@@ -1,0 +1,101 @@
+"""BGZF block-boundary search from an arbitrary compressed offset.
+
+Scan forward ≤ MAX_BLOCK_SIZE bytes; the first offset where
+``bgzf_blocks_to_check`` consecutive block headers parse wins
+(reference bgzf/.../block/FindBlockStart.scala:8-36; false-positive
+probability ≈ 2^(-32N)).
+
+Two implementations:
+- ``find_block_start``      — faithful sequential scan over a channel
+- ``find_block_starts_np``  — vectorized NumPy scan over an in-memory window,
+  used by the TPU-era split planner to resolve many shard starts at once
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from spark_bam_tpu.bgzf.block import MAX_BLOCK_SIZE
+from spark_bam_tpu.bgzf.header import (
+    Header,
+    HeaderParseException,
+    HeaderSearchFailedException,
+)
+from spark_bam_tpu.core.channel import ByteChannel
+
+
+def find_block_start(
+    ch: ByteChannel,
+    start: int,
+    bgzf_blocks_to_check: int = 5,
+    path: str = "<channel>",
+) -> int:
+    """First valid block-start offset ≥ ``start``."""
+    size = ch.size
+    for delta in range(MAX_BLOCK_SIZE):
+        pos = start + delta
+        if pos >= size:
+            break
+        try:
+            _check_chain(ch, pos, bgzf_blocks_to_check)
+            return pos
+        except (HeaderParseException, EOFError):
+            continue
+    raise HeaderSearchFailedException(path, start, min(MAX_BLOCK_SIZE, size - start))
+
+
+def _check_chain(ch: ByteChannel, pos: int, n: int) -> None:
+    """Parse up to n consecutive headers starting at pos (EOF earlier is OK
+    only if at least the first header parsed — mirrors MetadataStream.take(n)
+    which succeeds with fewer elements at EOF)."""
+    ch.seek(pos)
+    for i in range(n):
+        try:
+            header = Header.read(ch)
+        except EOFError:
+            if i == 0:
+                raise
+            return
+        ch.skip(header.compressed_size - header.size)
+
+
+def find_block_starts_np(
+    buf: np.ndarray, n_chain: int = 5, base: int = 0
+) -> np.ndarray:
+    """All offsets in ``buf`` where ``n_chain`` consecutive BGZF headers parse.
+
+    ``buf`` is a uint8 window of the compressed file starting at file offset
+    ``base``. An offset qualifies if headers chain ``n_chain`` deep *within
+    the window* (chains running off the window end count, matching the
+    sequential scan's EOF tolerance only when the window is the file tail —
+    callers pass windows padded by ``n_chain`` max-size blocks to avoid that
+    edge). Returns absolute file offsets.
+    """
+    n = len(buf)
+    if n < 18:
+        return np.empty(0, dtype=np.int64)
+    # Single-header validity mask over every offset with 18 bytes available.
+    m = n - 17
+    ok = (
+        (buf[0:m] == 31)
+        & (buf[1:m + 1] == 139)
+        & (buf[2:m + 2] == 8)
+        & (buf[3:m + 3] == 4)
+        & (buf[12:m + 12] == 66)
+        & (buf[13:m + 13] == 67)
+        & (buf[14:m + 14] == 2)
+    )
+    csize = (
+        buf[16:m + 16].astype(np.int64) | (buf[17:m + 17].astype(np.int64) << 8)
+    ) + 1
+    nxt = np.arange(m, dtype=np.int64) + csize
+    # Chain n_chain-1 jumps: header at i valid & header at i+csize valid & ...
+    chain_ok = ok.copy()
+    cur = nxt.copy()
+    for _ in range(n_chain - 1):
+        in_window = cur < m
+        # Off-window chains: treat as OK (padded windows make this the EOF case).
+        step_ok = np.where(in_window, ok[np.minimum(cur, m - 1)], True)
+        chain_ok &= step_ok
+        cur = np.where(in_window, nxt[np.minimum(cur, m - 1)], cur)
+    return np.flatnonzero(chain_ok).astype(np.int64) + base

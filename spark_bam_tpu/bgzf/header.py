@@ -1,0 +1,85 @@
+"""BGZF block-header parsing.
+
+A BGZF block is a gzip member with a BAM-specific "BC" extra subfield carrying
+the compressed block size. The 18 fixed header bytes are enough to learn the
+header size and compressed size (reference bgzf/.../block/Header.scala:14-88).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+EXPECTED_HEADER_SIZE = 18
+
+# (index, expected byte): gzip magic + deflate + FEXTRA, then the BAM "BC" subfield
+_MAGIC_CHECKS = (
+    (0, 31),
+    (1, 139),
+    (2, 8),
+    (3, 4),
+    (12, 66),   # 'B'
+    (13, 67),   # 'C'
+    (14, 2),    # subfield length = 2
+)
+
+
+class HeaderParseException(Exception):
+    """A fixed header byte didn't match.
+
+    Message format matches the reference ("Position %d: %d != %d",
+    bgzf/.../block/HeaderParseException.scala:5-11) — it is a user-visible
+    contract (load tests assert "Position 0: 64 != 31" when a SAM is loaded
+    as BAM).
+    """
+
+    def __init__(self, idx: int, actual: int, expected: int):
+        super().__init__(f"Position {idx}: {actual} != {expected}")
+        self.idx = idx
+        self.actual = actual
+        self.expected = expected
+
+
+class HeaderSearchFailedException(Exception):
+    """No valid BGZF block start found within a full block-size of scanning."""
+
+    def __init__(self, path, start: int, positions_attempted: int):
+        super().__init__(
+            f"Failed to find BGZF block boundary in {path} starting from {start}"
+            f" ({positions_attempted} positions attempted)"
+        )
+        self.path = path
+        self.start = start
+        self.positions_attempted = positions_attempted
+
+
+@dataclass(frozen=True)
+class Header:
+    size: int             # total header size: 18 + extra subfield bytes
+    compressed_size: int  # whole-block compressed size (header + payload + footer)
+
+    @staticmethod
+    def parse(buf: bytes | memoryview) -> "Header":
+        """Parse from ≥18 bytes. Raises HeaderParseException / EOFError."""
+        if len(buf) < EXPECTED_HEADER_SIZE:
+            raise EOFError(
+                f"Expected {EXPECTED_HEADER_SIZE} header bytes, got {len(buf)}"
+            )
+        for idx, expected in _MAGIC_CHECKS[:4]:
+            actual = buf[idx]
+            if actual != expected:
+                raise HeaderParseException(idx, actual, expected)
+        xlen = buf[10] | (buf[11] << 8)
+        extra = xlen - 6
+        for idx, expected in _MAGIC_CHECKS[4:]:
+            actual = buf[idx]
+            if actual != expected:
+                raise HeaderParseException(idx, actual, expected)
+        compressed_size = (buf[16] | (buf[17] << 8)) + 1
+        return Header(EXPECTED_HEADER_SIZE + extra, compressed_size)
+
+    @staticmethod
+    def read(ch) -> "Header":
+        """Parse from a ByteChannel positioned at a block start; consumes the header."""
+        header = Header.parse(ch.read_fully(EXPECTED_HEADER_SIZE))
+        ch.skip(header.size - EXPECTED_HEADER_SIZE)
+        return header

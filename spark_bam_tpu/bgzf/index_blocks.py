@@ -1,0 +1,64 @@
+"""Single-pass BGZF block indexer → ``.blocks`` sidecar.
+
+Emits ``start,compressedSize,uncompressedSize`` per block (reference
+bgzf/.../index/IndexBlocks.scala:11-52; line format :42). The sidecar is the
+durable accelerator consumed by the split planner (check/blocks.py) — reading
+it skips the parallel block search.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Iterable
+
+from spark_bam_tpu.bgzf.block import Metadata
+from spark_bam_tpu.bgzf.stream import MetadataStream
+from spark_bam_tpu.core.channel import open_channel
+
+log = logging.getLogger(__name__)
+
+
+def format_block_line(meta: Metadata) -> str:
+    return f"{meta.start},{meta.compressed_size},{meta.uncompressed_size}"
+
+
+def parse_block_line(line: str) -> Metadata:
+    parts = line.strip().split(",")
+    if len(parts) != 3:
+        raise ValueError(f"Bad blocks-index line: {line!r}")
+    return Metadata(int(parts[0]), int(parts[1]), int(parts[2]))
+
+
+def read_blocks_index(path) -> list[Metadata]:
+    with open(path) as f:
+        return [parse_block_line(line) for line in f if line.strip()]
+
+
+def index_blocks(
+    bam_path, out_path=None, heartbeat_seconds: float = 10.0
+) -> tuple[str, int]:
+    """Write the ``.blocks`` sidecar for ``bam_path``; returns (path, #blocks)."""
+    out_path = str(out_path) if out_path is not None else str(bam_path) + ".blocks"
+    count = 0
+    last_beat = time.monotonic()
+    with open_channel(bam_path) as ch, open(out_path, "w") as out:
+        for meta in MetadataStream(ch):
+            out.write(format_block_line(meta) + "\n")
+            count += 1
+            now = time.monotonic()
+            if now - last_beat >= heartbeat_seconds:
+                log.info("indexed %d blocks (at offset %d)", count, meta.start)
+                last_beat = now
+    return out_path, count
+
+
+def blocks_metadata(bam_path) -> Iterable[Metadata]:
+    """All block Metadata of a BAM: from the sidecar if present, else by scan."""
+    import os
+
+    sidecar = str(bam_path) + ".blocks"
+    if os.path.exists(sidecar):
+        return read_blocks_index(sidecar)
+    with open_channel(bam_path) as ch:
+        return list(MetadataStream(ch))

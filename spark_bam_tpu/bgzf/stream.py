@@ -1,0 +1,262 @@
+"""BGZF block streams and uncompressed-byte views.
+
+Host-side equivalents of the reference's block layer
+(bgzf/.../block/{Stream,MetadataStream,UncompressedBytes,PosIterator}.scala):
+
+- ``BlockStream``            — iterate decompressed ``Block``s (zlib raw-deflate)
+- ``SeekableBlockStream``    — adds ``seek`` + an LRU cache of 100 blocks
+- ``MetadataStream``         — iterate ``Metadata`` without decompressing
+- ``UncompressedBytes``      — linear byte-channel view over the blocks
+- ``SeekableUncompressedBytes`` — virtual-position addressable variant
+- ``pos_iterator``           — all candidate ``Pos`` of a block
+
+The TPU hot path does not use these per-byte views; it inflates whole windows
+of blocks into flat buffers (``spark_bam_tpu.tpu.inflate``). These streams
+serve header parsing, indexing, oracles and golden tests.
+"""
+
+from __future__ import annotations
+
+import zlib
+from collections import OrderedDict
+from typing import Iterator, Optional
+
+from spark_bam_tpu.bgzf.block import Block, Metadata, FOOTER_SIZE
+from spark_bam_tpu.bgzf.header import Header
+from spark_bam_tpu.core.channel import ByteChannel
+from spark_bam_tpu.core.pos import Pos
+
+
+def inflate_block_payload(comp: bytes | memoryview, uncompressed_size: int) -> bytes:
+    """Raw-DEFLATE inflate of one block payload (reference Stream.scala:49-54)."""
+    data = zlib.decompress(bytes(comp), wbits=-15, bufsize=max(uncompressed_size, 1))
+    if len(data) != uncompressed_size:
+        raise IOError(
+            f"Expected {uncompressed_size} decompressed bytes, found {len(data)}"
+        )
+    return data
+
+
+def read_block(ch: ByteChannel) -> Optional[Block]:
+    """Read + inflate the block at the channel position; None at EOF sentinel/EOF."""
+    start = ch.position()
+    try:
+        header = Header.read(ch)
+    except EOFError:
+        return None
+    remaining = header.compressed_size - header.size
+    payload = ch.read_fully(remaining)
+    data_length = remaining - FOOTER_SIZE
+    uncompressed_size = int.from_bytes(payload[-4:], "little")
+    if data_length == 2:
+        # 28-byte empty terminator block (reference Stream.scala:56-58)
+        return None
+    data = inflate_block_payload(payload[:data_length], uncompressed_size)
+    return Block(data, start, header.compressed_size)
+
+
+class BlockStream:
+    """Iterator of decompressed Blocks from a channel (reference ``Stream``)."""
+
+    def __init__(self, ch: ByteChannel):
+        self.ch = ch
+        self._head: Optional[Block] = None
+        self._done = False
+
+    def _advance(self) -> Optional[Block]:
+        try:
+            return read_block(self.ch)
+        except EOFError:
+            return None
+
+    def head(self) -> Optional[Block]:
+        if self._head is None and not self._done:
+            self._head = self._advance()
+            if self._head is None:
+                self._done = True
+        return self._head
+
+    def __iter__(self) -> Iterator[Block]:
+        return self
+
+    def __next__(self) -> Block:
+        blk = self.head()
+        if blk is None:
+            raise StopIteration
+        self._head = None
+        return blk
+
+    def close(self) -> None:
+        self.ch.close()
+
+
+class SeekableBlockStream(BlockStream):
+    """BlockStream + ``seek(block_pos)`` + LRU cache of decompressed blocks.
+
+    Cache size 100 matches the reference (Stream.scala:83-92).
+    """
+
+    MAX_CACHE_SIZE = 100
+
+    def __init__(self, ch: ByteChannel):
+        super().__init__(ch)
+        self._cache: OrderedDict[int, Block] = OrderedDict()
+
+    def _advance(self) -> Optional[Block]:
+        start = self.ch.position()
+        blk = self._cache.get(start)
+        if blk is not None:
+            self._cache.move_to_end(start)
+            self.ch.seek(start + blk.compressed_size)
+            blk.idx = 0
+            return blk
+        blk = super()._advance()
+        if blk is not None:
+            self._cache[start] = blk
+            if len(self._cache) > self.MAX_CACHE_SIZE:
+                self._cache.popitem(last=False)
+        return blk
+
+    def seek(self, block_pos: int) -> None:
+        head = self._head
+        if head is not None and head.start == block_pos:
+            head.idx = 0
+            return
+        self._head = None
+        self._done = False
+        self.ch.seek(block_pos)
+
+
+class MetadataStream:
+    """Iterate block Metadata without inflating (reference MetadataStream.scala)."""
+
+    def __init__(self, ch: ByteChannel):
+        self.ch = ch
+
+    def __iter__(self) -> Iterator[Metadata]:
+        while True:
+            start = self.ch.position()
+            try:
+                header = Header.read(self.ch)
+            except EOFError:
+                return
+            remaining = header.compressed_size - header.size
+            self.ch.skip(remaining - 4)
+            uncompressed_size = self.ch.read_i32()
+            if remaining - FOOTER_SIZE == 2:
+                return  # EOF sentinel block
+            yield Metadata(start, header.compressed_size, uncompressed_size)
+
+    def close(self) -> None:
+        self.ch.close()
+
+
+def pos_iterator(meta: Metadata) -> Iterator[Pos]:
+    """All candidate virtual positions of a block (reference PosIterator.scala)."""
+    for offset in range(meta.uncompressed_size):
+        yield Pos(meta.start, offset)
+
+
+class UncompressedBytes:
+    """Linear reader over the concatenated uncompressed bytes of a block stream.
+
+    ``tell()`` is a linear coordinate counted from construction/last seek —
+    the checkers only use differences and equality against it (see
+    eager.Checker.scala:36-47,116-119).
+    """
+
+    def __init__(self, stream: BlockStream):
+        self.stream = stream
+        self._linear = 0
+
+    # -- position ------------------------------------------------------------
+    def tell(self) -> int:
+        return self._linear
+
+    def cur_pos(self) -> Optional[Pos]:
+        blk = self.stream.head()
+        if blk is None:
+            return None
+        if blk.idx >= len(blk.data):
+            next(self.stream, None)
+            return self.cur_pos()
+        return blk.pos
+
+    def cur_block(self) -> Optional[Block]:
+        if self.cur_pos() is None:
+            return None
+        return self.stream.head()
+
+    # -- reads ---------------------------------------------------------------
+    def read(self, n: int) -> bytes:
+        out = bytearray()
+        while n > 0:
+            blk = self.cur_block()
+            if blk is None:
+                break
+            take = min(n, len(blk.data) - blk.idx)
+            out += blk.data[blk.idx: blk.idx + take]
+            blk.idx += take
+            self._linear += take
+            n -= take
+        return bytes(out)
+
+    def read_fully(self, n: int) -> bytes:
+        data = self.read(n)
+        if len(data) != n:
+            raise EOFError(f"wanted {n} bytes, got {len(data)}")
+        return data
+
+    def read_i32(self) -> int:
+        return int.from_bytes(self.read_fully(4), "little", signed=True)
+
+    def read_u8(self) -> int:
+        return self.read_fully(1)[0]
+
+    def skip(self, n: int) -> int:
+        """Advance up to n bytes; returns bytes actually skipped."""
+        skipped = 0
+        while n > 0:
+            blk = self.cur_block()
+            if blk is None:
+                break
+            take = min(n, len(blk.data) - blk.idx)
+            blk.idx += take
+            self._linear += take
+            skipped += take
+            n -= take
+        return skipped
+
+    def has_next(self) -> bool:
+        return self.cur_pos() is not None
+
+    def next_byte(self) -> int:
+        blk = self.cur_block()
+        if blk is None:
+            raise EOFError("at end of stream")
+        b = blk.data[blk.idx]
+        blk.idx += 1
+        self._linear += 1
+        return b
+
+    def close(self) -> None:
+        self.stream.close()
+
+
+class SeekableUncompressedBytes(UncompressedBytes):
+    """UncompressedBytes addressable by virtual position."""
+
+    def __init__(self, stream: SeekableBlockStream):
+        super().__init__(stream)
+        self.stream: SeekableBlockStream = stream
+
+    @staticmethod
+    def open(ch: ByteChannel) -> "SeekableUncompressedBytes":
+        return SeekableUncompressedBytes(SeekableBlockStream(ch))
+
+    def seek(self, pos: Pos) -> None:
+        self.stream.seek(pos.block_pos)
+        self._linear = 0
+        blk = self.stream.head()
+        if blk is not None:
+            blk.idx = pos.offset

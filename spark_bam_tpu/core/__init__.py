@@ -1,0 +1,4 @@
+from spark_bam_tpu.core.pos import Pos
+from spark_bam_tpu.core.config import Config, default_config
+
+__all__ = ["Pos", "Config", "default_config"]

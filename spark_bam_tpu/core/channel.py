@@ -1,0 +1,183 @@
+"""Byte channels: seekable random-access byte sources.
+
+Replaces the reference's L0 ``org.hammerlab.channel`` layer
+(``SeekableByteChannel``, ``CachingChannel`` — SURVEY.md §1 L0). Local files
+are served from ``mmap`` (zero-copy slices straight into NumPy); the class is
+the single IO seam, so remote backends (GCS/HTTP) plug in by subclassing
+``ByteChannel`` — only ``_read_at`` needs overriding, and ``CachingChannel``
+supplies the chunk cache that makes high-latency backends viable
+(SURVEY.md §7 "Remote storage IO").
+"""
+
+from __future__ import annotations
+
+import io
+import mmap
+import os
+import struct
+from collections import OrderedDict
+
+
+class ByteChannel:
+    """Positioned byte source. ``read_fully`` raises EOFError on short reads."""
+
+    def __init__(self):
+        self._pos = 0
+
+    # -- subclass surface ---------------------------------------------------
+    def _read_at(self, pos: int, n: int) -> bytes:
+        raise NotImplementedError
+
+    @property
+    def size(self) -> int:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+    # -- shared behavior ----------------------------------------------------
+    def position(self) -> int:
+        return self._pos
+
+    def seek(self, pos: int) -> None:
+        self._pos = pos
+
+    def skip(self, n: int) -> None:
+        self._pos += n
+
+    def read(self, n: int) -> bytes:
+        """Read up to n bytes (may be short at EOF)."""
+        data = self._read_at(self._pos, n)
+        self._pos += len(data)
+        return data
+
+    def read_fully(self, n: int) -> bytes:
+        data = self.read(n)
+        if len(data) != n:
+            raise EOFError(f"wanted {n} bytes at {self._pos - len(data)}, got {len(data)}")
+        return data
+
+    def read_u8(self) -> int:
+        return self.read_fully(1)[0]
+
+    def read_i32(self) -> int:
+        return struct.unpack("<i", self.read_fully(4))[0]
+
+    def read_u16(self) -> int:
+        return struct.unpack("<H", self.read_fully(2))[0]
+
+    def read_u64(self) -> int:
+        return struct.unpack("<Q", self.read_fully(8))[0]
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class MMapChannel(ByteChannel):
+    """mmap-backed channel for local files (the default)."""
+
+    def __init__(self, path):
+        super().__init__()
+        self.path = str(path)
+        self._f = open(self.path, "rb")
+        self._size = os.fstat(self._f.fileno()).st_size
+        self._mm = mmap.mmap(self._f.fileno(), 0, access=mmap.ACCESS_READ) if self._size else b""
+
+    def _read_at(self, pos: int, n: int) -> bytes:
+        if pos >= self._size:
+            return b""
+        return self._mm[pos: pos + n]
+
+    def memoryview(self, pos: int, n: int) -> memoryview:
+        """Zero-copy view (local-file fast path used by the batched inflater)."""
+        return memoryview(self._mm)[pos: pos + n]
+
+    @property
+    def size(self) -> int:
+        return self._size
+
+    def close(self) -> None:
+        if isinstance(self._mm, mmap.mmap):
+            self._mm.close()
+        self._f.close()
+
+
+class FileStreamChannel(ByteChannel):
+    """Buffered sequential channel over an arbitrary file object (non-mmap path)."""
+
+    def __init__(self, fobj: io.RawIOBase, size: int | None = None):
+        super().__init__()
+        self._f = fobj
+        self._size = size
+
+    def _read_at(self, pos: int, n: int) -> bytes:
+        self._f.seek(pos)
+        return self._f.read(n) or b""
+
+    @property
+    def size(self) -> int:
+        if self._size is None:
+            cur = self._f.tell()
+            self._size = self._f.seek(0, io.SEEK_END)
+            self._f.seek(cur)
+        return self._size
+
+    def close(self) -> None:
+        self._f.close()
+
+
+class CachingChannel(ByteChannel):
+    """LRU chunk cache over another channel.
+
+    Analog of the reference's ``CachingChannel`` wrapped around every
+    executor-side file handle (load/.../Channels.scala:9-27). Chunks are
+    fixed-size and aligned; useful over high-latency channels.
+    """
+
+    def __init__(self, inner: ByteChannel, chunk_size: int = 256 << 10, max_chunks: int = 64):
+        super().__init__()
+        self.inner = inner
+        self.chunk_size = chunk_size
+        self.max_chunks = max_chunks
+        self._cache: OrderedDict[int, bytes] = OrderedDict()
+
+    def _chunk(self, idx: int) -> bytes:
+        chunk = self._cache.get(idx)
+        if chunk is None:
+            chunk = self.inner._read_at(idx * self.chunk_size, self.chunk_size)
+            self._cache[idx] = chunk
+            if len(self._cache) > self.max_chunks:
+                self._cache.popitem(last=False)
+        else:
+            self._cache.move_to_end(idx)
+        return chunk
+
+    def _read_at(self, pos: int, n: int) -> bytes:
+        out = []
+        remaining = n
+        while remaining > 0:
+            idx, off = divmod(pos, self.chunk_size)
+            chunk = self._chunk(idx)
+            piece = chunk[off: off + remaining]
+            if not piece:
+                break
+            out.append(piece)
+            pos += len(piece)
+            remaining -= len(piece)
+        return b"".join(out)
+
+    @property
+    def size(self) -> int:
+        return self.inner.size
+
+    def close(self) -> None:
+        self.inner.close()
+
+
+def open_channel(path, cached: bool = False) -> ByteChannel:
+    """Open a channel for a path (local mmap today; the pluggable IO seam)."""
+    ch: ByteChannel = MMapChannel(path)
+    return CachingChannel(ch) if cached else ch

@@ -1,0 +1,124 @@
+"""Typed configuration surface.
+
+The reference exposes value-class knobs with implicit defaults
+(bgzf/.../block/package.scala:20-22, check/.../package.scala:36-58,
+bgzf/.../EstimatedCompressionRatio.scala:5-14) plus a ``spark.bam.*``-style
+config namespace. Here the same knobs live on one explicit dataclass; every
+API/CLI entry point threads a ``Config`` instead of Scala implicits.
+
+Keys may also be supplied as a flat ``{"spark.bam.<knob>": value}`` mapping
+(``Config.from_dict``) for parity with the reference's config-surface contract
+(BASELINE.json: "gated behind the existing Checker plugin and spark.bam.*
+config surface").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+from dataclasses import dataclass
+
+_SIZE_RE = re.compile(r"^\s*(\d+(?:\.\d+)?)\s*([kKmMgGtTpP]?)i?[bB]?\s*$")
+
+_SIZE_FACTORS = {
+    "": 1,
+    "k": 1 << 10,
+    "m": 1 << 20,
+    "g": 1 << 30,
+    "t": 1 << 40,
+    "p": 1 << 50,
+}
+
+
+def parse_bytes(s) -> int:
+    """Parse byte-size shorthand: ``"2MB"``, ``"32m"``, ``"100KB"``, ``1024``.
+
+    Mirrors the reference's ``hammerlab.bytes`` shorthand accepted by
+    ``SplitSize.Args`` (check/.../args/SplitSize.scala:9-32).
+    """
+    if isinstance(s, int):
+        return s
+    m = _SIZE_RE.match(str(s))
+    if not m:
+        raise ValueError(f"Bad byte-size: {s!r}")
+    value, unit = m.groups()
+    return int(float(value) * _SIZE_FACTORS[unit.lower()])
+
+
+def format_bytes(n: int) -> str:
+    for unit, shift in (("PB", 50), ("TB", 40), ("GB", 30), ("MB", 20), ("KB", 10)):
+        if n >= (1 << shift) and n % (1 << shift) == 0:
+            return f"{n >> shift}{unit}"
+    for unit, shift in (("PB", 50), ("TB", 40), ("GB", 30), ("MB", 20), ("KB", 10)):
+        if n >= (1 << shift):
+            return f"{n / (1 << shift):.1f}{unit}"
+    return f"{n}B"
+
+
+@dataclass(frozen=True)
+class Config:
+    # --- BGZF block search (bgzf/.../block/package.scala:20-22) ---
+    bgzf_blocks_to_check: int = 5       # consecutive headers a block-start must chain
+    # --- record checking (check/.../package.scala:36-58) ---
+    reads_to_check: int = 10            # consecutive records a boundary must chain
+    max_read_size: int = 10_000_000     # byte budget for a boundary scan
+    # --- split planning ---
+    split_size: int | None = None       # bytes; None → context default (2MB check path)
+    estimated_compression_ratio: float = 3.0
+    # --- backend selection: the Checker plugin surface ---
+    checker: str = "eager"              # eager | full | indexed | seqdoop
+    backend: str = "auto"               # auto | tpu | numpy | python | native
+    # --- TPU execution shape ---
+    window_size: int = 64 << 20         # uncompressed bytes checked per device window
+    halo_size: int = 4 << 20            # extra trailing bytes so chains can complete
+    # --- misc ---
+    warn: bool = False                  # root log-level toggle (args/LogArgs.scala:30-33)
+    post_partition_size: int = 100_000  # PostPartitionArgs default (args/PostPartitionArgs.scala:38-43)
+
+    CHECK_SPLIT_SIZE_DEFAULT = 2 << 20  # Blocks.scala:64
+    LOAD_SPLIT_SIZE_DEFAULT = 32 << 20  # hadoop FileSplits default in the load path
+
+    def split_size_or(self, default: int) -> int:
+        return self.split_size if self.split_size is not None else default
+
+    def replace(self, **kw) -> "Config":
+        if "split_size" in kw and kw["split_size"] is not None:
+            kw["split_size"] = parse_bytes(kw["split_size"])
+        return dataclasses.replace(self, **kw)
+
+    _PREFIX = "spark.bam."
+
+    @classmethod
+    def from_dict(cls, d: dict, base: "Config | None" = None) -> "Config":
+        """Build from a flat ``spark.bam.*`` (or bare-key) mapping."""
+        base = base or cls()
+        fields = {f.name: f for f in dataclasses.fields(cls)}
+        kw = {}
+        for key, value in d.items():
+            name = key[len(cls._PREFIX):] if key.startswith(cls._PREFIX) else key
+            name = name.replace(".", "_").replace("-", "_")
+            if name not in fields:
+                raise KeyError(f"Unknown config key: {key}")
+            f = fields[name]
+            if f.type in ("int", int):
+                value = parse_bytes(value) if isinstance(value, str) else int(value)
+            elif f.type in ("float", float):
+                value = float(value)
+            elif f.type in ("bool", bool):
+                value = value if isinstance(value, bool) else str(value).lower() in ("1", "true", "yes")
+            kw[name] = value
+        return base.replace(**kw)
+
+    @classmethod
+    def from_env(cls, env=os.environ, base: "Config | None" = None) -> "Config":
+        """Read ``SPARK_BAM_<KNOB>`` environment overrides."""
+        d = {}
+        for key, value in env.items():
+            if key.startswith("SPARK_BAM_"):
+                d[key[len("SPARK_BAM_"):].lower()] = value
+        return cls.from_dict(d, base=base) if d else (base or cls())
+
+
+def default_config() -> Config:
+    return Config.from_env()

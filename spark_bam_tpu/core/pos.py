@@ -1,0 +1,49 @@
+"""Virtual positions in a BGZF file.
+
+A "virtual position" is (compressed offset of a BGZF block start, offset into
+that block's *uncompressed* payload). Mirrors the reference's
+``org.hammerlab.bgzf.Pos`` (bgzf/.../Pos.scala:12-43) including the packed
+HTSJDK ``long`` encoding (48-bit block position << 16 | 16-bit offset).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+
+class Pos(NamedTuple):
+    block_pos: int  # byte offset of the BGZF block start in the compressed file
+    offset: int     # offset into the block's uncompressed payload (< 65536)
+
+    def __str__(self) -> str:
+        return f"{self.block_pos}:{self.offset}"
+
+    def to_htsjdk(self) -> int:
+        """Pack into the HTSJDK-style 64-bit virtual offset."""
+        return (self.block_pos << 16) | self.offset
+
+    @staticmethod
+    def from_htsjdk(vpos: int) -> "Pos":
+        return Pos(vpos >> 16, vpos & 0xFFFF)
+
+    def distance(self, other: "Pos", estimated_compression_ratio: float = 3.0) -> int:
+        """Approximate *compressed*-byte distance ``self - other``.
+
+        Intra-block uncompressed offsets are scaled down by the estimated
+        compression ratio (reference Pos.scala:17-22, default ratio 3.0 from
+        EstimatedCompressionRatio.scala:13).
+        """
+        return max(
+            0,
+            self.block_pos
+            - other.block_pos
+            + int((self.offset - other.offset) / estimated_compression_ratio),
+        )
+
+
+def parse_pos(s: str) -> Pos:
+    """Parse ``"blockPos:offset"`` (or a bare block position) into a Pos."""
+    if ":" in s:
+        block, off = s.split(":", 1)
+        return Pos(int(block), int(off))
+    return Pos(int(s), 0)

@@ -1,0 +1,98 @@
+"""Descriptive-stats pretty printing.
+
+The reference reports N/μ/σ, med/mad, run-length-encoded element lists and a
+percentile ladder everywhere results are summarized (org.hammerlab.stats;
+format visible in bgzf StreamTest.scala:36-58 and the CLI golden outputs).
+This reproduces that report shape.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Iterable, Sequence
+
+
+def _fmt(x: float) -> str:
+    if isinstance(x, float) and not x.is_integer():
+        return f"{x:.1f}" if abs(x) >= 1 else f"{x:.2f}"
+    return str(int(x))
+
+
+def _rle(values: Sequence[int], limit: int = 10) -> str:
+    """Run-length-encode: ``65498×24 34570``; head…tail truncation beyond 2*limit."""
+    runs: list[tuple[int, int]] = []
+    for v in values:
+        if runs and runs[-1][0] == v:
+            runs[-1] = (v, runs[-1][1] + 1)
+        else:
+            runs.append((v, 1))
+
+    def show(run):
+        v, n = run
+        return f"{_fmt(v)}×{n}" if n > 1 else _fmt(v)
+
+    if len(runs) > 2 * limit:
+        head = " ".join(show(r) for r in runs[:limit])
+        tail = " ".join(show(r) for r in runs[-limit:])
+        return f"{head} … {tail}"
+    return " ".join(show(r) for r in runs)
+
+
+def _percentile(sorted_vals: Sequence[float], p: float) -> float:
+    """Linear-interpolated percentile on a sorted sequence."""
+    n = len(sorted_vals)
+    if n == 1:
+        return sorted_vals[0]
+    rank = p / 100 * (n - 1)
+    lo = int(math.floor(rank))
+    hi = min(lo + 1, n - 1)
+    frac = rank - lo
+    return sorted_vals[lo] * (1 - frac) + sorted_vals[hi] * frac
+
+
+def percentile_ladder(n: int) -> list[float]:
+    """Percentiles to report, widened as N grows (matches reference's scaling idea)."""
+    ladder = [50.0]
+    tiers = [(2, [25, 75]), (6, [10, 90]), (11, [5, 95]),
+             (21, [1, 99]), (101, [0.1, 99.9]), (1001, [0.01, 99.99])]
+    for min_n, (lo, hi) in tiers:
+        if n >= min_n:
+            ladder = [lo] + ladder + [hi]
+    return ladder
+
+
+class Stats:
+    """Summary statistics of an integer/float sample, reference-style rendering."""
+
+    def __init__(self, values: Iterable[float]):
+        self.values = list(values)
+        self.n = len(self.values)
+        if self.n:
+            self.mean = sum(self.values) / self.n
+            self.stddev = math.sqrt(
+                sum((v - self.mean) ** 2 for v in self.values) / self.n
+            )
+            s = sorted(self.values)
+            self.sorted = s
+            self.median = _percentile(s, 50)
+            self.mad = _percentile(sorted(abs(v - self.median) for v in s), 50)
+
+    def show(self, indent: str = "") -> str:
+        if not self.n:
+            return f"{indent}(empty)"
+        lines = [
+            f"N: {self.n}, μ/σ: {_fmt(round(self.mean, 1))}/{_fmt(round(self.stddev, 1))},"
+            f" med/mad: {_fmt(self.median)}/{_fmt(self.mad)}"
+        ]
+        if self.n > 1:
+            lines.append(f" elems: {_rle(self.values)}")
+            if sorted(self.values) != self.values and len(set(self.values)) > 1:
+                lines.append(f"sorted: {_rle(self.sorted)}")
+            for p in percentile_ladder(self.n):
+                val = round(_percentile(self.sorted, p), 1)
+                pname = _fmt(p) if p != int(p) else str(int(p))
+                lines.append(f"{pname:>4}:\t{_fmt(val)}")
+        return "\n".join(indent + line for line in lines)
+
+    def __str__(self) -> str:
+        return self.show()

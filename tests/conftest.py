@@ -1,0 +1,57 @@
+"""Test configuration.
+
+JAX tests run on a virtual 8-device CPU mesh so multi-chip sharding logic is
+exercised without TPU hardware; set the XLA flags before jax is imported
+anywhere.
+"""
+
+import os
+import sys
+from pathlib import Path
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+import pytest  # noqa: E402
+
+# Reference test fixtures (small real BAMs + golden sidecars). Read-only.
+FIXTURES = Path("/root/reference/test_bams/src/main/resources")
+
+
+def fixture(name: str) -> Path:
+    return FIXTURES / name
+
+
+@pytest.fixture(scope="session")
+def bam1():
+    p = fixture("1.bam")
+    if not p.exists():
+        pytest.skip("reference fixtures unavailable")
+    return p
+
+
+@pytest.fixture(scope="session")
+def bam2():
+    p = fixture("2.bam")
+    if not p.exists():
+        pytest.skip("reference fixtures unavailable")
+    return p
+
+
+@pytest.fixture(scope="session")
+def sam2():
+    p = fixture("2.sam")
+    if not p.exists():
+        pytest.skip("reference fixtures unavailable")
+    return p
+
+
+@pytest.fixture(scope="session")
+def bam5k():
+    p = fixture("5k.bam")
+    if not p.exists():
+        pytest.skip("reference fixtures unavailable")
+    return p

@@ -1,0 +1,124 @@
+"""BGZF block layer vs the reference's golden facts.
+
+Golden values are implementation-independent facts about the checked-in
+fixture BAMs (reference bgzf StreamTest.scala:36-58, MetadataStreamTest).
+"""
+
+import numpy as np
+import pytest
+
+from spark_bam_tpu.bgzf import (
+    Block,
+    BlockStream,
+    Header,
+    HeaderParseException,
+    Metadata,
+    MetadataStream,
+    SeekableBlockStream,
+    SeekableUncompressedBytes,
+    find_block_start,
+)
+from spark_bam_tpu.bgzf.find_block_start import find_block_starts_np
+from spark_bam_tpu.bgzf.index_blocks import (
+    format_block_line,
+    index_blocks,
+    read_blocks_index,
+)
+from spark_bam_tpu.core.channel import open_channel
+from spark_bam_tpu.core.pos import Pos
+
+
+def meta(block: Block) -> Metadata:
+    return block.metadata()
+
+
+def test_block_stream_2bam(bam2):
+    with open_channel(bam2) as ch:
+        blocks = list(BlockStream(ch))
+    assert len(blocks) == 25
+    assert meta(blocks[0]) == Metadata(0, 26169, 65498)
+    assert meta(blocks[1]) == Metadata(26169, 24080, 65498)
+    assert meta(blocks[2]) == Metadata(50249, 25542, 65498)
+    # All but the last block inflate to 65,498 bytes.
+    assert all(b.uncompressed_size == 65498 for b in blocks[:-1])
+    assert blocks[-1].uncompressed_size == 34570
+    # Total uncompressed size is a published fixture fact (~1,606,522 positions).
+    assert sum(b.uncompressed_size for b in blocks) == 1_606_522
+
+
+def test_seekable_stream(bam2):
+    with open_channel(bam2) as ch:
+        stream = SeekableBlockStream(ch)
+        assert meta(next(stream)) == Metadata(0, 26169, 65498)
+        stream.seek(0)
+        assert meta(next(stream)) == Metadata(0, 26169, 65498)
+        stream.seek(0)
+        assert meta(next(stream)) == Metadata(0, 26169, 65498)
+        assert meta(next(stream)) == Metadata(26169, 24080, 65498)
+        stream.seek(0)
+        assert meta(next(stream)) == Metadata(0, 26169, 65498)
+        stream.seek(75791)
+        assert meta(next(stream)) == Metadata(75791, 22308, 65498)
+
+
+def test_metadata_stream_matches_blocks_sidecar(bam2):
+    with open_channel(bam2) as ch:
+        metas = list(MetadataStream(ch))
+    sidecar = read_blocks_index(str(bam2) + ".blocks")
+    assert metas == sidecar
+
+
+def test_header_parse_rejects_sam(sam2):
+    with open_channel(sam2) as ch:
+        with pytest.raises(HeaderParseException, match=r"Position 0: 64 != 31"):
+            Header.read(ch)
+
+
+def test_seekable_uncompressed_bytes(bam2):
+    with open_channel(bam2) as ch:
+        u = SeekableUncompressedBytes.open(ch)
+        u.seek(Pos(0, 0))
+        assert u.read_fully(4) == b"BAM\x01"
+        # Crossing a block boundary: read to the end of block 0 and beyond.
+        u.seek(Pos(0, 65490))
+        data = u.read_fully(16)
+        assert len(data) == 16
+        assert u.cur_pos() == Pos(26169, 8)
+        # tell() counts linearly from the seek.
+        u.seek(Pos(26169, 100))
+        assert u.tell() == 0
+        u.read_fully(10)
+        assert u.tell() == 10
+
+
+def test_index_blocks_roundtrip(bam2, tmp_path):
+    out, count = index_blocks(bam2, tmp_path / "2.bam.blocks")
+    assert count == 25
+    written = [line.strip() for line in open(out)]
+    golden = [line.strip() for line in open(str(bam2) + ".blocks")]
+    assert written == golden
+    sidecar = read_blocks_index(out)
+    assert format_block_line(sidecar[0]) == "0,26169,65498"
+
+
+def test_find_block_start(bam2):
+    with open_channel(bam2) as ch:
+        # Exactly at a block boundary.
+        assert find_block_start(ch, 0) == 0
+        assert find_block_start(ch, 26169) == 26169
+        # Mid-block: next boundary found by scanning forward.
+        assert find_block_start(ch, 1) == 26169
+        assert find_block_start(ch, 26000) == 26169
+        assert find_block_start(ch, 26170) == 50249
+
+
+def test_find_block_starts_np(bam2):
+    sidecar = read_blocks_index(str(bam2) + ".blocks")
+    starts = {m.start for m in sidecar}
+    with open_channel(bam2) as ch:
+        buf = np.frombuffer(ch.read_fully(ch.size), dtype=np.uint8)
+    found = find_block_starts_np(buf, n_chain=5)
+    # Every real block start is found; the EOF sentinel start is also a valid
+    # header chain (it is a real block, just empty).
+    eof_sentinel = sidecar[-1].start + sidecar[-1].compressed_size
+    assert starts | {eof_sentinel} == set(found.tolist())

@@ -1,0 +1,56 @@
+from spark_bam_tpu.core.config import Config, format_bytes, parse_bytes
+from spark_bam_tpu.core.pos import Pos, parse_pos
+from spark_bam_tpu.core.ranges import ByteRange, RangeSet, parse_range, parse_ranges
+
+
+def test_pos_htsjdk_roundtrip():
+    p = Pos(239479, 311)
+    assert Pos.from_htsjdk(p.to_htsjdk()) == p
+    assert p.to_htsjdk() == (239479 << 16) | 311
+    assert str(p) == "239479:311"
+    assert parse_pos("239479:311") == p
+    assert parse_pos("100") == Pos(100, 0)
+
+
+def test_pos_distance():
+    # Intra-block offsets scale by the estimated compression ratio (default 3.0).
+    assert Pos(1000, 300).distance(Pos(1000, 0)) == 100
+    assert Pos(0, 0).distance(Pos(1000, 0)) == 0  # clamped at 0
+
+
+def test_parse_bytes():
+    assert parse_bytes("2MB") == 2 << 20
+    assert parse_bytes("32m") == 32 << 20
+    assert parse_bytes("100KB") == 100 << 10
+    assert parse_bytes("1g") == 1 << 30
+    assert parse_bytes(12345) == 12345
+    assert parse_bytes("7") == 7
+    assert format_bytes(2 << 20) == "2MB"
+
+
+def test_ranges_grammar():
+    assert parse_range("10-20") == ByteRange(10, 20)
+    assert parse_range("10+5") == ByteRange(10, 15)
+    assert parse_range("7") == ByteRange(7, 8)
+    assert parse_range("1k-2k") == ByteRange(1024, 2048)
+    rs = parse_ranges("0-10,20+5,100")
+    assert 5 in rs and 22 in rs and 100 in rs
+    assert 15 not in rs and 101 not in rs
+    assert rs.overlaps(8, 12) and not rs.overlaps(12, 18)
+    # Adjacent/overlapping ranges merge.
+    merged = RangeSet([ByteRange(0, 10), ByteRange(5, 15)])
+    assert merged.ranges == (ByteRange(0, 15),)
+    assert parse_ranges(None) is None and parse_ranges("  ") is None
+
+
+def test_config_surface():
+    c = Config()
+    assert c.bgzf_blocks_to_check == 5
+    assert c.reads_to_check == 10
+    assert c.max_read_size == 10_000_000
+    assert c.estimated_compression_ratio == 3.0
+    c2 = Config.from_dict({"spark.bam.reads_to_check": 3, "split_size": "4MB"})
+    assert c2.reads_to_check == 3
+    assert c2.split_size == 4 << 20
+    c3 = Config.from_env({"SPARK_BAM_CHECKER": "full"})
+    assert c3.checker == "full"
