@@ -1,0 +1,19 @@
+from spark_bam_tpu.bam.header import BamHeader, ContigLengths, read_header
+from spark_bam_tpu.bam.record import BamRecord
+from spark_bam_tpu.bam.iterators import (
+    PosStream,
+    RecordStream,
+    SeekablePosStream,
+    SeekableRecordStream,
+)
+
+__all__ = [
+    "BamHeader",
+    "ContigLengths",
+    "read_header",
+    "BamRecord",
+    "PosStream",
+    "RecordStream",
+    "SeekablePosStream",
+    "SeekableRecordStream",
+]

@@ -1,0 +1,143 @@
+"""``.bai`` BAM-index reader and interval → chunk queries.
+
+Reference: check/.../bam/index/Index.scala:11-93 (METADATA_BIN_ID :92) plus
+the HTSJDK-delegating chunk query used by ``loadBamIntervals``
+(load/.../CanLoadBam.scala:387-421). Here both live in one module: parse the
+BAI binning + linear index, and answer "which (start,end) virtual-position
+chunks can contain alignments overlapping [start,end) on contig c".
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+
+from spark_bam_tpu.core.pos import Pos
+
+METADATA_BIN_ID = 37450  # magic bin holding per-reference metadata pseudo-chunks
+LINEAR_INDEX_SHIFT = 14  # 16 KiB linear-index windows
+
+
+@dataclass(frozen=True)
+class Chunk:
+    start: Pos
+    end: Pos
+
+    def size(self, estimated_compression_ratio: float = 3.0) -> int:
+        """Approximate compressed size (used for bin-packing into partitions)."""
+        return self.end.distance(self.start, estimated_compression_ratio)
+
+
+@dataclass
+class Reference:
+    bins: dict[int, list[Chunk]]
+    linear_index: list[int]  # virtual offsets, one per 16 KiB window
+    metadata_chunks: list[Chunk]
+
+
+@dataclass
+class BaiIndex:
+    references: list[Reference]
+    n_no_coor: int | None
+
+    @staticmethod
+    def read(path) -> "BaiIndex":
+        with open(path, "rb") as f:
+            data = f.read()
+        if data[:4] != b"BAI\x01":
+            raise ValueError(f"Not a BAI index: bad magic {data[:4]!r}")
+        off = 4
+        (n_ref,) = struct.unpack_from("<i", data, off)
+        off += 4
+        refs = []
+        for _ in range(n_ref):
+            (n_bin,) = struct.unpack_from("<i", data, off)
+            off += 4
+            bins: dict[int, list[Chunk]] = {}
+            meta: list[Chunk] = []
+            for _ in range(n_bin):
+                bin_id, n_chunk = struct.unpack_from("<Ii", data, off)
+                off += 8
+                chunks = []
+                for _ in range(n_chunk):
+                    beg, end = struct.unpack_from("<QQ", data, off)
+                    off += 16
+                    chunks.append(Chunk(Pos.from_htsjdk(beg), Pos.from_htsjdk(end)))
+                if bin_id == METADATA_BIN_ID:
+                    meta = chunks
+                else:
+                    bins[bin_id] = chunks
+            (n_intv,) = struct.unpack_from("<i", data, off)
+            off += 4
+            linear = list(struct.unpack_from(f"<{n_intv}Q", data, off))
+            off += 8 * n_intv
+            refs.append(Reference(bins, linear, meta))
+        n_no_coor = None
+        if off + 8 <= len(data):
+            (n_no_coor,) = struct.unpack_from("<Q", data, off)
+        return BaiIndex(refs, n_no_coor)
+
+    # ------------------------------------------------------------------ queries
+    def chunk_starts(self) -> list[Pos]:
+        return sorted(
+            {c.start for ref in self.references for cs in ref.bins.values() for c in cs}
+        )
+
+    def all_addresses(self) -> list[Pos]:
+        out = set()
+        for ref in self.references:
+            for chunks in ref.bins.values():
+                for c in chunks:
+                    out.add(c.start)
+                    out.add(c.end)
+        return sorted(out)
+
+    def query(self, ref_idx: int, start: int, end: int) -> list[Chunk]:
+        """Chunks possibly containing alignments overlapping [start, end)."""
+        if ref_idx >= len(self.references):
+            return []
+        ref = self.references[ref_idx]
+        min_offset = Pos(0, 0)
+        win = start >> LINEAR_INDEX_SHIFT
+        if ref.linear_index and win < len(ref.linear_index):
+            min_offset = Pos.from_htsjdk(ref.linear_index[win])
+        chunks = [
+            c
+            for bin_id in reg2bins(start, end)
+            for c in ref.bins.get(bin_id, ())
+            if (c.end.block_pos, c.end.offset) > (min_offset.block_pos, min_offset.offset)
+        ]
+        return merge_chunks(sorted(chunks, key=lambda c: (c.start, c.end)))
+
+
+def reg2bins(beg: int, end: int) -> list[int]:
+    """All bin ids overlapping [beg, end) in the UCSC binning scheme."""
+    end -= 1
+    bins = [0]
+    for shift, offset in ((26, 1), (23, 9), (20, 73), (17, 585), (14, 4681)):
+        bins.extend(range(offset + (beg >> shift), offset + (end >> shift) + 1))
+    return bins
+
+
+def reg2bin(beg: int, end: int) -> int:
+    """Smallest bin containing [beg, end) (for the BAM writer)."""
+    end -= 1
+    for shift, offset in ((14, 4681), (17, 585), (20, 73), (23, 9), (26, 1)):
+        if beg >> shift == end >> shift:
+            return offset + (beg >> shift)
+    return 0
+
+
+def merge_chunks(chunks: list[Chunk]) -> list[Chunk]:
+    """Coalesce adjacent/overlapping chunks (matches HTSJDK's optimization)."""
+    out: list[Chunk] = []
+    for c in chunks:
+        if out and (c.start.block_pos, c.start.offset) <= (
+            out[-1].end.block_pos,
+            out[-1].end.offset,
+        ):
+            if (c.end.block_pos, c.end.offset) > (out[-1].end.block_pos, out[-1].end.offset):
+                out[-1] = Chunk(out[-1].start, c.end)
+        else:
+            out.append(c)
+    return out

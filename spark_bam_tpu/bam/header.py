@@ -1,0 +1,95 @@
+"""BAM header parsing: magic, SAM text, contig dictionary.
+
+Reference: check/.../bam/header/Header.scala:13-79 (magic check :29, contig
+dict :37-53) and ContigLengths.scala. ``end_pos`` — the virtual position of
+the first alignment record — is the left fence for every seek/scan; record
+iterators clamp to it.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Mapping
+
+from spark_bam_tpu.bgzf.stream import BlockStream, UncompressedBytes
+from spark_bam_tpu.core.channel import ByteChannel, open_channel
+from spark_bam_tpu.core.pos import Pos
+
+
+class ContigLengths(Mapping[int, tuple[str, int]]):
+    """Ordered map: reference index → (contig name, length)."""
+
+    def __init__(self, entries):
+        self._entries: dict[int, tuple[str, int]] = dict(entries)
+
+    def __getitem__(self, idx: int) -> tuple[str, int]:
+        return self._entries[idx]
+
+    def __iter__(self):
+        return iter(self._entries)
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def name(self, idx: int) -> str:
+        return "*" if idx < 0 else self._entries[idx][0]
+
+    def lengths_list(self) -> list[int]:
+        """Lengths in index order (the array shipped to the TPU checker)."""
+        return [self._entries[i][1] for i in range(len(self._entries))]
+
+    def __repr__(self) -> str:
+        items = ", ".join(f"{i}:{n}({l})" for i, (n, l) in self._entries.items())
+        return f"ContigLengths({items})"
+
+    def __eq__(self, other):
+        return isinstance(other, ContigLengths) and self._entries == other._entries
+
+
+@dataclass(frozen=True)
+class BamHeader:
+    contig_lengths: ContigLengths
+    end_pos: Pos            # virtual position of the first alignment record
+    uncompressed_size: int  # uncompressed bytes occupied by the header
+    text: str = ""          # raw SAM-text header
+
+    @property
+    def num_contigs(self) -> int:
+        return len(self.contig_lengths)
+
+
+def parse_header(u: UncompressedBytes, keep_text: bool = True) -> BamHeader:
+    """Parse from an uncompressed-byte stream positioned at 0."""
+    magic = u.read_fully(4)
+    if magic != b"BAM\x01":
+        raise ValueError(f"Not a BAM: bad magic {magic!r}")
+    text_len = u.read_i32()
+    if keep_text:
+        text = u.read_fully(text_len).decode("latin-1").rstrip("\x00")
+    else:
+        u.skip(text_len)
+        text = ""
+    num_refs = u.read_i32()
+    entries = {}
+    for idx in range(num_refs):
+        name_len = u.read_i32()
+        name = u.read_fully(name_len).rstrip(b"\x00").decode("latin-1")
+        length = u.read_i32()
+        entries[idx] = (name, length)
+    end_pos = u.cur_pos()
+    if end_pos is None:
+        # Header-only BAM: first-record position is one past the last byte.
+        end_pos = Pos(0, 0)
+    return BamHeader(ContigLengths(entries), end_pos, u.tell(), text)
+
+
+def read_header(path_or_channel, keep_text: bool = True) -> BamHeader:
+    """Read the header of a BAM file (path or open channel)."""
+    if isinstance(path_or_channel, ByteChannel):
+        return parse_header(UncompressedBytes(BlockStream(path_or_channel)), keep_text)
+    with open_channel(path_or_channel) as ch:
+        return parse_header(UncompressedBytes(BlockStream(ch)), keep_text)
+
+
+def contig_lengths(path) -> ContigLengths:
+    return read_header(path, keep_text=False).contig_lengths

@@ -1,0 +1,113 @@
+"""Record and record-position iterators over a BAM.
+
+Reference: check/.../bam/iterator/{RecordIterator,PosStream,RecordStream,
+SeekableRecordIterator}.scala. ``PosStream`` walks record length-prefixes
+without decoding; ``RecordStream`` fully decodes via our own codec
+(bam/record.py) instead of HTSJDK's BAMRecordCodec. Seekable variants clamp
+seeks to the first-record position (header.end_pos).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+from spark_bam_tpu.bam.header import BamHeader, parse_header
+from spark_bam_tpu.bam.record import BamRecord
+from spark_bam_tpu.bgzf.stream import (
+    BlockStream,
+    SeekableBlockStream,
+    SeekableUncompressedBytes,
+    UncompressedBytes,
+)
+from spark_bam_tpu.core.channel import ByteChannel
+from spark_bam_tpu.core.pos import Pos
+
+
+class _RecordIteratorBase:
+    """Shared: owns the uncompressed stream, parses the header on open."""
+
+    def __init__(self, u: UncompressedBytes, header: Optional[BamHeader] = None):
+        self.u = u
+        if header is None:
+            header = parse_header(u)
+        self.header = header
+
+    def cur_pos(self) -> Optional[Pos]:
+        return self.u.cur_pos()
+
+    def close(self) -> None:
+        self.u.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class PosStream(_RecordIteratorBase):
+    """Yield the virtual position of every record start (no decoding)."""
+
+    def __iter__(self) -> Iterator[Pos]:
+        while True:
+            pos = self.cur_pos()
+            if pos is None:
+                return
+            try:
+                remaining = self.u.read_i32()
+            except EOFError:
+                return
+            self.u.skip(remaining)
+            yield pos
+
+    @staticmethod
+    def open(ch: ByteChannel) -> "PosStream":
+        return PosStream(UncompressedBytes(BlockStream(ch)))
+
+
+class RecordStream(_RecordIteratorBase):
+    """Yield (Pos, BamRecord) pairs."""
+
+    def __iter__(self) -> Iterator[tuple[Pos, BamRecord]]:
+        while True:
+            pos = self.cur_pos()
+            if pos is None:
+                return
+            try:
+                remaining = self.u.read_i32()
+                body = self.u.read_fully(remaining)
+            except EOFError:
+                return
+            rec, _ = BamRecord.decode(
+                remaining.to_bytes(4, "little", signed=True) + body
+            )
+            yield pos, rec
+
+    @staticmethod
+    def open(ch: ByteChannel) -> "RecordStream":
+        return RecordStream(UncompressedBytes(BlockStream(ch)))
+
+
+class _SeekableMixin:
+    u: SeekableUncompressedBytes
+    header: BamHeader
+
+    def seek(self, pos: Pos) -> None:
+        """Seek, clamped so positions inside the header are rounded up to the
+        first record (reference SeekableRecordIterator.scala:183-198)."""
+        end = self.header.end_pos
+        if (pos.block_pos, pos.offset) < (end.block_pos, end.offset):
+            pos = end
+        self.u.seek(pos)
+
+
+class SeekablePosStream(PosStream, _SeekableMixin):
+    @staticmethod
+    def open(ch: ByteChannel) -> "SeekablePosStream":
+        return SeekablePosStream(SeekableUncompressedBytes(SeekableBlockStream(ch)))
+
+
+class SeekableRecordStream(RecordStream, _SeekableMixin):
+    @staticmethod
+    def open(ch: ByteChannel) -> "SeekableRecordStream":
+        return SeekableRecordStream(SeekableUncompressedBytes(SeekableBlockStream(ch)))

@@ -1,0 +1,162 @@
+"""BAM structure layer vs reference golden facts.
+
+Golden record positions/names from reference RecordStreamTest.scala:43-104;
+record counts from docs (2.bam: 2,500 reads; 1.bam: 4,917 reads).
+"""
+
+import itertools
+
+import pytest
+
+from spark_bam_tpu.bam.header import read_header
+from spark_bam_tpu.bam.index_records import index_records, read_records_index
+from spark_bam_tpu.bam.iterators import (
+    PosStream,
+    RecordStream,
+    SeekablePosStream,
+    SeekableRecordStream,
+)
+from spark_bam_tpu.bam.record import BamRecord, parse_sam_line
+from spark_bam_tpu.bam.writer import write_bam
+from spark_bam_tpu.bam.bai import BaiIndex
+from spark_bam_tpu.core.channel import open_channel
+from spark_bam_tpu.core.pos import Pos
+
+GOLDEN_FIRST_RECORDS = [
+    (Pos(0, 5650), 10001, "HWI-ST807:461:C2P0JACXX:4:2115:8592:79724"),
+    (Pos(0, 6274), 10009, "HWI-ST807:461:C2P0JACXX:4:2115:8592:79724"),
+    (Pos(0, 6894), 10048, "HWI-ST807:461:C2P0JACXX:4:1304:9505:89866"),
+    (Pos(0, 7533), 10335, "HWI-ST807:461:C2P0JACXX:4:2311:6431:65669"),
+    (Pos(0, 8170), 10363, "HWI-ST807:461:C2P0JACXX:4:1305:2342:51860"),
+    (Pos(0, 8738), 10363, "HWI-ST807:461:C2P0JACXX:4:1305:2342:51860"),
+    (Pos(0, 9384), 10368, "HWI-ST807:461:C2P0JACXX:4:1304:9505:89866"),
+    (Pos(0, 10018), 10458, "HWI-ST807:461:C2P0JACXX:4:2311:6431:65669"),
+    (Pos(0, 10637), 11648, "HWI-ST807:461:C2P0JACXX:4:1107:13461:64844"),
+    (Pos(0, 11318), 11687, "HWI-ST807:461:C2P0JACXX:4:2203:17157:59976"),
+]
+
+
+def test_header(bam2):
+    header = read_header(bam2)
+    assert header.end_pos == Pos(0, 5650)
+    assert header.num_contigs > 0
+    # 2.bam is a chr1 excerpt; contig 0 is "1".
+    assert header.contig_lengths.name(0) == "1"
+    assert header.text.startswith("@HD")
+
+
+def test_record_stream_golden(bam2):
+    with open_channel(bam2) as ch:
+        rs = RecordStream.open(ch)
+        assert rs.header.end_pos == Pos(0, 5650)
+        for (pos, rec), (gpos, start, name) in zip(rs, GOLDEN_FIRST_RECORDS):
+            assert pos == gpos
+            assert rec.pos + 1 == start  # SAM alignment start is 1-based
+            assert rec.read_name == name
+            assert rec.ref_id == 0
+
+
+def test_record_stream_block_crossing(bam2):
+    with open_channel(bam2) as ch:
+        rs = RecordStream.open(ch)
+        items = list(itertools.islice(rs, 98))
+    # Records 96 and 97 straddle into block 2 (golden from RecordStreamTest).
+    assert items[93][0] == Pos(0, 63908)
+    assert items[93][1].read_name == "HWI-ST807:461:C2P0JACXX:4:1205:8857:43215"
+    assert items[96][0] == Pos(26169, 279)
+    assert items[96][1].read_name == "HWI-ST807:461:C2P0JACXX:4:1313:17039:71392"
+    assert items[97][0] == Pos(26169, 901)
+    assert items[97][1].pos + 1 == 12605
+
+
+def test_seekable_record_stream(bam2):
+    with open_channel(bam2) as ch:
+        rs = SeekableRecordStream.open(ch)
+        rs.seek(Pos(0, 65150))
+        pos, rec = next(iter(rs))
+        assert pos == Pos(0, 65150)
+        assert rec.pos + 1 == 12602
+        # Seeking into the header clamps to the first record.
+        rs.seek(Pos(0, 0))
+        pos, rec = next(iter(rs))
+        assert pos == Pos(0, 5650)
+        assert rec.read_name == GOLDEN_FIRST_RECORDS[0][2]
+
+
+def test_pos_stream_matches_records_sidecar(bam2):
+    golden = read_records_index(str(bam2) + ".records")
+    with open_channel(bam2) as ch:
+        positions = list(PosStream.open(ch))
+    assert len(positions) == 2500  # published 2.bam fact
+    assert positions == golden
+
+
+def test_index_records(bam1, tmp_path):
+    out, count = index_records(bam1, tmp_path / "1.bam.records")
+    assert count == 4917  # published 1.bam fact
+    assert [l.strip() for l in open(out)] == [
+        l.strip() for l in open(str(bam1) + ".records")
+    ]
+
+
+def test_record_roundtrip(bam2):
+    with open_channel(bam2) as ch:
+        rs = RecordStream.open(ch)
+        records = [rec for _, rec in itertools.islice(rs, 50)]
+    for rec in records:
+        encoded = rec.encode()
+        decoded, consumed = BamRecord.decode(encoded)
+        assert consumed == len(encoded)
+        assert decoded == rec
+
+
+def test_sam_rendering_against_sam_file(bam2, sam2):
+    header = read_header(bam2)
+    contigs_by_name = {
+        name: idx for idx, (name, _) in header.contig_lengths.items()
+    }
+    sam_lines = [
+        l for l in open(sam2).read().splitlines() if not l.startswith("@")
+    ]
+    with open_channel(bam2) as ch:
+        rs = RecordStream.open(ch)
+        bam_recs = [rec for _, rec in rs]
+    assert len(bam_recs) == len(sam_lines)
+    for rec, line in zip(bam_recs[:200], sam_lines[:200]):
+        parsed = parse_sam_line(line, contigs_by_name)
+        assert rec.read_name == parsed.read_name
+        assert rec.flag == parsed.flag
+        assert rec.pos == parsed.pos
+        assert rec.cigar == parsed.cigar
+        assert rec.seq == parsed.seq
+        assert rec.qual == parsed.qual
+
+
+def test_writer_roundtrip(bam2, tmp_path):
+    with open_channel(bam2) as ch:
+        rs = RecordStream.open(ch)
+        header = rs.header
+        records = [rec for _, rec in itertools.islice(rs, 500)]
+    out = tmp_path / "roundtrip.bam"
+    # Small payloads force records to straddle block boundaries.
+    n = write_bam(out, header, records, block_payload=5000)
+    assert n == 500
+    header2 = read_header(out)
+    assert header2.contig_lengths == header.contig_lengths
+    with open_channel(out) as ch:
+        rs2 = RecordStream.open(ch)
+        records2 = [rec for _, rec in rs2]
+    assert records2 == records
+
+
+def test_bai_query(bam2):
+    bai = BaiIndex.read(str(bam2) + ".bai")
+    assert len(bai.references) >= 1
+    chunks = bai.query(0, 0, 100_000_000)
+    assert chunks, "whole-contig query must return chunks"
+    # All reads of 2.bam live in one contig; chunks must cover the first record.
+    first = chunks[0]
+    assert first.start == Pos(0, 5650)
+    # A query outside any read positions returns nothing or chunks filtered by
+    # the linear index.
+    assert bai.query(5, 0, 1000) == []
