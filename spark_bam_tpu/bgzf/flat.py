@@ -1,0 +1,108 @@
+"""Flat uncompressed views of BGZF files.
+
+The vectorized checkers operate on *flat buffers*: the concatenated
+uncompressed payloads of a run of blocks, plus the block table needed to map
+``Pos(block, offset) ↔ flat index``. This replaces the reference's per-byte
+``UncompressedBytes`` iterators for all bulk work (SURVEY.md §7 step 4a:
+"inflate on host, ship uncompressed blocks to HBM").
+
+Inflation fans out across threads: zlib releases the GIL, so a thread pool
+saturates host cores (the Pallas in-device inflate is the planned upgrade,
+tpu/inflate.py).
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass
+
+import numpy as np
+
+from spark_bam_tpu.bgzf.block import Metadata, FOOTER_SIZE
+from spark_bam_tpu.bgzf.header import Header
+from spark_bam_tpu.bgzf.stream import MetadataStream, inflate_block_payload
+from spark_bam_tpu.core.channel import ByteChannel, MMapChannel, open_channel
+
+
+@dataclass
+class FlatView:
+    """Uncompressed bytes of blocks[first:last] of a file, flat-addressable."""
+
+    data: np.ndarray          # uint8, concatenated uncompressed payloads
+    block_starts: np.ndarray  # int64, compressed-file offset per block
+    block_flat: np.ndarray    # int64, flat offset of each block's first byte
+    file_total: int | None    # total flat size of the *whole* file, if known
+    at_eof: bool = False      # view ends exactly at the file's uncompressed end
+
+    @property
+    def size(self) -> int:
+        return int(self.data.shape[0])
+
+    def flat_of_pos(self, block_pos: int, offset: int) -> int:
+        i = int(np.searchsorted(self.block_starts, block_pos))
+        if i >= len(self.block_starts) or self.block_starts[i] != block_pos:
+            raise KeyError(f"block {block_pos} not in view")
+        return int(self.block_flat[i]) + offset
+
+    def pos_of_flat(self, flat: int) -> tuple[int, int]:
+        i = int(np.searchsorted(self.block_flat, flat, side="right")) - 1
+        return int(self.block_starts[i]), int(flat - self.block_flat[i])
+
+    def pos_of_flat_many(self, flat: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        idx = np.searchsorted(self.block_flat, flat, side="right") - 1
+        return self.block_starts[idx], flat - self.block_flat[idx]
+
+
+def _inflate_one(ch: ByteChannel, meta: Metadata, out: np.ndarray, flat_off: int):
+    if isinstance(ch, MMapChannel):
+        comp = ch.memoryview(meta.start, meta.compressed_size)
+    else:
+        ch.seek(meta.start)
+        comp = ch.read_fully(meta.compressed_size)
+    header = Header.parse(comp[:18])
+    payload = comp[header.size: meta.compressed_size - FOOTER_SIZE]
+    data = inflate_block_payload(payload, meta.uncompressed_size)
+    out[flat_off: flat_off + len(data)] = np.frombuffer(data, dtype=np.uint8)
+
+
+def inflate_blocks(
+    ch: ByteChannel,
+    metas: list[Metadata],
+    file_total: int | None = None,
+    at_eof: bool = False,
+    threads: int = 8,
+) -> FlatView:
+    """Inflate a run of blocks into one flat buffer (parallel zlib)."""
+    usizes = np.array([m.uncompressed_size for m in metas], dtype=np.int64)
+    block_flat = np.zeros(len(metas), dtype=np.int64)
+    if len(metas):
+        np.cumsum(usizes[:-1], out=block_flat[1:])
+    total = int(usizes.sum())
+    out = np.empty(total, dtype=np.uint8)
+    if len(metas) > 1 and threads > 1:
+        with ThreadPoolExecutor(max_workers=threads) as pool:
+            list(
+                pool.map(
+                    lambda im: _inflate_one(ch, im[1], out, int(block_flat[im[0]])),
+                    enumerate(metas),
+                )
+            )
+    else:
+        for i, m in enumerate(metas):
+            _inflate_one(ch, m, out, int(block_flat[i]))
+    return FlatView(
+        out,
+        np.array([m.start for m in metas], dtype=np.int64),
+        block_flat,
+        file_total,
+        at_eof or (file_total is not None and total == file_total),
+    )
+
+
+def flatten_file(path, threads: int = 8) -> FlatView:
+    """Inflate an entire BAM into one flat buffer (fixtures / small files)."""
+    with open_channel(path) as ch:
+        metas = list(MetadataStream(ch))
+    with open_channel(path) as ch:
+        total = sum(m.uncompressed_size for m in metas)
+        return inflate_blocks(ch, metas, file_total=total, at_eof=True, threads=threads)

@@ -1,0 +1,27 @@
+from spark_bam_tpu.check.flags import Flags, Success, FLAG_NAMES
+from spark_bam_tpu.check.checker import (
+    ALLOWED_NAME_CHAR_MIN,
+    ALLOWED_NAME_CHAR_MAX,
+    EXCLUDED_NAME_CHAR,
+    FIXED_FIELDS_SIZE,
+    MAX_CIGAR_OP,
+    make_checker,
+)
+from spark_bam_tpu.check.eager import EagerChecker
+from spark_bam_tpu.check.full import FullChecker
+from spark_bam_tpu.check.indexed import IndexedChecker
+
+__all__ = [
+    "Flags",
+    "Success",
+    "FLAG_NAMES",
+    "FIXED_FIELDS_SIZE",
+    "MAX_CIGAR_OP",
+    "ALLOWED_NAME_CHAR_MIN",
+    "ALLOWED_NAME_CHAR_MAX",
+    "EXCLUDED_NAME_CHAR",
+    "EagerChecker",
+    "FullChecker",
+    "IndexedChecker",
+    "make_checker",
+]

@@ -1,0 +1,57 @@
+"""Checker plugin surface.
+
+Reference: check/.../bam/check/Checker.scala:7-28 — a ``Checker[Call]`` is
+``Pos → Call`` plus shared structural constants; ``MakeChecker`` builds one
+per file handle. Here the plugin registry keys the ``spark.bam.checker``
+config knob: ``eager`` / ``full`` / ``indexed`` / ``seqdoop`` (oracles), with
+the vectorized engines (numpy/tpu) behind ``spark.bam.backend``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Protocol
+
+from spark_bam_tpu.core.pos import Pos
+
+FIXED_FIELDS_SIZE = 36  # 9 × i32 at the start of every BAM record
+MAX_CIGAR_OP = 8
+
+# Read-name alphabet: '!'..'?' ++ 'A'..'~'  — printable ASCII minus '@'
+# (reference Checker.scala:12-17).
+ALLOWED_NAME_CHAR_MIN = 0x21  # '!'
+ALLOWED_NAME_CHAR_MAX = 0x7E  # '~'
+EXCLUDED_NAME_CHAR = 0x40     # '@'
+
+
+def name_char_allowed(b: int) -> bool:
+    return ALLOWED_NAME_CHAR_MIN <= b <= ALLOWED_NAME_CHAR_MAX and b != EXCLUDED_NAME_CHAR
+
+
+class Checker(Protocol):
+    def __call__(self, pos: Pos): ...
+
+
+_REGISTRY: dict[str, Callable] = {}
+
+
+def register_checker(name: str):
+    def deco(factory):
+        _REGISTRY[name] = factory
+        return factory
+    return deco
+
+
+def make_checker(name: str, path, config=None, **kw) -> Checker:
+    """Build a checker by plugin name for a BAM path.
+
+    Factories accept (path, config, **kw) and return a ``Pos → call`` object
+    with a ``next_read_start(pos)`` method where applicable.
+    """
+    # Import for registration side effects.
+    from spark_bam_tpu.check import eager, full, indexed, seqdoop  # noqa: F401
+
+    if name not in _REGISTRY:
+        raise KeyError(f"Unknown checker {name!r}; have {sorted(_REGISTRY)}")
+    from spark_bam_tpu.core.config import default_config
+
+    return _REGISTRY[name](path, config or default_config(), **kw)

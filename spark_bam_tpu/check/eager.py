@@ -1,0 +1,177 @@
+"""CPU ``eager`` checker: the sequential semantics oracle.
+
+Boolean verdict per candidate Pos; short-circuits on the first failing check
+and chains ``reads_to_check`` consecutive records
+(reference check/.../bam/check/eager/Checker.scala:18-177). The TPU and NumPy
+engines (tpu/checker.py, check/vectorized.py) are differentially tested
+against this at every position of the fixtures.
+
+Semantics pinned here (each is a golden-test subject):
+- name length is ``i32 & 0xff`` (only the low byte)           — ref :52
+- EOF with *zero* bytes at the record edge after ≥1 success ⇒ valid — ref :36-39
+- contig-length bound is strict ``>`` (equal is allowed)      — ref PosChecker.scala:59
+- logical/physical cursor divergence after a negative seq-len record is
+  preserved: the recursion trusts ``nextOffset`` while reads continue from
+  the physical cursor                                          — ref :116-125
+"""
+
+from __future__ import annotations
+
+import struct
+
+from spark_bam_tpu.bam.header import ContigLengths, contig_lengths as read_contig_lengths
+from spark_bam_tpu.bgzf.stream import SeekableBlockStream, SeekableUncompressedBytes
+from spark_bam_tpu.check.checker import name_char_allowed, register_checker
+from spark_bam_tpu.core.channel import open_channel
+from spark_bam_tpu.core.pos import Pos
+
+
+class EagerChecker:
+    def __init__(
+        self,
+        u: SeekableUncompressedBytes,
+        contigs: ContigLengths,
+        reads_to_check: int = 10,
+    ):
+        self.u = u
+        self.contigs = contigs
+        self.num_contigs = len(contigs)
+        self.lengths = contigs.lengths_list()
+        self.reads_to_check = reads_to_check
+
+    @staticmethod
+    def open(path, config=None) -> "EagerChecker":
+        from spark_bam_tpu.core.config import default_config
+
+        config = config or default_config()
+        ch = open_channel(path)
+        return EagerChecker(
+            SeekableUncompressedBytes(SeekableBlockStream(ch)),
+            read_contig_lengths(path),
+            config.reads_to_check,
+        )
+
+    def __call__(self, pos: Pos) -> bool:
+        self.u.seek(pos)
+        return self._apply(self.u.tell(), 0)
+
+    def _ref_pos_error(self, ref_idx: int, ref_pos: int) -> bool:
+        if ref_idx < -1:
+            return True
+        if ref_idx >= self.num_contigs:
+            return True
+        if ref_pos < -1:
+            return True
+        if ref_idx >= 0 and ref_pos > self.lengths[ref_idx]:
+            return True
+        return False
+
+    def _apply(self, start: int, successes: int) -> bool:
+        u = self.u
+        if successes == self.reads_to_check:
+            return True
+
+        fixed = u.read(36)
+        if len(fixed) < 36:
+            # Zero bytes at exactly the expected record edge, with ≥1 chained
+            # success, is a valid EOF (ref :36-39); anything else fails.
+            return len(fixed) == 0 and u.tell() - len(fixed) == start and successes > 0
+
+        (
+            remaining,
+            ref_idx,
+            ref_pos,
+            name_len_i32,
+            flags_n_cigar,
+            seq_len,
+            next_ref_idx,
+            next_ref_pos,
+            _tlen,
+        ) = struct.unpack("<9i", fixed)
+
+        next_offset = start + 4 + remaining
+
+        if self._ref_pos_error(ref_idx, ref_pos):
+            return False
+
+        name_len = name_len_i32 & 0xFF
+        if name_len in (0, 1):
+            return False
+
+        flags = (flags_n_cigar >> 16) & 0xFFFF
+        n_cigar = flags_n_cigar & 0xFFFF
+        n_cigar_bytes = 4 * n_cigar
+
+        if (flags & 4) == 0 and (seq_len == 0 or n_cigar == 0):
+            return False
+
+        # int32-wrapping arithmetic with truncating division, as on the JVM.
+        t = _wrap32(seq_len + 1)
+        n_seq_qual = _wrap32(_trunc_div2(t) + seq_len)
+        if remaining < _wrap32(32 + name_len + n_cigar_bytes + n_seq_qual):
+            return False
+
+        if self._ref_pos_error(next_ref_idx, next_ref_pos):
+            return False
+
+        name = u.read(name_len)
+        if len(name) < name_len:
+            return False
+        if name[-1] != 0:
+            return False
+        if any(not name_char_allowed(b) for b in name[:-1]):
+            return False
+
+        cigar = u.read(n_cigar_bytes)
+        if len(cigar) < n_cigar_bytes:
+            return False
+        for k in range(n_cigar):
+            if cigar[4 * k] & 0xF > 8:
+                return False
+
+        bytes_to_skip = next_offset - u.tell()
+        if bytes_to_skip > 0:
+            u.skip(bytes_to_skip)
+
+        return self._apply(next_offset, successes + 1)
+
+    # ------------------------------------------------------------ read scan
+    def next_read_start_with_delta(
+        self, start: Pos, max_read_size: int = 10_000_000
+    ) -> tuple[Pos, int] | None:
+        """Advance byte-by-byte until a position passes (ref :128-162)."""
+        u = self.u
+        u.seek(start)
+        for idx in range(max_read_size):
+            pos = u.cur_pos()
+            if pos is None:
+                return None
+            if self(pos):
+                return pos, idx
+            u.seek(pos)
+            if not u.has_next():
+                return None
+            u.next_byte()
+        return None
+
+    def next_read_start(self, start: Pos, max_read_size: int = 10_000_000) -> Pos | None:
+        found = self.next_read_start_with_delta(start, max_read_size)
+        return found[0] if found else None
+
+    def close(self) -> None:
+        self.u.close()
+
+
+def _wrap32(x: int) -> int:
+    x &= 0xFFFFFFFF
+    return x - 0x100000000 if x >= 0x80000000 else x
+
+
+def _trunc_div2(x: int) -> int:
+    """JVM-style Int division by 2 (truncates toward zero)."""
+    return -((-x) // 2) if x < 0 else x // 2
+
+
+@register_checker("eager")
+def _make_eager(path, config, **kw):
+    return EagerChecker.open(path, config)

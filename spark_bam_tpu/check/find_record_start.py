@@ -1,0 +1,80 @@
+"""Split-point resolution: first record boundary at/after a block start.
+
+Reference check/.../bam/spark/FindRecordStart.scala:9-71 — scan byte-by-byte
+with an eager checker until a position passes; ``NoReadFoundException`` after
+``max_read_size`` attempts. Two engines:
+
+- ``find_record_start``       — sequential oracle scan
+- ``find_record_starts_flat`` — vectorized: one chain-walk over a flat view
+  resolves *all* queried block starts at once (this is what the split
+  planner batches onto TPU)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from spark_bam_tpu.bgzf.flat import FlatView
+from spark_bam_tpu.check.eager import EagerChecker
+from spark_bam_tpu.check.vectorized import check_flat
+from spark_bam_tpu.core.pos import Pos
+
+
+class NoReadFoundException(Exception):
+    def __init__(self, path, start: int, max_read_size: int):
+        super().__init__(
+            f"Failed to find a valid read-start in {max_read_size} attempts"
+            f" in {path} from {start}"
+        )
+        self.path = path
+        self.start = start
+        self.max_read_size = max_read_size
+
+
+def find_record_start(
+    checker: EagerChecker,
+    block_start: int,
+    max_read_size: int = 10_000_000,
+    path: str = "<channel>",
+) -> Pos:
+    found = checker.next_read_start(Pos(block_start, 0), max_read_size)
+    if found is None:
+        raise NoReadFoundException(path, block_start, max_read_size)
+    return found
+
+
+def find_record_starts_flat(
+    view: FlatView,
+    contig_lengths: np.ndarray,
+    block_starts: list[int] | None = None,
+    max_read_size: int = 10_000_000,
+    reads_to_check: int = 10,
+) -> dict[int, Pos | None]:
+    """First record boundary at/after each block start, via one vectorized pass.
+
+    Checks every position of the view in one flag pass + chain walk, then for
+    each queried block start takes the first true verdict within
+    ``max_read_size`` bytes. ``None`` marks block starts whose scan budget ran
+    out inside the view; starts whose answer could lie beyond the view (not
+    ``at_eof`` and budget crosses the end) are absent from the result.
+    """
+    if block_starts is None:
+        block_starts = [int(s) for s in view.block_starts]
+    result = check_flat(
+        view.data, contig_lengths, at_eof=view.at_eof, reads_to_check=reads_to_check
+    )
+    verdict = result.verdict & result.exact
+    true_flat = np.flatnonzero(verdict)
+    out: dict[int, Pos | None] = {}
+    for start in block_starts:
+        flat = view.flat_of_pos(start, 0)
+        j = int(np.searchsorted(true_flat, flat))
+        if j < len(true_flat) and true_flat[j] - flat < max_read_size:
+            block, off = view.pos_of_flat(int(true_flat[j]))
+            out[start] = Pos(block, off)
+        else:
+            budget_end = flat + max_read_size
+            if view.at_eof or budget_end <= view.size:
+                out[start] = None  # budget definitively exhausted
+            # else: unresolvable within this window — caller widens the view
+    return out

@@ -1,0 +1,134 @@
+"""The 19-check error model.
+
+Reference: check/.../bam/check/full/error/{Error,Flags,RefPosError,
+ReadNameError,CigarOpsError}.scala. Flag order (= bit index) follows the
+reference's BitSet serialization (Flags.scala:201-223) so masks interchange.
+The same bitmask encoding is what the vectorized engines (NumPy/JAX) emit —
+``Flags.from_mask`` decodes a device result into the rich form.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, fields
+
+FLAG_NAMES = (
+    "tooFewFixedBlockBytes",        # bit 0
+    "negativeReadIdx",              # bit 1
+    "tooLargeReadIdx",              # bit 2
+    "negativeReadPos",              # bit 3
+    "tooLargeReadPos",              # bit 4
+    "negativeNextReadIdx",          # bit 5
+    "tooLargeNextReadIdx",          # bit 6
+    "negativeNextReadPos",          # bit 7
+    "tooLargeNextReadPos",          # bit 8
+    "tooFewBytesForReadName",       # bit 9
+    "nonNullTerminatedReadName",    # bit 10
+    "nonASCIIReadName",             # bit 11
+    "noReadName",                   # bit 12
+    "emptyReadName",                # bit 13
+    "tooFewBytesForCigarOps",       # bit 14
+    "invalidCigarOp",               # bit 15
+    "emptyMappedCigar",             # bit 16
+    "emptyMappedSeq",               # bit 17
+    "tooFewRemainingBytesImplied",  # bit 18
+)
+
+BIT = {name: 1 << i for i, name in enumerate(FLAG_NAMES)}
+
+
+@dataclass(frozen=True)
+class Success:
+    """A position that chained ``reads_parsed`` valid records (or hit EOF)."""
+    reads_parsed: int
+
+    @property
+    def call(self) -> bool:
+        return True
+
+
+@dataclass(frozen=True)
+class Flags:
+    tooFewFixedBlockBytes: bool = False
+    negativeReadIdx: bool = False
+    tooLargeReadIdx: bool = False
+    negativeReadPos: bool = False
+    tooLargeReadPos: bool = False
+    negativeNextReadIdx: bool = False
+    tooLargeNextReadIdx: bool = False
+    negativeNextReadPos: bool = False
+    tooLargeNextReadPos: bool = False
+    tooFewBytesForReadName: bool = False
+    nonNullTerminatedReadName: bool = False
+    nonASCIIReadName: bool = False
+    noReadName: bool = False
+    emptyReadName: bool = False
+    tooFewBytesForCigarOps: bool = False
+    invalidCigarOp: bool = False
+    emptyMappedCigar: bool = False
+    emptyMappedSeq: bool = False
+    tooFewRemainingBytesImplied: bool = False
+    readsBeforeError: int = 0
+
+    @property
+    def call(self) -> bool:
+        return False
+
+    def to_mask(self) -> int:
+        mask = 0
+        for i, name in enumerate(FLAG_NAMES):
+            if getattr(self, name):
+                mask |= 1 << i
+        return mask
+
+    @staticmethod
+    def from_mask(mask: int, reads_before_error: int = 0) -> "Flags":
+        return Flags(
+            **{name: bool(mask & (1 << i)) for i, name in enumerate(FLAG_NAMES)},
+            readsBeforeError=reads_before_error,
+        )
+
+    def true_flags(self) -> list[str]:
+        return [name for name in FLAG_NAMES if getattr(self, name)]
+
+    def num_checks_failed(self) -> int:
+        """Failing checks + (readsBeforeError>0), the reference's
+        numNonZeroFields (Flags.scala:118-124)."""
+        return len(self.true_flags()) + (1 if self.readsBeforeError > 0 else 0)
+
+    def __str__(self) -> str:
+        return ",".join(self.true_flags())
+
+
+def flags_fields() -> list[str]:
+    return [f.name for f in fields(Flags)]
+
+
+class Counts(dict):
+    """Per-flag Long counters, summable (reference error/Counts.scala)."""
+
+    def __init__(self):
+        super().__init__({name: 0 for name in FLAG_NAMES})
+
+    def add(self, flags: Flags) -> None:
+        for name in FLAG_NAMES:
+            if getattr(flags, name):
+                self[name] += 1
+
+    def add_mask_counts(self, mask_counts: dict[int, int]) -> None:
+        """Accumulate from a histogram of flag masks (vectorized results)."""
+        for mask, count in mask_counts.items():
+            for i, name in enumerate(FLAG_NAMES):
+                if mask & (1 << i):
+                    self[name] += count
+
+    def merge(self, other: "Counts") -> "Counts":
+        for name in FLAG_NAMES:
+            self[name] += other[name]
+        return self
+
+    def show(self, indent: str = "\t") -> str:
+        width = max(len(str(v)) for v in self.values())
+        return "\n".join(
+            f"{indent}{str(self[name]).rjust(width)}:\t{name}"
+            for name in sorted(FLAG_NAMES, key=lambda n: -self[n])
+        )

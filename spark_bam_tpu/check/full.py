@@ -1,0 +1,165 @@
+"""CPU ``full`` checker: runs *all* checks, returns every failing flag.
+
+Reference check/.../bam/check/full/Checker.scala:17-198. Differences from
+``eager`` are diagnostic, not semantic: it never short-circuits inside a
+record, so the returned ``Flags`` captures every failing condition of the
+first bad record (with ``readsBeforeError`` = chained successes before it).
+
+Order quirks preserved (each affects emitted flags, not the verdict):
+- name-length 0/1 produce noReadName/emptyReadName and *no name bytes are
+  consumed*, so the cigar scan reads from fixed-fields end  — ref :81-86,111
+- a name read hitting EOF emits tooFewBytesForReadName and suppresses all
+  cigar flags (exception path)                               — ref :140-144
+- invalidCigarOp suppresses emptyMapped flags               — ref :113-132
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Union
+
+from spark_bam_tpu.bam.header import ContigLengths, contig_lengths as read_contig_lengths
+from spark_bam_tpu.bgzf.stream import SeekableBlockStream, SeekableUncompressedBytes
+from spark_bam_tpu.check.checker import name_char_allowed, register_checker
+from spark_bam_tpu.check.eager import _trunc_div2, _wrap32
+from spark_bam_tpu.check.flags import Flags, Success
+from spark_bam_tpu.core.channel import open_channel
+from spark_bam_tpu.core.pos import Pos
+
+Result = Union[Success, Flags]
+
+
+class FullChecker:
+    def __init__(
+        self,
+        u: SeekableUncompressedBytes,
+        contigs: ContigLengths,
+        reads_to_check: int = 10,
+    ):
+        self.u = u
+        self.num_contigs = len(contigs)
+        self.lengths = contigs.lengths_list()
+        self.reads_to_check = reads_to_check
+
+    @staticmethod
+    def open(path, config=None) -> "FullChecker":
+        from spark_bam_tpu.core.config import default_config
+
+        config = config or default_config()
+        ch = open_channel(path)
+        return FullChecker(
+            SeekableUncompressedBytes(SeekableBlockStream(ch)),
+            read_contig_lengths(path),
+            config.reads_to_check,
+        )
+
+    def __call__(self, pos: Pos) -> Result:
+        self.u.seek(pos)
+        return self._apply(self.u.tell(), 0)
+
+    def _ref_pos_flags(self, ref_idx: int, ref_pos: int, next_: bool) -> dict:
+        neg_idx = too_large_idx = neg_pos = too_large_pos = False
+        if ref_idx < -1:
+            neg_idx = True
+            neg_pos = ref_pos < -1
+        elif ref_idx >= self.num_contigs:
+            too_large_idx = True
+            neg_pos = ref_pos < -1
+        elif ref_pos < -1:
+            neg_pos = True
+        elif ref_idx >= 0 and ref_pos > self.lengths[ref_idx]:
+            too_large_pos = True
+        prefix = "negativeNextRead" if next_ else "negativeRead"
+        tprefix = "tooLargeNextRead" if next_ else "tooLargeRead"
+        return {
+            f"{prefix}Idx": neg_idx,
+            f"{tprefix}Idx": too_large_idx,
+            f"{prefix}Pos": neg_pos,
+            f"{tprefix}Pos": too_large_pos,
+        }
+
+    def _apply(self, start: int, successes: int) -> Result:
+        u = self.u
+        if successes == self.reads_to_check:
+            return Success(self.reads_to_check)
+
+        fixed = u.read(36)
+        if len(fixed) < 36:
+            if len(fixed) == 0 and u.tell() == start and successes > 0:
+                return Success(successes)
+            return Flags(tooFewFixedBlockBytes=True, readsBeforeError=successes)
+
+        (
+            remaining,
+            ref_idx,
+            ref_pos,
+            name_len_i32,
+            flags_n_cigar,
+            seq_len,
+            next_ref_idx,
+            next_ref_pos,
+            _tlen,
+        ) = struct.unpack("<9i", fixed)
+
+        next_offset = start + 4 + remaining
+        kw = self._ref_pos_flags(ref_idx, ref_pos, next_=False)
+        kw.update(self._ref_pos_flags(next_ref_idx, next_ref_pos, next_=True))
+
+        name_len = name_len_i32 & 0xFF
+        flags = (flags_n_cigar >> 16) & 0xFFFF
+        n_cigar = flags_n_cigar & 0xFFFF
+        n_cigar_bytes = 4 * n_cigar
+
+        t = _wrap32(seq_len + 1)
+        n_seq_qual = _wrap32(_trunc_div2(t) + seq_len)
+        kw["tooFewRemainingBytesImplied"] = remaining < _wrap32(
+            32 + name_len + n_cigar_bytes + n_seq_qual
+        )
+
+        # --- read name (lengths 0/1 consume nothing; ref :81-86) ---
+        name_failed_eof = False
+        if name_len == 0:
+            kw["noReadName"] = True
+        elif name_len == 1:
+            kw["emptyReadName"] = True
+        else:
+            name = u.read(name_len)
+            if len(name) < name_len:
+                kw["tooFewBytesForReadName"] = True
+                name_failed_eof = True
+            elif name[-1] != 0:
+                kw["nonNullTerminatedReadName"] = True
+            elif any(not name_char_allowed(b) for b in name[:-1]):
+                kw["nonASCIIReadName"] = True
+
+        # --- cigar (skipped entirely when the name read EOF'd; ref :140-144) ---
+        if not name_failed_eof:
+            cigar = u.read(n_cigar_bytes)
+            # Sequential-read order: a bad op among the readable ints wins
+            # over the EOF that a later int would have hit (ref :113-119).
+            bad_op = any(
+                cigar[4 * k] & 0xF > 8 for k in range(len(cigar) // 4)
+            )
+            if bad_op:
+                kw["invalidCigarOp"] = True
+            elif len(cigar) < n_cigar_bytes:
+                kw["tooFewBytesForCigarOps"] = True
+            elif (flags & 4) == 0 and (seq_len == 0 or n_cigar == 0):
+                kw["emptyMappedSeq"] = seq_len == 0
+                kw["emptyMappedCigar"] = n_cigar == 0
+
+        if any(kw.values()):
+            return Flags(**kw, readsBeforeError=successes)
+
+        bytes_to_skip = next_offset - u.tell()
+        if bytes_to_skip > 0:
+            u.skip(bytes_to_skip)
+        return self._apply(next_offset, successes + 1)
+
+    def close(self) -> None:
+        self.u.close()
+
+
+@register_checker("full")
+def _make_full(path, config, **kw):
+    return FullChecker.open(path, config)

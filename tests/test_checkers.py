@@ -1,0 +1,165 @@
+"""Checker semantics: oracles vs reference golden facts, and the vectorized
+engine differentially against both the oracle and the .records ground truth
+at every position of the fixtures."""
+
+import numpy as np
+import pytest
+
+from spark_bam_tpu.bam.header import contig_lengths, read_header
+from spark_bam_tpu.bam.index_records import read_records_index
+from spark_bam_tpu.bgzf.flat import flatten_file
+from spark_bam_tpu.check.eager import EagerChecker
+from spark_bam_tpu.check.find_record_start import (
+    find_record_start,
+    find_record_starts_flat,
+)
+from spark_bam_tpu.check.flags import Flags, Success
+from spark_bam_tpu.check.full import FullChecker
+from spark_bam_tpu.check.indexed import IndexedChecker
+from spark_bam_tpu.check.vectorized import check_flat
+from spark_bam_tpu.core.pos import Pos
+
+
+@pytest.fixture(scope="module")
+def flat2(bam2):
+    return flatten_file(bam2)
+
+
+@pytest.fixture(scope="module")
+def lengths2(bam2):
+    return np.array(contig_lengths(bam2).lengths_list(), dtype=np.int32)
+
+
+# ---------------------------------------------------------------- oracles
+def test_full_checker_golden(bam2):
+    checker = FullChecker.open(bam2)
+    # True positive deep in the file (reference full/CheckerTest.scala:38-44).
+    assert checker(Pos(439897, 52186)) == Success(10)
+    # Two checks fail inside the header (:46-60).
+    assert checker(Pos(0, 5649)) == Flags(
+        noReadName=True, invalidCigarOp=True, readsBeforeError=0
+    )
+    # EOF (:62-72).
+    assert checker(Pos(1006167, 15243)) == Flags(
+        tooFewFixedBlockBytes=True, readsBeforeError=0
+    )
+    checker.close()
+
+
+def test_eager_checker_golden(bam2):
+    checker = EagerChecker.open(bam2)
+    assert checker(Pos(439897, 52186)) is True
+    assert checker(Pos(0, 5649)) is False
+    assert checker(Pos(0, 5650)) is True  # first record
+    checker.close()
+
+
+def test_find_record_start(bam1):
+    checker = EagerChecker.open(bam1)
+    # Reference FindRecordStartTest.scala:52-62.
+    assert find_record_start(checker, 239479) == Pos(239479, 312)
+    checker.close()
+
+
+def test_eager_rejects_known_seqdoop_fp(bam1):
+    # Pos(239479, 311) is the TCGA-derived hadoop-bam false positive that
+    # motivated the reference (seqdoop CheckerTest.scala:175-177).
+    checker = EagerChecker.open(bam1)
+    assert checker(Pos(239479, 311)) is False
+    assert checker(Pos(239479, 312)) is True
+    checker.close()
+
+
+def test_indexed_checker(bam2):
+    idx = IndexedChecker.open(bam2)
+    assert idx(Pos(0, 5650)) is True
+    assert idx(Pos(0, 5649)) is False
+    assert idx.next_read_start(Pos(0, 0)) == Pos(0, 5650)
+    assert idx.next_read_start(Pos(0, 5651)) == Pos(0, 6274)
+
+
+# ---------------------------------------------------- vectorized vs truth
+def test_vectorized_matches_records_index_2bam(bam2, flat2, lengths2):
+    result = check_flat(flat2.data, lengths2, at_eof=True)
+    assert flat2.size == 1_606_522  # published uncompressed-position count
+    records = read_records_index(str(bam2) + ".records")
+    truth = np.zeros(flat2.size, dtype=bool)
+    for pos in records:
+        truth[flat2.flat_of_pos(pos.block_pos, pos.offset)] = True
+    # eager has no known false calls on the fixtures: exact agreement.
+    np.testing.assert_array_equal(result.verdict, truth)
+    assert result.exact.all()
+
+
+def test_vectorized_matches_records_index_1bam(bam1):
+    flat = flatten_file(bam1)
+    lens = np.array(contig_lengths(bam1).lengths_list(), dtype=np.int32)
+    result = check_flat(flat.data, lens, at_eof=True)
+    assert flat.size == 1_608_257  # published fact
+    records = read_records_index(str(bam1) + ".records")
+    truth = np.zeros(flat.size, dtype=bool)
+    for pos in records:
+        truth[flat.flat_of_pos(pos.block_pos, pos.offset)] = True
+    np.testing.assert_array_equal(result.verdict, truth)
+
+
+def test_vectorized_differential_vs_oracle(bam2, flat2, lengths2):
+    """Byte-exact agreement with the sequential oracles — verdicts AND flags."""
+    result = check_flat(flat2.data, lengths2, at_eof=True)
+    eager = EagerChecker.open(bam2)
+    full = FullChecker.open(bam2)
+
+    rng = np.random.default_rng(0)
+    sample = set(rng.integers(0, flat2.size, 300).tolist())
+    # All positions of the first 2,000 bytes, a block boundary neighborhood,
+    # the EOF neighborhood, and all record starts in the sample region.
+    sample.update(range(2000))
+    sample.update(range(65400, 65700))
+    sample.update(range(flat2.size - 200, flat2.size))
+
+    for flat_idx in sorted(sample):
+        block, off = flat2.pos_of_flat(flat_idx)
+        pos = Pos(block, off)
+        expected = eager(pos)
+        assert result.verdict[flat_idx] == expected, f"verdict mismatch at {pos}"
+        fres = full(pos)
+        if isinstance(fres, Success):
+            assert result.verdict[flat_idx]
+            assert result.reads_parsed[flat_idx] == fres.reads_parsed
+        else:
+            assert not result.verdict[flat_idx]
+            assert result.fail_mask[flat_idx] == fres.to_mask(), (
+                f"flags mismatch at {pos}: "
+                f"{Flags.from_mask(int(result.fail_mask[flat_idx]))} vs {fres}"
+            )
+            assert result.reads_before[flat_idx] == fres.readsBeforeError
+    eager.close()
+    full.close()
+
+
+def test_windowed_mode_escapes_and_agrees(bam2, flat2, lengths2):
+    """A window covering a prefix of the file: verdicts must agree with the
+    whole-file run wherever the window claims exactness."""
+    full_run = check_flat(flat2.data, lengths2, at_eof=True)
+    w = 200_000
+    window = check_flat(flat2.data[:w], lengths2, at_eof=False)
+    resolved = ~window.escaped
+    np.testing.assert_array_equal(
+        window.verdict[resolved], full_run.verdict[:w][resolved]
+    )
+    # Escapes exist only near the window end (within max record-chain reach).
+    esc_idx = np.flatnonzero(window.escaped)
+    assert len(esc_idx) > 0 and esc_idx.min() > w - 50_000
+
+
+def test_find_record_starts_flat(bam1):
+    flat = flatten_file(bam1)
+    lens = np.array(contig_lengths(bam1).lengths_list(), dtype=np.int32)
+    found = find_record_starts_flat(flat, lens, [239479])
+    assert found[239479] == Pos(239479, 312)
+    # All block starts resolve to the first indexed record at/after them.
+    records = read_records_index(str(bam1) + ".records")
+    idx = IndexedChecker(records)
+    all_found = find_record_starts_flat(flat, lens)
+    for start, pos in all_found.items():
+        assert pos == idx.next_read_start(Pos(start, 0)), f"block {start}"
