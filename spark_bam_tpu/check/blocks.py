@@ -1,0 +1,104 @@
+"""Block partition planning: BGZF blocks → ≈split-size partitions.
+
+Reference check/.../bam/check/Blocks.scala:22-214. Two paths:
+
+- **Indexed** (``.blocks`` sidecar exists): parse block metadata, filter by
+  byte ranges, prefix-sum compressed sizes, assign each block to partition
+  ``cum_offset // split_size`` (ref :70-140).
+- **Search**: split the file into ``split_size`` byte ranges; per range, find
+  the first block boundary then stream metadata while inside the range
+  (ref :141-207). Ranges are resolved in parallel on the host.
+
+Default split size 2 MB (ref :64).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from dataclasses import dataclass
+
+from spark_bam_tpu.bgzf.block import Metadata
+from spark_bam_tpu.bgzf.find_block_start import find_block_start
+from spark_bam_tpu.bgzf.index_blocks import read_blocks_index
+from spark_bam_tpu.bgzf.stream import MetadataStream
+from spark_bam_tpu.core.channel import open_channel
+from spark_bam_tpu.core.config import Config
+from spark_bam_tpu.core.ranges import RangeSet
+from spark_bam_tpu.parallel.executor import ParallelConfig, map_partitions
+
+
+@dataclass
+class Blocks:
+    """Partitioned block metadata + per-partition byte bounds."""
+
+    partitions: list[list[Metadata]]
+    bounds: list[tuple[int, int]]
+
+    @property
+    def num_blocks(self) -> int:
+        return sum(len(p) for p in self.partitions)
+
+    def all_blocks(self) -> list[Metadata]:
+        return [m for p in self.partitions for m in p]
+
+
+def plan_blocks(
+    path,
+    config: Config = Config(),
+    ranges: RangeSet | None = None,
+    blocks_path=None,
+    parallel: ParallelConfig = ParallelConfig(),
+) -> Blocks:
+    split_size = config.split_size_or(Config.CHECK_SPLIT_SIZE_DEFAULT)
+    blocks_path = str(blocks_path) if blocks_path else str(path) + ".blocks"
+
+    if os.path.exists(blocks_path):
+        metas = [
+            m
+            for m in read_blocks_index(blocks_path)
+            if ranges is None or m.start in ranges
+        ]
+        # Exclusive prefix sum of compressed sizes over the *filtered* blocks
+        # (the reference scans after filtering, Blocks.scala:89-107).
+        partitions: dict[int, list[Metadata]] = {}
+        offset = 0
+        for m in metas:
+            partitions.setdefault(offset // split_size, []).append(m)
+            offset += m.compressed_size
+        num_partitions = math.ceil(offset / split_size) if offset else 0
+        return Blocks(
+            partitions=[partitions.get(i, []) for i in range(num_partitions)],
+            bounds=[
+                (i * split_size, (i + 1) * split_size) for i in range(num_partitions)
+            ],
+        )
+
+    size = os.path.getsize(path)
+    num_splits = math.ceil(size / split_size)
+    split_idxs = [
+        i
+        for i in range(num_splits)
+        if ranges is None or ranges.overlaps(i * split_size, (i + 1) * split_size)
+    ]
+
+    def resolve(idx: int) -> list[Metadata]:
+        start, end = idx * split_size, (idx + 1) * split_size
+        with open_channel(path) as ch:
+            block_start = find_block_start(
+                ch, start, config.bgzf_blocks_to_check, path=str(path)
+            )
+            ch.seek(block_start)
+            out = []
+            for m in MetadataStream(ch):
+                if m.start >= end:
+                    break
+                if ranges is None or m.start in ranges:
+                    out.append(m)
+            return out
+
+    partitions = map_partitions(resolve, split_idxs, parallel)
+    return Blocks(
+        partitions=partitions,
+        bounds=[(i * split_size, (i + 1) * split_size) for i in split_idxs],
+    )

@@ -1,0 +1,21 @@
+from spark_bam_tpu.load.api import (
+    load_bam,
+    load_bam_intervals,
+    load_reads,
+    load_reads_and_positions,
+    load_sam,
+    load_splits_and_reads,
+)
+from spark_bam_tpu.load.splits import Split
+from spark_bam_tpu.load.dataset import Dataset
+
+__all__ = [
+    "load_bam",
+    "load_bam_intervals",
+    "load_reads",
+    "load_reads_and_positions",
+    "load_sam",
+    "load_splits_and_reads",
+    "Split",
+    "Dataset",
+]

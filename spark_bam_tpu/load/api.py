@@ -1,0 +1,277 @@
+"""User-facing load API.
+
+Reference: ``spark_bam._`` enrichment of SparkContext
+(load/src/main/scala/spark_bam/package.scala:123-131 and
+load/.../load/CanLoadBam.scala). Functions return lazy ``Dataset``s of
+``BamRecord`` (or ``(Pos, BamRecord)``) partitioned exactly the way the
+reference partitions RDDs:
+
+- ``load_bam``: file splits → per split find-block-start → find-record-start
+  → stream records until the next split's range (CanLoadBam.scala:173-243)
+- ``load_sam``: newline-aligned text splits + SAM line parse (:143-171)
+- ``load_bam_intervals``: .bai chunk query → cost-packed partitions →
+  seek + interval-overlap filter (:59-138)
+- ``load_reads``: extension dispatch (:348-382)
+"""
+
+from __future__ import annotations
+
+import os
+
+from spark_bam_tpu.bam.bai import BaiIndex, Chunk
+from spark_bam_tpu.bam.header import BamHeader, read_header
+from spark_bam_tpu.bam.iterators import SeekableRecordStream
+from spark_bam_tpu.bam.record import BamRecord, parse_sam_line
+from spark_bam_tpu.bgzf.find_block_start import find_block_start
+from spark_bam_tpu.bgzf.stream import SeekableBlockStream, SeekableUncompressedBytes
+from spark_bam_tpu.check.eager import EagerChecker
+from spark_bam_tpu.check.find_record_start import NoReadFoundException
+from spark_bam_tpu.core.channel import open_channel
+from spark_bam_tpu.core.config import Config
+from spark_bam_tpu.core.pos import Pos
+from spark_bam_tpu.load.dataset import Dataset
+from spark_bam_tpu.load.intervals import LociSet
+from spark_bam_tpu.load.splits import FileSplit, Split, file_splits
+from spark_bam_tpu.parallel.executor import ParallelConfig
+
+
+def _resolve_split_start(path, split: FileSplit, header: BamHeader, config: Config):
+    """find-block-start → find-record-start for one file split; None if the
+    split owns no blocks (its first boundary lies at/after its end)."""
+    with open_channel(path) as ch:
+        block_start = find_block_start(
+            ch, split.start, config.bgzf_blocks_to_check, path=str(path)
+        )
+    if block_start >= split.end:
+        return None
+    checker = EagerChecker(
+        SeekableUncompressedBytes(SeekableBlockStream(open_channel(path))),
+        header.contig_lengths,
+        config.reads_to_check,
+    )
+    try:
+        found = checker.next_read_start(Pos(block_start, 0), config.max_read_size)
+    finally:
+        checker.close()
+    if found is None:
+        raise NoReadFoundException(str(path), block_start, config.max_read_size)
+    return found
+
+
+def _iter_split_records(path, split: FileSplit, header: BamHeader, config: Config):
+    start_pos = _resolve_split_start(path, split, header, config)
+    if start_pos is None:
+        return
+    stream = SeekableRecordStream(
+        SeekableUncompressedBytes(SeekableBlockStream(open_channel(path))), header
+    )
+    try:
+        stream.seek(start_pos)
+        for pos, rec in stream:
+            if pos.block_pos >= split.end:
+                break
+            yield pos, rec
+    finally:
+        stream.close()
+
+
+def load_reads_and_positions(
+    path,
+    split_size=None,
+    config: Config = Config(),
+    parallel: ParallelConfig = ParallelConfig(),
+) -> Dataset:
+    """(Pos, BamRecord) pairs, partitioned by file splits (ref :281-334)."""
+    config = config.replace(split_size=split_size) if split_size else config
+    size = config.split_size_or(Config.LOAD_SPLIT_SIZE_DEFAULT)
+    header = read_header(path)
+    splits = file_splits(path, size)
+    return Dataset(
+        splits,
+        lambda split: _iter_split_records(path, split, header, config),
+        parallel,
+    )
+
+
+def load_bam(
+    path,
+    split_size=None,
+    config: Config = Config(),
+    parallel: ParallelConfig = ParallelConfig(),
+) -> Dataset:
+    """Records of a BAM, partitioned by file splits (ref :173-243)."""
+    ds = load_reads_and_positions(path, split_size, config, parallel)
+    compute = ds.compute
+    return Dataset(ds.partitions, lambda p: (rec for _, rec in compute(p)), parallel)
+
+
+def load_splits_and_reads(
+    path,
+    split_size=None,
+    config: Config = Config(),
+    parallel: ParallelConfig = ParallelConfig(),
+) -> tuple[list[Split], Dataset]:
+    """Resolved splits + the records dataset (ref :245-279)."""
+    ds = load_reads_and_positions(path, split_size, config, parallel)
+    firsts = ds.first_per_partition()
+    starts = [pos for item in firsts if item is not None for pos in [item[0]]]
+    eof = Pos(os.path.getsize(path), 0)
+    splits = [
+        Split(start, starts[i + 1] if i + 1 < len(starts) else eof)
+        for i, start in enumerate(starts)
+    ]
+    return splits, load_bam(path, split_size, config, parallel)
+
+
+def load_sam(
+    path,
+    split_size=None,
+    config: Config = Config(),
+    parallel: ParallelConfig = ParallelConfig(),
+) -> Dataset:
+    """SAM text file → records, newline-aligned byte-range partitions."""
+    size = (
+        config.replace(split_size=split_size).split_size
+        if split_size
+        else config.split_size_or(Config.LOAD_SPLIT_SIZE_DEFAULT)
+    )
+    contigs_by_name: dict[str, int] = {}
+    n_header = 0
+    with open(path, "rt") as f:
+        for line in f:
+            if not line.startswith("@"):
+                break
+            n_header += 1
+            if line.startswith("@SQ"):
+                fields = dict(
+                    kv.split(":", 1) for kv in line.rstrip("\n").split("\t")[1:] if ":" in kv
+                )
+                if "SN" in fields:
+                    contigs_by_name[fields["SN"]] = len(contigs_by_name)
+    file_size = os.path.getsize(path)
+    ranges = [(s, min(s + size, file_size)) for s in range(0, file_size, size)]
+
+    def compute(rng):
+        start, end = rng
+        with open(path, "rb") as f:
+            f.seek(start)
+            if start > 0:
+                f.readline()  # skip the partial line owned by the previous split
+            while f.tell() < end:
+                line = f.readline()
+                if not line:
+                    break
+                text = line.decode("latin-1")
+                if text.startswith("@"):
+                    continue
+                yield parse_sam_line(text, contigs_by_name)
+
+    return Dataset(ranges, compute, parallel)
+
+
+def load_reads(
+    path,
+    split_size=None,
+    config: Config = Config(),
+    parallel: ParallelConfig = ParallelConfig(),
+) -> Dataset:
+    """Extension dispatch: .sam / .bam (.cram requires a reference-guided
+    codec — not implemented; reference delegates it to hadoop-bam too,
+    CanLoadBam.scala:348-382)."""
+    s = str(path)
+    if s.endswith(".sam"):
+        return load_sam(path, split_size, config, parallel)
+    if s.endswith(".bam"):
+        return load_bam(path, split_size, config, parallel)
+    if s.endswith(".cram"):
+        raise NotImplementedError("CRAM loading is not supported yet")
+    raise ValueError(f"Can't tell format of path: {s}")
+
+
+# --------------------------------------------------------------- intervals
+def interval_chunks(
+    path, loci: LociSet, header: BamHeader, config: Config = Config()
+) -> list[Chunk]:
+    """.bai chunks overlapping the loci (ref getIntevalChunks :387-421)."""
+    bai = BaiIndex.read(str(path) + ".bai")
+    name_to_idx = {name: idx for idx, (name, _) in header.contig_lengths.items()}
+    chunks: list[Chunk] = []
+    for contig, ivs in loci.intervals.items():
+        if contig not in name_to_idx:
+            continue
+        ref = name_to_idx[contig]
+        if not ivs:
+            length = header.contig_lengths[ref][1]
+            ivs = [(0, length)]
+        for s, e in ivs:
+            chunks.extend(bai.query(ref, s, e))
+    chunks.sort(key=lambda c: (c.start, c.end))
+    from spark_bam_tpu.bam.bai import merge_chunks
+
+    return merge_chunks(chunks)
+
+
+def pack_chunks(
+    chunks: list[Chunk], split_size: int, ratio: float
+) -> list[list[Chunk]]:
+    """Greedy size-capped grouping (the reference's cappedCostGroups,
+    CanLoadBam.scala:85-99)."""
+    groups: list[list[Chunk]] = []
+    cur: list[Chunk] = []
+    cur_cost = 0
+    for c in chunks:
+        cost = max(c.size(ratio), 1)
+        if cur and cur_cost + cost > split_size:
+            groups.append(cur)
+            cur, cur_cost = [], 0
+        cur.append(c)
+        cur_cost += cost
+    if cur:
+        groups.append(cur)
+    return groups
+
+
+def load_bam_intervals(
+    path,
+    loci: LociSet | str,
+    split_size=None,
+    config: Config = Config(),
+    parallel: ParallelConfig = ParallelConfig(),
+) -> Dataset:
+    """Indexed random access: only records overlapping ``loci`` (ref :59-138)."""
+    header = read_header(path)
+    if isinstance(loci, str):
+        loci = LociSet.parse(loci, header.contig_lengths)
+    config = config.replace(split_size=split_size) if split_size else config
+    size = config.split_size_or(Config.LOAD_SPLIT_SIZE_DEFAULT)
+    chunks = interval_chunks(path, loci, header, config)
+    groups = pack_chunks(chunks, size, config.estimated_compression_ratio)
+
+    def overlaps(rec: BamRecord) -> bool:
+        # Unmapped reads (even placed ones) have no genomic region.
+        if rec.ref_id < 0 or rec.is_unmapped:
+            return False
+        return loci.overlaps(
+            header.contig_lengths.name(rec.ref_id), rec.pos, rec.end_pos()
+        )
+
+    def compute(group):
+        stream = SeekableRecordStream(
+            SeekableUncompressedBytes(SeekableBlockStream(open_channel(path))),
+            header,
+        )
+        try:
+            for chunk in group:
+                stream.seek(chunk.start)
+                for pos, rec in stream:
+                    if (pos.block_pos, pos.offset) >= (
+                        chunk.end.block_pos,
+                        chunk.end.offset,
+                    ):
+                        break
+                    if overlaps(rec):
+                        yield rec
+        finally:
+            stream.close()
+
+    return Dataset(groups, compute, parallel)

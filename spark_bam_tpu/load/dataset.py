@@ -1,0 +1,70 @@
+"""Partitioned lazy dataset — the RDD analog.
+
+A ``Dataset`` is a list of partition descriptors plus a compute function;
+actions (count/collect/first-per-partition) execute partitions through the
+host orchestrator (parallel/executor.py). This replaces the reference's
+Spark RDD surface for the load API.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Generic, Iterable, Iterator, Sequence, TypeVar
+
+from spark_bam_tpu.parallel.executor import ParallelConfig, map_partitions
+
+T = TypeVar("T")
+P = TypeVar("P")
+
+
+class Dataset(Generic[P, T]):
+    def __init__(
+        self,
+        partitions: Sequence[P],
+        compute: Callable[[P], Iterable[T]],
+        parallel: ParallelConfig = ParallelConfig(),
+    ):
+        self.partitions = list(partitions)
+        self.compute = compute
+        self.parallel = parallel
+
+    @property
+    def num_partitions(self) -> int:
+        return len(self.partitions)
+
+    def map_partitions(self, fn: Callable[[Iterable[T]], Iterable[T]]) -> "Dataset":
+        compute = self.compute
+        return Dataset(
+            self.partitions, lambda p: fn(compute(p)), self.parallel
+        )
+
+    def count(self) -> int:
+        return sum(
+            map_partitions(
+                lambda p: sum(1 for _ in self.compute(p)), self.partitions, self.parallel
+            )
+        )
+
+    def collect(self) -> list[T]:
+        out: list[T] = []
+        for part in map_partitions(
+            lambda p: list(self.compute(p)), self.partitions, self.parallel
+        ):
+            out.extend(part)
+        return out
+
+    def partition_sizes(self) -> list[int]:
+        return map_partitions(
+            lambda p: sum(1 for _ in self.compute(p)), self.partitions, self.parallel
+        )
+
+    def first_per_partition(self) -> list[T | None]:
+        def first(p):
+            for x in self.compute(p):
+                return x
+            return None
+
+        return map_partitions(first, self.partitions, self.parallel)
+
+    def __iter__(self) -> Iterator[T]:
+        for p in self.partitions:
+            yield from self.compute(p)

@@ -1,0 +1,54 @@
+"""Loci sets: genomic interval collections for indexed loads.
+
+Parses ``chr1:100-200,chr2,chr3:5k-10k`` style strings (the reference uses
+hammerlab LociSet for ``loadBamIntervals``, load/.../CanLoadBam.scala:59-138).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from spark_bam_tpu.core.config import parse_bytes
+
+
+@dataclass
+class LociSet:
+    # contig name → list of half-open (start, end); empty list ⇒ whole contig
+    intervals: dict[str, list[tuple[int, int]]] = field(default_factory=dict)
+
+    @staticmethod
+    def parse(s: str, contig_lengths=None) -> "LociSet":
+        out: dict[str, list[tuple[int, int]]] = {}
+        for part in s.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if ":" in part:
+                name, rng = part.split(":", 1)
+                lo, hi = rng.split("-", 1)
+                out.setdefault(name, []).append((parse_bytes(lo), parse_bytes(hi)))
+            else:
+                out.setdefault(part, [])
+        if contig_lengths is not None:
+            for name, ivs in out.items():
+                if not ivs:
+                    length = next(
+                        (l for _, (n, l) in contig_lengths.items() if n == name), None
+                    )
+                    if length is not None:
+                        ivs.append((0, length))
+        return LociSet(out)
+
+    def overlaps(self, contig: str, start: int, end: int) -> bool:
+        if contig not in self.intervals:
+            return False
+        ivs = self.intervals[contig]
+        if not ivs:
+            return True  # whole contig
+        return any(s < end and start < e for s, e in ivs)
+
+    def ranges_for(self, contig: str) -> list[tuple[int, int]] | None:
+        return self.intervals.get(contig)
+
+    def __bool__(self) -> bool:
+        return bool(self.intervals)

@@ -1,0 +1,46 @@
+"""File splits and resolved record-boundary splits.
+
+Reference: hadoop ``FileSplits`` → ``SplitRDD`` byte ranges
+(load/.../load/SplitRDD.scala:37-79) and the resolved
+``Split(start: Pos, end: Pos)`` (check/.../bam/spark/Split.scala:80-104).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+from spark_bam_tpu.core.pos import Pos
+
+
+@dataclass(frozen=True)
+class FileSplit:
+    """A compressed byte range [start, end) of one file."""
+    path: str
+    start: int
+    end: int
+
+    @property
+    def length(self) -> int:
+        return self.end - self.start
+
+
+@dataclass(frozen=True)
+class Split:
+    """A resolved split: record-boundary virtual positions [start, end)."""
+    start: Pos
+    end: Pos
+
+    def length(self, estimated_compression_ratio: float = 3.0) -> int:
+        return self.end.distance(self.start, estimated_compression_ratio)
+
+    def __str__(self) -> str:
+        return f"Split({self.start}-{self.end})"
+
+
+def file_splits(path, split_size: int) -> list[FileSplit]:
+    size = os.path.getsize(path)
+    return [
+        FileSplit(str(path), start, min(start + split_size, size))
+        for start in range(0, size, split_size)
+    ]

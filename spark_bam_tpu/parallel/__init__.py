@@ -1,0 +1,3 @@
+from spark_bam_tpu.parallel.executor import ParallelConfig, map_partitions
+
+__all__ = ["ParallelConfig", "map_partitions"]

@@ -1,0 +1,64 @@
+"""Host-side partition orchestration.
+
+Replaces the Spark driver/executor substrate (SURVEY.md §2.9): partitions are
+planned on the host and executed by a pluggable pool — sequential, threads
+(zlib/NumPy release the GIL, so threads saturate cores for this workload), or
+processes. The reference's analogous knob is ``ParallelConfig``
+(check/.../bam/spark/ParallelConfig.scala:127-148, Threads-vs-Spark).
+
+Accumulator-style reductions become plain fold-left over per-partition
+results; device-side reductions (psum over a mesh) live in parallel/mesh.py.
+"""
+
+from __future__ import annotations
+
+import os
+from concurrent.futures import ProcessPoolExecutor, ThreadPoolExecutor
+from dataclasses import dataclass
+from typing import Callable, Iterable, Sequence, TypeVar
+
+T = TypeVar("T")
+R = TypeVar("R")
+
+
+@dataclass(frozen=True)
+class ParallelConfig:
+    mode: str = "threads"   # sequential | threads | processes
+    workers: int = 0        # 0 → os.cpu_count()
+
+    @property
+    def num_workers(self) -> int:
+        return self.workers or os.cpu_count() or 1
+
+    @staticmethod
+    def parse(s: str) -> "ParallelConfig":
+        """``"sequential"`` | ``"threads[=N]"`` | ``"processes[=N]"``."""
+        if "=" in s:
+            mode, n = s.split("=", 1)
+            return ParallelConfig(mode, int(n))
+        return ParallelConfig(s)
+
+
+def map_partitions(
+    fn: Callable[[T], R],
+    items: Sequence[T],
+    config: ParallelConfig = ParallelConfig(),
+) -> list[R]:
+    """Apply ``fn`` to every partition, preserving order."""
+    if config.mode == "sequential" or len(items) <= 1:
+        return [fn(item) for item in items]
+    if config.mode == "threads":
+        with ThreadPoolExecutor(max_workers=config.num_workers) as pool:
+            return list(pool.map(fn, items))
+    if config.mode == "processes":
+        with ProcessPoolExecutor(max_workers=config.num_workers) as pool:
+            return list(pool.map(fn, items))
+    raise ValueError(f"Unknown parallel mode: {config.mode}")
+
+
+def fold_results(results: Iterable[R], zero, merge) -> object:
+    """Accumulator analog: host-side fold of per-partition results."""
+    acc = zero
+    for r in results:
+        acc = merge(acc, r)
+    return acc

@@ -1,0 +1,97 @@
+"""Load API vs the reference's golden partition sizes and counts
+(LoadBAMTest.scala, LoadSAMTest.scala, LoadSamAsBamFails.scala)."""
+
+import pytest
+
+from spark_bam_tpu.bam.header import read_header
+from spark_bam_tpu.bgzf.header import HeaderParseException
+from spark_bam_tpu.core.pos import Pos
+from spark_bam_tpu.load.api import (
+    interval_chunks,
+    load_bam,
+    load_bam_intervals,
+    load_reads,
+    load_sam,
+    load_splits_and_reads,
+)
+from spark_bam_tpu.load.intervals import LociSet
+
+
+def test_load_bam_1e6(bam2):
+    ds = load_bam(bam2, split_size=1_000_000)
+    assert ds.partition_sizes() == [2500]
+
+
+def test_load_bam_1e5(bam2):
+    ds = load_bam(bam2, split_size=100_000)
+    assert ds.partition_sizes() == [503, 414, 518, 421, 493, 151]
+
+
+def test_load_bam_2e4(bam2):
+    ds = load_bam(bam2, split_size=20_000)
+    assert ds.partition_sizes() == [
+        96, 102, 105, 101, 99, 102, 101, 106, 0, 105,
+        105, 102, 104, 103, 104, 106, 104, 106, 0, 105,
+        195, 101, 0, 99, 98, 99, 52,
+    ]
+
+
+def test_load_bam_1bam(bam1):
+    assert load_bam(bam1, split_size=300 << 10).count() == 4917
+
+
+def test_load_reads_dispatch(bam2, sam2):
+    assert load_reads(bam2, split_size=1_000_000).count() == 2500
+    assert load_reads(sam2, split_size=1_000_000).count() == 2500
+
+
+def test_load_sam_matches_bam(bam2, sam2):
+    bam_names = [r.read_name for r in load_bam(bam2, split_size=1_000_000)]
+    sam_names = [r.read_name for r in load_sam(sam2, split_size=500_000)]
+    assert bam_names == sam_names
+
+
+def test_load_sam_as_bam_fails(sam2):
+    with pytest.raises(HeaderParseException, match=r"Position 0: 64 != 31"):
+        load_bam(sam2).count()
+
+
+def test_load_splits_and_reads(bam2):
+    splits, ds = load_splits_and_reads(bam2, split_size=100_000)
+    assert len(splits) == 6
+    assert splits[0].start == Pos(0, 5650)
+    # Consecutive splits tile the file: each end is the next start.
+    for a, b in zip(splits, splits[1:]):
+        assert a.end == b.start
+    assert ds.count() == 2500
+
+
+def test_interval_chunks_all(bam2):
+    header = read_header(bam2)
+    loci = LociSet.parse("1:0-100000", header.contig_lengths)
+    chunks = interval_chunks(bam2, loci, header)
+    assert len(chunks) == 1
+    assert chunks[0].start == Pos(0, 5650)
+    assert chunks[0].end == Pos(531725, 0)
+
+
+def test_load_bam_intervals_all(bam2):
+    # 2500 reads, 50 unmapped ⇒ 2450 overlap the whole-range query.
+    ds = load_bam_intervals(bam2, "1:0-100000")
+    assert ds.count() == 2450
+
+
+def test_load_bam_intervals_disjoint(bam2):
+    header = read_header(bam2)
+    loci = LociSet.parse("1:13000-14000,1:60000-61000", header.contig_lengths)
+    chunks = interval_chunks(bam2, loci, header)
+    assert chunks == [
+        type(chunks[0])(Pos(0, 5650), Pos(314028, 45444)),
+        type(chunks[0])(Pos(439897, 20150), Pos(439897, 39777)),
+    ]
+    ds = load_bam_intervals(bam2, loci)
+    assert ds.num_partitions == 1
+    assert ds.count() == 129
+    ds2 = load_bam_intervals(bam2, loci, split_size=10_000)
+    assert ds2.num_partitions == 2
+    assert ds2.count() == 129
