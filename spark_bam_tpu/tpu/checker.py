@@ -1,0 +1,385 @@
+"""TPU-vectorized record-boundary checker.
+
+The JAX twin of check/vectorized.py (same two-pass algorithm — see that
+module's docstring for the design; the NumPy engine is the differential
+oracle for this one). Everything here is shape-static and jit-compiled:
+
+- window size ``W`` and ``reads_to_check`` are static; the *valid* byte count
+  ``n`` and ``at_eof`` flag are traced scalars, so one compiled kernel serves
+  every window of a file including the tail.
+- all integer work is int32 (TPU-native); the reference's JVM int32 wrap
+  semantics come for free, truncating division is ``lax.div``.
+- the chain walk's logical cursor is clamped into sentinel ranges when a
+  pathological length-prefix would overflow int32; affected lanes are
+  reported inexact and re-checked on host (exactness is never silently lost).
+
+Mapping to the hardware: the flag pass is elementwise VPU work + two
+prefix-sum scans that XLA fuses over the window; the chain walk is
+``reads_to_check`` gather rounds. Candidate independence (SURVEY.md §2.8
+item 6) is what makes the whole battery data-parallel.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from spark_bam_tpu.check.flags import BIT
+from spark_bam_tpu.check.vectorized import DEFINITIVE_MASK, ESCAPE_MASK
+
+# Padding beyond any index the flag pass can touch (36 fixed + 255 name +
+# 4*65535 cigar + slack), rounded to a multiple of 4 for the stride-4 scan.
+PAD = 36 + 255 + 4 * 65535 + 17  # = 262448, divisible by 4
+
+_I32 = jnp.int32
+
+
+def _i32_at(p: jnp.ndarray, w: int) -> jnp.ndarray:
+    """Little-endian u32 at every byte offset of the padded buffer."""
+    u = (
+        p[:-3].astype(jnp.uint32)
+        | (p[1:-2].astype(jnp.uint32) << 8)
+        | (p[2:-1].astype(jnp.uint32) << 16)
+        | (p[3:].astype(jnp.uint32) << 24)
+    )
+    return u
+
+
+def _ref_pos_bits(idx, pos, c, len_at, b_neg_idx, b_large_idx, b_neg_pos, b_large_pos):
+    neg_idx = idx < -1
+    large_idx = (~neg_idx) & (idx >= c)
+    neg_pos = pos < -1
+    idx_ok = (~neg_idx) & (~large_idx)
+    large_pos = idx_ok & (~neg_pos) & (idx >= 0) & (pos > len_at)
+    return (
+        jnp.where(neg_idx, _I32(b_neg_idx), _I32(0))
+        | jnp.where(large_idx, _I32(b_large_idx), _I32(0))
+        | jnp.where(neg_pos, _I32(b_neg_pos), _I32(0))
+        | jnp.where(large_pos, _I32(b_large_pos), _I32(0))
+    )
+
+
+def _compute_flags(p, lengths, num_contigs, n):
+    """Flag pass over a (W+PAD,)-byte padded buffer; returns F, remaining, body_end."""
+    w = p.shape[0] - PAD
+    u = _i32_at(p, w)
+    i32 = lax.bitcast_convert_type(u, jnp.int32)
+
+    remaining = i32[0:w]
+    ref_idx = i32[4: w + 4]
+    ref_pos = i32[8: w + 8]
+    name_len = p[12: w + 12].astype(_I32)  # i32 & 0xff ⇒ the low byte
+    fnc = u[16: w + 16]
+    n_cigar = (fnc & 0xFFFF).astype(_I32)
+    mapped = ((fnc >> 18) & 1) == 0
+    seq_len = i32[20: w + 20]
+    next_ref_idx = i32[24: w + 24]
+    next_ref_pos = i32[28: w + 28]
+
+    c = num_contigs
+    cmax = lengths.shape[0]
+    len_r = jnp.take(lengths, jnp.clip(ref_idx, 0, cmax - 1), mode="clip")
+    len_n = jnp.take(lengths, jnp.clip(next_ref_idx, 0, cmax - 1), mode="clip")
+
+    F = _ref_pos_bits(
+        ref_idx, ref_pos, c, len_r,
+        BIT["negativeReadIdx"], BIT["tooLargeReadIdx"],
+        BIT["negativeReadPos"], BIT["tooLargeReadPos"],
+    )
+    F = F | _ref_pos_bits(
+        next_ref_idx, next_ref_pos, c, len_n,
+        BIT["negativeNextReadIdx"], BIT["tooLargeNextReadIdx"],
+        BIT["negativeNextReadPos"], BIT["tooLargeNextReadPos"],
+    )
+
+    # Implied-size consistency: JVM int32 wrap + truncation toward zero.
+    t = seq_len + _I32(1)
+    half = lax.div(t, _I32(2))
+    rhs = _I32(32) + name_len + _I32(4) * n_cigar + half + seq_len
+    F = F | jnp.where(remaining < rhs, _I32(BIT["tooFewRemainingBytesImplied"]), _I32(0))
+
+    idx = jnp.arange(w, dtype=_I32)
+    name_start = idx + 36
+    name_end = name_start + name_len
+    has_name = name_len >= 2
+    F = F | jnp.where(name_len == 0, _I32(BIT["noReadName"]), _I32(0))
+    F = F | jnp.where(name_len == 1, _I32(BIT["emptyReadName"]), _I32(0))
+
+    name_eof = has_name & (name_end > n)
+    F = F | jnp.where(name_eof, _I32(BIT["tooFewBytesForReadName"]), _I32(0))
+
+    name_in = has_name & (~name_eof)
+    last_idx = name_end - 1
+    last_byte = jnp.take(p, last_idx, mode="clip")
+    non_null = name_in & (last_byte != 0)
+    F = F | jnp.where(non_null, _I32(BIT["nonNullTerminatedReadName"]), _I32(0))
+
+    allowed = ((p >= 0x21) & (p <= 0x7E) & (p != 0x40)).astype(_I32)
+    acc = jnp.concatenate([jnp.zeros(1, _I32), jnp.cumsum(allowed, dtype=_I32)])
+    good = jnp.take(acc, last_idx, mode="clip") - jnp.take(acc, name_start, mode="clip")
+    bad_chars = name_in & (~non_null) & (good != name_len - 1)
+    F = F | jnp.where(bad_chars, _I32(BIT["nonASCIIReadName"]), _I32(0))
+
+    # Cigar: stride-4 suffix sums of bad-op indicators (op = low nibble of the
+    # int's first byte). Ints are readable only when fully inside the valid n.
+    j = jnp.arange(p.shape[0], dtype=_I32)
+    bad_op = (((p & 0xF) > 8) & (j + 4 <= n)).astype(_I32)
+    b4 = bad_op.reshape(-1, 4)
+    B = jnp.flip(jnp.cumsum(jnp.flip(b4, 0), axis=0, dtype=_I32), 0).reshape(-1)
+
+    cig_start = name_start + jnp.where(name_in, name_len, _I32(0))
+    cig_end = cig_start + _I32(4) * n_cigar
+    cig_considered = ~name_eof
+    bad_count = jnp.take(B, cig_start, mode="clip") - jnp.take(B, cig_end, mode="clip")
+    has_bad = cig_considered & (bad_count > 0)
+    F = F | jnp.where(has_bad, _I32(BIT["invalidCigarOp"]), _I32(0))
+    cig_eof = cig_considered & (~has_bad) & (cig_end > n)
+    F = F | jnp.where(cig_eof, _I32(BIT["tooFewBytesForCigarOps"]), _I32(0))
+    empty_ok = cig_considered & (~has_bad) & (~cig_eof) & mapped
+    empty_seq = empty_ok & (seq_len == 0)
+    empty_cig = empty_ok & (n_cigar == 0)
+    some_empty = empty_seq | empty_cig
+    F = F | jnp.where(some_empty & empty_seq, _I32(BIT["emptyMappedSeq"]), _I32(0))
+    F = F | jnp.where(some_empty & empty_cig, _I32(BIT["emptyMappedCigar"]), _I32(0))
+
+    few_fixed = idx > n - 36
+    F = jnp.where(few_fixed, _I32(BIT["tooFewFixedBlockBytes"]), F)
+
+    body_end = jnp.where(
+        few_fixed,
+        idx + 36,
+        cig_start + jnp.where(cig_considered, _I32(4) * n_cigar, _I32(0)),
+    )
+    return F, remaining, body_end
+
+
+# Sentinel bounds for the logical cursor: anything outside [0, n] behaves
+# identically (it can never equal the physical cursor at EOF), so clamping is
+# exact unless the cursor needs to *re-enter* range — tracked per lane.
+@functools.partial(
+    jax.jit, static_argnames=("reads_to_check", "window")
+)
+def check_window(
+    padded: jnp.ndarray,       # (W+PAD,) uint8; zeros beyond n
+    lengths: jnp.ndarray,      # (Cmax,) int32 contig lengths, padded
+    num_contigs: jnp.ndarray,  # () int32
+    n: jnp.ndarray,            # () int32: valid byte count
+    at_eof: jnp.ndarray,       # () bool: buffer end == file end
+    reads_to_check: int = 10,
+    window: int | None = None,
+):
+    """Flag pass + chain walk over one window; verdicts for every offset.
+
+    Returns dict of (W,) arrays: verdict, fail_mask, reads_parsed,
+    reads_before, exact, escaped.
+    """
+    w = padded.shape[0] - PAD
+    F, remaining, body_end = _compute_flags(padded, lengths, num_contigs, n)
+
+    logical = jnp.arange(w, dtype=_I32)
+    physical = jnp.arange(w, dtype=_I32)
+    l_overflowed = jnp.zeros(w, dtype=bool)
+    res = jnp.zeros(w, dtype=jnp.int8)  # 0 running, 1 true, -1 false, 2 escaped
+    fail_mask = jnp.zeros(w, dtype=_I32)
+    reads_before = jnp.zeros(w, dtype=_I32)
+    reads_parsed = jnp.zeros(w, dtype=_I32)
+    exact = jnp.ones(w, dtype=bool)
+
+    def step(state, step_idx):
+        logical, physical, l_overflowed, res, fail_mask, reads_before, reads_parsed, exact = state
+        run = res == 0
+
+        # --- EOF at record edge (zero bytes): eager/Checker.scala:36-39 ---
+        at_end = run & (physical >= n)
+        edge = (physical == logical) & (~l_overflowed) & (step_idx > 0)
+        maybe_edge = l_overflowed & (step_idx > 0)  # can't trust comparison
+        eof_ok = at_end & edge & at_eof
+        eof_bad = at_end & (~edge) & (~maybe_edge) & at_eof
+        eof_esc = at_end & ((~at_eof) | maybe_edge)
+        res = jnp.where(eof_ok, jnp.int8(1), res)
+        reads_parsed = jnp.where(eof_ok, step_idx, reads_parsed)
+        res = jnp.where(eof_bad, jnp.int8(-1), res)
+        fail_mask = jnp.where(eof_bad, _I32(BIT["tooFewFixedBlockBytes"]), fail_mask)
+        reads_before = jnp.where(eof_bad, step_idx, reads_before)
+        res = jnp.where(eof_esc, jnp.int8(2), res)
+        run = res == 0
+
+        f = jnp.take(F, jnp.clip(physical, 0, w - 1), mode="clip")
+        f = jnp.where(run, f, _I32(0))
+        definitive = f & DEFINITIVE_MASK
+        boundary = f & ESCAPE_MASK
+
+        fail = run & ((definitive != 0) | (at_eof & (boundary != 0)))
+        esc = run & (~at_eof) & (definitive == 0) & (boundary != 0)
+        inexact = run & (~at_eof) & (definitive != 0) & (boundary != 0)
+        res = jnp.where(fail, jnp.int8(-1), res)
+        fail_mask = jnp.where(fail, f, fail_mask)
+        reads_before = jnp.where(fail, step_idx, reads_before)
+        res = jnp.where(esc, jnp.int8(2), res)
+        exact = exact & (~inexact)
+        run = res == 0
+
+        ok = run & (f == 0)
+        pi = jnp.clip(physical, 0, w - 1)
+        rem = jnp.take(remaining, pi, mode="clip")
+        # int32-safe logical advance: out-of-range values collapse to
+        # sentinels (n+64 / -64) that preserve all future comparisons unless
+        # the cursor would legitimately re-enter [0, n] — flagged for host
+        # re-check via l_overflowed.
+        big = rem > n + 64
+        small = rem < -(n + 64)
+        rem_c = jnp.clip(rem, -(n + 64), n + 64)
+        next_logical = logical + 4 + rem_c
+        next_logical = jnp.clip(next_logical, -(n + 64), n + 64)
+        overflow_now = big | small | (logical + 4 + rem_c != next_logical)
+        next_physical = jnp.maximum(jnp.take(body_end, pi, mode="clip"), next_logical)
+        next_physical = jnp.minimum(next_physical, n)
+        # (A chain stepping to/past the buffer end resolves at the next
+        #  iteration's EOF check: success/fail when at_eof, escape otherwise.)
+        logical = jnp.where(ok, next_logical, logical)
+        physical = jnp.where(ok, next_physical, physical)
+        l_overflowed = l_overflowed | (ok & overflow_now)
+        return (
+            logical, physical, l_overflowed, res, fail_mask,
+            reads_before, reads_parsed, exact,
+        ), None
+
+    state = (logical, physical, l_overflowed, res, fail_mask, reads_before, reads_parsed, exact)
+    state, _ = lax.scan(step, state, jnp.arange(reads_to_check, dtype=_I32))
+    logical, physical, l_overflowed, res, fail_mask, reads_before, reads_parsed, exact = state
+
+    full_chain = res == 0
+    res = jnp.where(full_chain, jnp.int8(1), res)
+    reads_parsed = jnp.where(full_chain, _I32(reads_to_check), reads_parsed)
+    escaped = res == 2
+    exact = exact & (~escaped)
+    return {
+        "verdict": res == 1,
+        "fail_mask": fail_mask,
+        "reads_parsed": reads_parsed,
+        "reads_before": reads_before,
+        "exact": exact,
+        "escaped": escaped,
+    }
+
+
+def make_check_window(window: int, reads_to_check: int = 10):
+    """A jit-compiled window kernel for fixed ``window`` size."""
+
+    def run(padded, lengths, num_contigs, n, at_eof):
+        return check_window(
+            padded, lengths, num_contigs, n, at_eof,
+            reads_to_check=reads_to_check, window=window,
+        )
+
+    return run
+
+
+@dataclass
+class WindowResult:
+    verdict: np.ndarray
+    fail_mask: np.ndarray
+    reads_parsed: np.ndarray
+    reads_before: np.ndarray
+    exact: np.ndarray
+    escaped: np.ndarray
+
+
+class TpuChecker:
+    """Host wrapper: windows a flat uncompressed stream through the device
+    kernel; escaped/inexact candidates fall back to the NumPy engine (and
+    ultimately the sequential oracle), so results are always exact.
+
+    The ``Checker`` plugin face of the TPU backend (``spark.bam.backend=tpu``).
+    """
+
+    def __init__(
+        self,
+        contig_lengths: np.ndarray,
+        window: int = 16 << 20,
+        halo: int = 4 << 20,
+        reads_to_check: int = 10,
+        cmax: int = 1024,
+    ):
+        self.window = window
+        self.halo = halo
+        self.reads_to_check = reads_to_check
+        self.num_contigs = np.int32(len(contig_lengths))
+        cmax = max(cmax, len(contig_lengths))
+        self.lengths = np.zeros(cmax, dtype=np.int32)
+        self.lengths[: len(contig_lengths)] = contig_lengths
+        self._kernel = make_check_window(window, reads_to_check)
+
+    def check_buffer(self, buf: np.ndarray, at_eof: bool = True) -> WindowResult:
+        """Check every position of ``buf``; exact everywhere except possibly
+        within the final chain-reach when ``at_eof=False`` (those escape)."""
+        n_total = len(buf)
+        out = {
+            k: np.empty(n_total, dtype=d)
+            for k, d in [
+                ("verdict", bool), ("fail_mask", np.int32),
+                ("reads_parsed", np.int32), ("reads_before", np.int32),
+                ("exact", bool), ("escaped", bool),
+            ]
+        }
+        w = self.window
+        step = max(w - self.halo, 1)
+        s = 0
+        while True:
+            e = min(s + w, n_total)
+            chunk_eof = at_eof and e == n_total
+            padded = np.zeros(w + PAD, dtype=np.uint8)
+            padded[: e - s] = buf[s:e]
+            res = self._kernel(
+                jnp.asarray(padded),
+                jnp.asarray(self.lengths),
+                jnp.int32(self.num_contigs),
+                jnp.int32(e - s),
+                jnp.bool_(chunk_eof),
+            )
+            res = {k: np.asarray(v) for k, v in res.items()}
+            # Own [s, s+step) — the halo tail belongs to the next window —
+            # except the last window, which owns through the end.
+            own_end = e if e == n_total else min(s + step, n_total)
+            for k in out:
+                out[k][s:own_end] = res[k][: own_end - s]
+            if e == n_total:
+                break
+            s += step
+        result = WindowResult(**out)
+        self._host_recheck(buf, result, at_eof)
+        return result
+
+    def _host_recheck(self, buf, result: WindowResult, at_eof: bool):
+        """Resolve escaped/inexact lanes with the NumPy engine on a widened
+        span (covers sentinel-overflow lanes and halo-exceeding chains)."""
+        bad = result.escaped | ~result.exact
+        if at_eof:
+            idxs = np.flatnonzero(bad)
+        else:
+            # In pure windowed mode the tail escapes are legitimate output.
+            idxs = np.flatnonzero(bad[: max(len(buf) - self.halo, 0)])
+        if len(idxs) == 0:
+            return
+        from spark_bam_tpu.check.vectorized import check_flat
+
+        # Escapes are rare (chains outrunning the halo, sentinel overflows);
+        # re-run only the suffix that can influence them.
+        base = int(idxs.min())
+        res = check_flat(
+            buf[base:], self.lengths[: int(self.num_contigs)],
+            candidates=(idxs - base).astype(np.int64),
+            at_eof=at_eof, reads_to_check=self.reads_to_check,
+        )
+        result.verdict[idxs] = res.verdict
+        result.fail_mask[idxs] = res.fail_mask
+        result.reads_parsed[idxs] = res.reads_parsed
+        result.reads_before[idxs] = res.reads_before
+        result.exact[idxs] = res.exact | res.verdict | (res.fail_mask != 0)
+        result.escaped[idxs] = res.escaped
