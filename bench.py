@@ -1,0 +1,110 @@
+"""Benchmark: record-boundary checking throughput, device vs CPU-sequential.
+
+The hot path of the reference is the eager checker evaluated at every
+uncompressed position (check-bam; worst-case split resolution —
+SURVEY.md §3.5). This measures positions/second:
+
+- baseline: the sequential CPU eager oracle (reference semantics,
+  check/eager.py) on a position sample
+- measured: the jitted window kernel on the default JAX backend (the real
+  TPU chip under axon; CPU otherwise), full scan, steady-state
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+
+FIXTURE = Path("/root/reference/test_bams/src/main/resources/2.bam")
+
+
+def synth_buffer(flat_data: np.ndarray, target: int) -> np.ndarray:
+    """Tile the fixture's uncompressed stream up to ~target bytes."""
+    reps = max(1, target // len(flat_data))
+    return np.concatenate([flat_data] * reps)
+
+
+def cpu_baseline_pps(path, n_sample: int = 60_000) -> float:
+    from spark_bam_tpu.check.eager import EagerChecker
+    from spark_bam_tpu.bgzf.flat import flatten_file
+    from spark_bam_tpu.core.pos import Pos
+
+    flat = flatten_file(path)
+    checker = EagerChecker.open(path)
+    rng = np.random.default_rng(42)
+    idxs = rng.integers(0, flat.size, n_sample)
+    blocks, offs = flat.pos_of_flat_many(idxs)
+    t0 = time.perf_counter()
+    for b, o in zip(blocks.tolist(), offs.tolist()):
+        checker(Pos(b, o))
+    dt = time.perf_counter() - t0
+    checker.close()
+    return n_sample / dt
+
+
+def device_pps(path, window_mb: int = 32, iters: int = 5) -> tuple[float, str]:
+    import jax
+    import jax.numpy as jnp
+
+    from spark_bam_tpu.bam.header import contig_lengths
+    from spark_bam_tpu.bgzf.flat import flatten_file
+    from spark_bam_tpu.tpu.checker import PAD, make_check_window
+
+    flat = flatten_file(path)
+    lens_list = contig_lengths(path).lengths_list()
+    lengths = np.zeros(1024, dtype=np.int32)
+    lengths[: len(lens_list)] = lens_list
+
+    w = window_mb << 20
+    buf = synth_buffer(flat.data, w)[:w]
+    padded = np.zeros(w + PAD, dtype=np.uint8)
+    padded[: len(buf)] = buf
+    n = np.int32(len(buf))
+
+    kernel = make_check_window(w, 10)
+    lengths_j = jnp.asarray(lengths)
+    nc = jnp.int32(len(lens_list))
+
+    # Warmup/compile.
+    out = kernel(jnp.asarray(padded), lengths_j, nc, jnp.int32(n), jnp.bool_(False))
+    out["verdict"].block_until_ready()
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = kernel(
+            jnp.asarray(padded), lengths_j, nc, jnp.int32(n), jnp.bool_(False)
+        )
+    out["verdict"].block_until_ready()
+    dt = time.perf_counter() - t0
+    backend = jax.devices()[0].platform
+    return iters * int(n) / dt, backend
+
+
+def main():
+    if not FIXTURE.exists():
+        print(json.dumps({
+            "metric": "check_positions_per_sec",
+            "value": 0, "unit": "positions/s", "vs_baseline": 0,
+            "error": "fixture unavailable",
+        }))
+        return
+    cpu_pps = cpu_baseline_pps(FIXTURE)
+    dev_pps, backend = device_pps(FIXTURE)
+    print(json.dumps({
+        "metric": "check_positions_per_sec",
+        "value": round(dev_pps),
+        "unit": "positions/s",
+        "vs_baseline": round(dev_pps / cpu_pps, 2),
+        "cpu_eager_positions_per_sec": round(cpu_pps),
+        "backend": backend,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,132 @@
+"""Device-mesh execution: sharded checking across chips.
+
+The workload is data-parallel over windows of uncompressed bytes
+(SURVEY.md §2.8-2.9): a batch of B windows shards across the mesh's ``data``
+axis, every device runs the same check kernel on its shard, and the tiny
+confusion-matrix / flag-histogram reductions ride ``psum`` over ICI —
+replacing the reference's Spark accumulators (CheckerApp.scala:59-70).
+
+Cross-shard record chains are handled the same way as cross-window chains on
+one chip: each window carries a trailing halo of the next shard's bytes
+(≤ a few MB — the "halo exchange" in SURVEY §2.9 is done host-side at batch
+assembly; on multi-host deployments this is the only inter-host data motion).
+
+``sharded_check_step`` is the framework's "training step" equivalent: the
+jitted, mesh-partitioned unit of work the driver dry-runs for multi-chip
+validation (``__graft_entry__.dryrun_multichip``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from spark_bam_tpu.tpu.checker import PAD, check_window
+
+
+def make_mesh(devices=None, axis: str = "data") -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    return Mesh(np.array(devices), (axis,))
+
+
+@functools.partial(jax.jit, static_argnames=("reads_to_check",))
+def sharded_check_step(
+    windows: jnp.ndarray,      # (B, W+PAD) uint8, batch-dim sharded over the mesh
+    ns: jnp.ndarray,           # (B,) int32 valid byte counts
+    at_eofs: jnp.ndarray,      # (B,) bool
+    truth: jnp.ndarray,        # (B, W) bool: indexed ground truth (or zeros)
+    lengths: jnp.ndarray,      # (Cmax,) int32, replicated
+    num_contigs: jnp.ndarray,  # () int32
+    reads_to_check: int = 10,
+):
+    """One sharded unit of work: per-window check + global stat reduction.
+
+    Inputs carry their sharding (GSPMD): place the batch with
+    ``shard_windows`` and XLA partitions the vmap across devices and lowers
+    the stat sums to all-reduces over ICI.
+
+    Returns (per-window verdicts (B, W) bool, escapes, global stats dict).
+    """
+
+    def one(window, n, at_eof, tr):
+        res = check_window(
+            window, lengths, num_contigs, n, at_eof, reads_to_check=reads_to_check
+        )
+        w = window.shape[0] - PAD
+        in_range = jnp.arange(w, dtype=jnp.int32) < n
+        v = res["verdict"] & in_range
+        t = tr & in_range
+        stats = jnp.stack(
+            [
+                jnp.sum((v & t).astype(jnp.int32)),    # true positives
+                jnp.sum((v & ~t).astype(jnp.int32)),   # false positives
+                jnp.sum((~v & t).astype(jnp.int32)),   # false negatives
+                jnp.sum((~v & ~t).astype(jnp.int32)),  # true negatives
+                jnp.sum(in_range.astype(jnp.int32)),   # positions checked
+            ]
+        )
+        return v, res["escaped"] & in_range, stats
+
+    verdicts, escapes, stats = jax.vmap(one)(windows, ns, at_eofs, truth)
+    totals = jnp.sum(stats, axis=0)
+    return verdicts, escapes, {
+        "true_positives": totals[0],
+        "false_positives": totals[1],
+        "false_negatives": totals[2],
+        "true_negatives": totals[3],
+        "positions": totals[4],
+    }
+
+
+def shard_windows(
+    mesh: Mesh,
+    windows: np.ndarray,
+    axis: str = "data",
+):
+    """Place a (B, W+PAD) batch with batch-dim sharding over the mesh."""
+    sharding = NamedSharding(mesh, P(axis))
+    return jax.device_put(windows, sharding)
+
+
+def batch_windows(
+    buf: np.ndarray,
+    window: int,
+    halo: int,
+    batch: int,
+    at_eof: bool = True,
+    truth: np.ndarray | None = None,
+):
+    """Cut a flat buffer into a (B, W+PAD) batch of overlapping windows.
+
+    Each window's trailing ``halo`` lets chains started in its owned span
+    complete; ownership spans tile the buffer exactly. Returns (windows, ns,
+    at_eofs, owned ranges, truth windows).
+    """
+    n_total = len(buf)
+    step = max(window - halo, 1)
+    starts = list(range(0, max(n_total, 1), step))
+    # Trim starts that fall entirely beyond the buffer.
+    starts = [s for s in starts if s == 0 or s < n_total]
+    b = max(batch, len(starts))
+    ws = np.zeros((b, window + PAD), dtype=np.uint8)
+    ns = np.zeros(b, dtype=np.int32)
+    eofs = np.zeros(b, dtype=bool)
+    owned = []
+    tr = np.zeros((b, window), dtype=bool)
+    for i, s in enumerate(starts):
+        e = min(s + window, n_total)
+        ws[i, : e - s] = buf[s:e]
+        ns[i] = e - s
+        eofs[i] = at_eof and e == n_total
+        own_end = e if e == n_total else min(s + step, n_total)
+        owned.append((s, own_end))
+        if truth is not None:
+            tr[i, : e - s] = truth[s:e]
+        if e == n_total:
+            break
+    return ws, ns, eofs, owned, tr

@@ -9,8 +9,23 @@ import os
 import sys
 from pathlib import Path
 
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force, not setdefault: the environment pins JAX_PLATFORMS to the real TPU
+# tunnel; tests want the fast deterministic CPU backend with 8 virtual
+# devices so multi-chip sharding is exercised. Real-TPU runs go through
+# bench.py / __graft_entry__.py.
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+# The environment's sitecustomize imports jax before this conftest runs, so
+# the env var alone is too late — override through the config API as well.
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
 
 REPO_ROOT = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO_ROOT))
