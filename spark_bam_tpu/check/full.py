@@ -145,8 +145,11 @@ class FullChecker:
             elif len(cigar) < n_cigar_bytes:
                 kw["tooFewBytesForCigarOps"] = True
             elif (flags & 4) == 0 and (seq_len == 0 or n_cigar == 0):
-                kw["emptyMappedSeq"] = seq_len == 0
-                kw["emptyMappedCigar"] = n_cigar == 0
+                # Reference quirk preserved: full/Checker.scala:122-129 passes
+                # (emptySeq, emptyCigar) into EmptyMapped's
+                # (emptyMappedCigar, emptyMappedSeq) fields — swapped.
+                kw["emptyMappedCigar"] = seq_len == 0
+                kw["emptyMappedSeq"] = n_cigar == 0
 
         if any(kw.values()):
             return Flags(**kw, readsBeforeError=successes)

@@ -174,8 +174,11 @@ def compute_flags(buf: np.ndarray, contig_lengths: np.ndarray) -> RecordMasks:
     empty_ok = cig_considered & ~has_bad & ~cig_eof & mapped
     empty_seq = empty_ok & (seq_len == 0)
     empty_cig = empty_ok & (n_cigar == 0)
-    F |= ((empty_seq | empty_cig) & empty_seq) * np.int32(BIT["emptyMappedSeq"])
-    F |= ((empty_seq | empty_cig) & empty_cig) * np.int32(BIT["emptyMappedCigar"])
+    # Reference quirk preserved: full/Checker.scala:122-129 constructs
+    # EmptyMapped(emptySeq, emptyCigar) but the case class fields are
+    # (emptyMappedCigar, emptyMappedSeq) — the two flags are swapped.
+    F |= ((empty_seq | empty_cig) & empty_seq) * np.int32(BIT["emptyMappedCigar"])
+    F |= ((empty_seq | empty_cig) & empty_cig) * np.int32(BIT["emptyMappedSeq"])
 
     # --- too few fixed bytes: the only flag when the 36-byte read fails ---
     few_fixed = idx > n - 36

@@ -1,0 +1,208 @@
+"""Shared context for the checker commands.
+
+The reference's ``CheckerApp`` (cli/.../check/CheckerApp.scala:31-223) built
+around Spark broadcasts/accumulators; here one ``CheckerContext`` inflates
+the file into a flat view once, evaluates whichever vectorized engines a
+command needs, and renders the shared report blocks (position totals,
+confusion matrix, annotated false positives).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from functools import cached_property
+
+import numpy as np
+
+from spark_bam_tpu.bam.header import read_header
+from spark_bam_tpu.bam.index_records import read_records_index
+from spark_bam_tpu.bam.record import BamRecord
+from spark_bam_tpu.bgzf.flat import FlatView, flatten_file
+from spark_bam_tpu.check.flags import Flags
+from spark_bam_tpu.check.seqdoop import seqdoop_check_flat
+from spark_bam_tpu.check.vectorized import ChainResult, check_flat
+from spark_bam_tpu.cli.output import Printer
+from spark_bam_tpu.core.config import Config
+from spark_bam_tpu.core.pos import Pos
+from spark_bam_tpu.core.stats import format_bytes_binary
+
+
+def render_record(rec: BamRecord, contigs) -> str:
+    """HTSJDK-style record rendering + the reference's location suffix
+    (check/.../PosMetadata.scala:35-55)."""
+    pair = ""
+    if rec.flag & 0x1:
+        pair = " 2/2" if rec.flag & 0x80 else " 1/2"
+    kind = "unmapped" if rec.is_unmapped else "aligned"
+    s = f"{rec.read_name}{pair} {rec.read_length}b {kind} read"
+    num_contigs = len(contigs)
+    if rec.is_unmapped and rec.pos >= 0 and 0 <= rec.ref_id < num_contigs:
+        s += f" (placed at {contigs.name(rec.ref_id)}:{rec.pos + 1})"
+    elif not rec.is_unmapped:
+        s += f" @ {contigs.name(rec.ref_id)}:{rec.pos + 1}"
+    return s
+
+
+@dataclass
+class PosAnnotation:
+    pos: Pos
+    delta: int | None
+    record_str: str | None
+    flags: Flags
+
+    def __str__(self) -> str:
+        rec = (
+            f"{self.delta} before {self.record_str}"
+            if self.record_str is not None
+            else "no next record"
+        )
+        return f"{self.pos}:\t{rec}. Failing checks: {self.flags}"
+
+
+class CheckerContext:
+    def __init__(self, path, config: Config = Config(), printer: Printer | None = None):
+        self.path = str(path)
+        self.config = config
+        self.printer = printer or Printer()
+
+    @cached_property
+    def header(self):
+        return read_header(self.path)
+
+    @cached_property
+    def contigs(self):
+        return self.header.contig_lengths
+
+    @cached_property
+    def lengths(self) -> np.ndarray:
+        return np.array(self.contigs.lengths_list(), dtype=np.int32)
+
+    @cached_property
+    def view(self) -> FlatView:
+        return flatten_file(self.path)
+
+    @cached_property
+    def compressed_size(self) -> int:
+        return os.path.getsize(self.path)
+
+    # ------------------------------------------------------------- engines
+    @cached_property
+    def eager_result(self) -> ChainResult:
+        return check_flat(
+            self.view.data,
+            self.lengths,
+            at_eof=True,
+            reads_to_check=self.config.reads_to_check,
+        )
+
+    @cached_property
+    def eager_verdict(self) -> np.ndarray:
+        return self.eager_result.verdict
+
+    @cached_property
+    def seqdoop_verdict(self) -> np.ndarray:
+        return seqdoop_check_flat(self.view, len(self.contigs))
+
+    @cached_property
+    def truth(self) -> np.ndarray:
+        truth = np.zeros(self.view.size, dtype=bool)
+        for pos in read_records_index(self.records_path):
+            truth[self.view.flat_of_pos(pos.block_pos, pos.offset)] = True
+        return truth
+
+    @property
+    def records_path(self) -> str:
+        return self.path + ".records"
+
+    @property
+    def has_records_index(self) -> bool:
+        return os.path.exists(self.records_path)
+
+    def verdict_for(self, name: str) -> np.ndarray:
+        if name == "eager":
+            return self.eager_verdict
+        if name == "seqdoop":
+            return self.seqdoop_verdict
+        if name == "indexed":
+            return self.truth
+        raise KeyError(name)
+
+    # --------------------------------------------------------- annotations
+    def annotate(self, flat_idx: int) -> PosAnnotation:
+        """Next-record metadata + full-checker flags for one position
+        (reference PosMetadata.apply)."""
+        pos = Pos(*self.view.pos_of_flat(flat_idx))
+        mask = int(self.eager_result.fail_mask[flat_idx])
+        flags = Flags.from_mask(mask, int(self.eager_result.reads_before[flat_idx]))
+        true_flat = self.true_flat_eager
+        j = int(np.searchsorted(true_flat, flat_idx))
+        if j < len(true_flat) and true_flat[j] - flat_idx < self.config.max_read_size:
+            nxt = int(true_flat[j])
+            rec, _ = BamRecord.decode(self.view.data, nxt)
+            return PosAnnotation(
+                pos, nxt - flat_idx, render_record(rec, self.contigs), flags
+            )
+        return PosAnnotation(pos, None, None, flags)
+
+    @cached_property
+    def true_flat_eager(self) -> np.ndarray:
+        return np.flatnonzero(self.eager_verdict)
+
+    # ------------------------------------------------------------- reports
+    def print_header_and_confusion(
+        self, expected: np.ndarray, actual: np.ndarray
+    ) -> None:
+        """The shared check-bam/full-check report (CheckerApp.scala:64-222)."""
+        p = self.printer
+        tp = int((expected & actual).sum())
+        fp_idx = np.flatnonzero(~expected & actual)
+        fn_idx = np.flatnonzero(expected & ~actual)
+        tn = int((~expected & ~actual).sum())
+        num_reads = tp + len(fn_idx)
+        total = num_reads + tn + len(fp_idx)
+        ratio = total / self.compressed_size
+
+        p.echo(
+            f"{total} uncompressed positions",
+            f"{format_bytes_binary(self.compressed_size)} compressed",
+            "Compression ratio: %.2f" % ratio,
+            f"{num_reads} reads",
+        )
+
+        if not len(fp_idx) and not len(fn_idx):
+            p.echo("All calls matched!")
+            return
+
+        p.echo(f"{len(fp_idx)} false positives, {len(fn_idx)} false negatives", "")
+
+        if len(fp_idx):
+            annotations = [self.annotate(int(i)) for i in fp_idx]
+            hist: dict[str, int] = {}
+            for a in annotations:
+                key = str(a.flags)
+                hist[key] = hist.get(key, 0) + 1
+            rows = [
+                f"{count}:\t{flags}"
+                for flags, count in sorted(hist.items(), key=lambda kv: -kv[1])
+            ]
+            p.print_limited(
+                rows,
+                header="False-positive-site flags histogram:",
+                truncated_header=lambda n: "False-positive-site flags histogram:",
+            )
+            p.echo("")
+            p.print_limited(
+                [str(a) for a in annotations],
+                header="False positives with succeeding read info:",
+                truncated_header=lambda n: (
+                    f"{n} of {len(fp_idx)} false positives with succeeding read info::"
+                ),
+            )
+
+        if len(fn_idx):
+            p.print_limited(
+                [str(Pos(*self.view.pos_of_flat(int(i)))) for i in fn_idx],
+                header=f"{len(fn_idx)} false negatives:",
+                truncated_header=lambda n: f"{n} of {len(fn_idx)} false negatives:",
+            )

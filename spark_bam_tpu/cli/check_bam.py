@@ -1,0 +1,20 @@
+"""check-bam: evaluate two checkers at every uncompressed position.
+
+Default compares spark-bam's eager checker against the seqdoop
+(hadoop-bam-semantics) checker; ``-s``/``-u`` score eager/seqdoop against
+the ``.records`` ground truth (reference cli/.../check/eager/CheckBam.scala).
+"""
+
+from __future__ import annotations
+
+from spark_bam_tpu.cli.app import CheckerContext
+
+
+def run(ctx: CheckerContext, spark_bam: bool = False, hadoop_bam: bool = False) -> None:
+    if spark_bam and not hadoop_bam:
+        expected, actual = ctx.truth, ctx.eager_verdict
+    elif hadoop_bam and not spark_bam:
+        expected, actual = ctx.truth, ctx.seqdoop_verdict
+    else:
+        expected, actual = ctx.eager_verdict, ctx.seqdoop_verdict
+    ctx.print_header_and_confusion(expected, actual)

@@ -1,0 +1,106 @@
+"""check-blocks: compare checkers' first read-start per BGZF block.
+
+A cheaper proxy than check-bam: mismatched blocks are weighted by the
+previous block's compressed size — the share of compressed positions that
+would resolve to a bad split (reference cli/.../check/blocks/
+CheckBlocks.scala:25-201).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from spark_bam_tpu.bgzf.index_blocks import blocks_metadata
+from spark_bam_tpu.cli.app import CheckerContext
+from spark_bam_tpu.core.pos import Pos
+from spark_bam_tpu.core.stats import Stats, format_bytes_binary
+
+
+def _next_read_start(view, verdict_flat, flat, max_read_size):
+    j = int(np.searchsorted(verdict_flat, flat))
+    if j < len(verdict_flat) and verdict_flat[j] - flat < max_read_size:
+        return Pos(*view.pos_of_flat(int(verdict_flat[j])))
+    return None
+
+
+def run(ctx: CheckerContext, spark_bam: bool = False, hadoop_bam: bool = False) -> None:
+    p = ctx.printer
+    if spark_bam and not hadoop_bam:
+        v1, v2 = ctx.truth, ctx.eager_verdict
+    elif hadoop_bam and not spark_bam:
+        v1, v2 = ctx.truth, ctx.seqdoop_verdict
+    else:
+        v1, v2 = ctx.eager_verdict, ctx.seqdoop_verdict
+    flat1 = np.flatnonzero(v1)
+    flat2 = np.flatnonzero(v2)
+
+    metas = list(blocks_metadata(ctx.path))
+    total_compressed = ctx.compressed_size
+    max_read_size = ctx.config.max_read_size
+
+    mismatches = []  # (block start, prev compressed size, pos1, pos2)
+    offsets_hist: dict[int | None, int] = {}
+    prev = None
+    for meta in metas:
+        flat = ctx.view.flat_of_pos(meta.start, 0)
+        pos1 = _next_read_start(ctx.view, flat1, flat, max_read_size)
+        pos2 = _next_read_start(ctx.view, flat2, flat, max_read_size)
+        offset = pos1.offset if pos1 is not None and pos1.block_pos == meta.start else None
+        offsets_hist[offset] = offsets_hist.get(offset, 0) + 1
+        if pos1 != pos2:
+            mismatches.append(
+                (meta.start, prev.compressed_size if prev else 1, pos1, pos2)
+            )
+        prev = meta
+
+    def print_offsets_info():
+        keys = set(offsets_hist)
+        n_empty = offsets_hist.get(None, 0)
+        if keys == {None, 0}:
+            p.echo(
+                "",
+                f"{offsets_hist[0]} blocks start with a read,"
+                f" {n_empty} blocks didn't contain a read",
+            )
+        elif keys == {0}:
+            p.echo("", "All blocks start with reads")
+        else:
+            stats = Stats.from_hist(
+                [(k, v) for k, v in offsets_hist.items() if k is not None],
+                rounded=True,
+            )
+            p.echo(
+                "",
+                f"Offsets of blocks' first reads ({n_empty} blocks didn't contain a read start):",
+                stats.show(),
+            )
+
+    if not mismatches:
+        p.echo(
+            f"First read-position matched in {len(metas)} BGZF blocks totaling"
+            f" {format_bytes_binary(total_compressed, include_b=True)} (compressed)"
+        )
+        print_offsets_info()
+    else:
+        bad_compressed = sum(m[1] for m in mismatches)
+        p.echo(
+            f"First read-position mismatched in {len(mismatches)} of {len(metas)} BGZF blocks",
+            "",
+            f"{bad_compressed} of {total_compressed}"
+            f" ({bad_compressed / total_compressed}) compressed positions"
+            " would lead to bad splits",
+        )
+        print_offsets_info()
+        p.echo("")
+
+        def show_pos(pos):
+            return str(pos) if pos is not None else "-"
+
+        p.print_limited(
+            [
+                f"{start} (prev block size: {prev_size}):\t{show_pos(p1)}\t{show_pos(p2)}"
+                for start, prev_size, p1, p2 in mismatches
+            ],
+            header=f"{len(mismatches)} mismatched blocks:",
+            truncated_header=lambda n: f"{n} of {len(mismatches)} mismatched blocks:",
+        )

@@ -1,0 +1,101 @@
+"""compare-splits: split-computation comparison across many BAMs (one task
+per BAM; reference cli/.../spark/compare/CompareSplits.scala:15-166)."""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass
+
+from spark_bam_tpu.cli.app import CheckerContext
+from spark_bam_tpu.cli.output import Printer
+from spark_bam_tpu.cli.splits_util import diff_splits, spark_bam_splits
+from spark_bam_tpu.core.config import Config
+from spark_bam_tpu.core.stats import Stats
+from spark_bam_tpu.load.hadoop import hadoop_bam_splits
+from spark_bam_tpu.parallel.executor import ParallelConfig, map_partitions
+
+
+@dataclass
+class PathResult:
+    path: str
+    our_ms: int
+    their_ms: int
+    num_ours: int
+    num_theirs: int
+    diffs: list  # [(side, Split)]
+
+
+def check_path(path: str, split_size: int, config: Config) -> PathResult:
+    ctx = CheckerContext(path, config)
+    t0 = time.perf_counter()
+    ours = spark_bam_splits(ctx, split_size)
+    our_ms = int((time.perf_counter() - t0) * 1000)
+    t0 = time.perf_counter()
+    theirs = hadoop_bam_splits(path, split_size, config=config)
+    their_ms = int((time.perf_counter() - t0) * 1000)
+    return PathResult(
+        path, our_ms, their_ms, len(ours), len(theirs), diff_splits(ours, theirs)
+    )
+
+
+def run(
+    bams_path,
+    p: Printer,
+    split_size: int,
+    config: Config = Config(),
+    parallel: ParallelConfig = ParallelConfig(),
+) -> None:
+    paths = [line.strip() for line in open(bams_path) if line.strip()]
+    results = map_partitions(
+        lambda path: check_path(path, split_size, config), paths, parallel
+    )
+
+    total_ours = sum(r.num_ours for r in results)
+    total_theirs = sum(r.num_theirs for r in results)
+    bad = [r for r in results if r.diffs]
+    if bad:
+        n_our_bad = sum(sum(1 for side, _ in r.diffs if side == "ours") for r in bad)
+        n_their_bad = sum(
+            sum(1 for side, _ in r.diffs if side == "theirs") for r in bad
+        )
+        p.echo(
+            f"{len(bad)} of {len(results)} BAMs' splits didn't match"
+            f" (totals: {total_ours}, {total_theirs};"
+            f" {n_our_bad}, {n_their_bad} unmatched)",
+            "",
+        )
+    else:
+        p.echo(
+            f"All {len(results)} BAMs' splits"
+            f" (totals: {total_ours}, {total_theirs}) matched!",
+            "",
+        )
+
+    p.echo("Total split-computation time:")
+    p.echo(f"\thadoop-bam:\t{sum(r.their_ms for r in results)}")
+    p.echo(f"\tspark-bam:\t{sum(r.our_ms for r in results)}")
+    p.echo("")
+
+    ratios = [
+        r.their_ms / r.our_ms if r.our_ms else float(r.their_ms) for r in results
+    ]
+    if len(ratios) > 1:
+        p.echo("Ratios:")
+        p.echo(Stats(ratios).show(), "")
+    else:
+        p.echo("Ratio: %s" % round(ratios[0], 2), "")
+
+    for r in bad:
+        n_ours = sum(1 for side, _ in r.diffs if side == "ours")
+        n_theirs = sum(1 for side, _ in r.diffs if side == "theirs")
+        p.echo(
+            f"\t{os.path.basename(r.path)}: {len(r.diffs)} splits differ"
+            f" (totals: {r.num_ours}, {r.num_theirs};"
+            f" mismatched: {n_ours}, {n_theirs}):"
+        )
+        for side, s in r.diffs:
+            indent = "\t\t\t" if side == "theirs" else "\t\t"
+            p.echo(f"{indent}{s.start}-{s.end}")
+        p.echo("")
+    p.echo("")

@@ -1,0 +1,76 @@
+"""compute-splits: compute (and compare) spark-bam / hadoop-bam splits
+(reference cli/.../spark/ComputeSplits.scala:17-151)."""
+
+from __future__ import annotations
+
+import time
+
+from spark_bam_tpu.cli.app import CheckerContext
+from spark_bam_tpu.cli.splits_util import diff_splits, spark_bam_splits
+from spark_bam_tpu.core.stats import Stats
+from spark_bam_tpu.load.hadoop import hadoop_bam_splits
+from spark_bam_tpu.load.splits import Split
+
+
+def _print_splits(p, splits: list[Split], ratio: float) -> None:
+    stats = Stats([s.length(ratio) for s in splits])
+    p.echo("Split-size distribution:", stats.show(), "")
+    p.print_limited(
+        [f"{s.start}-{s.end}" for s in splits],
+        header=f"{len(splits)} splits:",
+        truncated_header=lambda n: f"First {n} of {len(splits)} splits:",
+    )
+    p.echo("")
+
+
+def run(
+    ctx: CheckerContext,
+    split_size: int,
+    spark_bam: bool = False,
+    hadoop_bam: bool = False,
+) -> None:
+    p = ctx.printer
+    ratio = ctx.config.estimated_compression_ratio
+
+    def timed_spark():
+        t0 = time.perf_counter()
+        splits = spark_bam_splits(ctx, split_size)
+        return int((time.perf_counter() - t0) * 1000), splits
+
+    def timed_hadoop():
+        t0 = time.perf_counter()
+        splits = hadoop_bam_splits(ctx.path, split_size, config=ctx.config)
+        return int((time.perf_counter() - t0) * 1000), splits
+
+    if hadoop_bam and not spark_bam:
+        ms, splits = timed_hadoop()
+        p.echo(f"Get hadoop-bam splits: {ms}ms", "")
+        _print_splits(p, splits, ratio)
+    elif spark_bam and not hadoop_bam:
+        ms, splits = timed_spark()
+        p.echo(f"Get spark-bam splits: {ms}ms", "")
+        _print_splits(p, splits, ratio)
+    else:
+        our_ms, ours = timed_spark()
+        p.echo(f"Get spark-bam splits: {our_ms}ms")
+        their_ms, theirs = timed_hadoop()
+        p.echo(f"Get hadoop-bam splits: {their_ms}ms")
+        p.echo("")
+        diffs = diff_splits(ours, theirs)
+        if diffs:
+            rows = [
+                f"\t{s.start}-{s.end}" if side == "theirs" else f"{s.start}-{s.end}"
+                for side, s in diffs
+            ]
+            p.print_limited(
+                rows,
+                header=f"{len(diffs)} splits differ (totals: {len(ours)}, {len(theirs)}):",
+                truncated_header=lambda n: (
+                    f"First {n} of {len(diffs)} splits that differ"
+                    f" (totals: {len(ours)}, {len(theirs)}):"
+                ),
+            )
+            p.echo("")
+        else:
+            p.echo("All splits matched!", "")
+            _print_splits(p, ours, ratio)

@@ -1,0 +1,53 @@
+"""count-reads: count via spark-bam and hadoop-bam loaders, compare
+(reference cli/.../spark/compare/CountReads.scala:20-131)."""
+
+from __future__ import annotations
+
+import time
+import traceback
+
+from spark_bam_tpu.cli.output import Printer
+from spark_bam_tpu.core.config import Config
+from spark_bam_tpu.load.api import load_bam
+from spark_bam_tpu.load.hadoop import hadoop_bam_count
+
+
+def run(
+    path,
+    p: Printer,
+    split_size: int,
+    config: Config = Config(),
+    spark_bam_first: bool = False,
+    iterations: int = 1,
+) -> None:
+    def run_once():
+        t0 = time.perf_counter()
+        spark_count = load_bam(path, split_size, config).count()
+        spark_ms = int((time.perf_counter() - t0) * 1000)
+        try:
+            t0 = time.perf_counter()
+            hadoop_count = hadoop_bam_count(path, split_size, config)
+            hadoop_ms = int((time.perf_counter() - t0) * 1000)
+            return spark_ms, spark_count, hadoop_ms, hadoop_count, None
+        except Exception as e:
+            return spark_ms, spark_count, None, None, e
+
+    results = [run_once() for _ in range(max(iterations, 1))]
+    for spark_ms, spark_count, hadoop_ms, hadoop_count, error in results:
+        p.echo(f"spark-bam read-count time: {spark_ms}")
+        if error is None:
+            p.echo(f"hadoop-bam read-count time: {hadoop_ms}", "")
+            if spark_count == hadoop_count:
+                p.echo(f"Read counts matched: {spark_count}", "")
+            else:
+                p.echo(
+                    f"Read counts mismatched: {spark_count} via spark-bam,"
+                    f" {hadoop_count} via hadoop-bam",
+                    "",
+                )
+        else:
+            p.echo(
+                "",
+                f"spark-bam found {spark_count} reads, hadoop-bam threw exception:",
+                f"{type(error).__module__}.{type(error).__name__}: {error}",
+            )

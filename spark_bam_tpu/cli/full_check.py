@@ -1,0 +1,119 @@
+"""full-check: run the full checker everywhere; report which checks fail
+how often, highlighting "critical" (single-check) and two-check positions
+(reference cli/.../check/full/FullCheck.scala:31-311)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from spark_bam_tpu.check.flags import BIT, FLAG_NAMES
+from spark_bam_tpu.cli.app import CheckerContext
+
+_BIT0 = BIT["tooFewFixedBlockBytes"]
+
+
+def _counts_lines(counts: dict[str, int], hide_bit0: bool = False) -> list[str]:
+    items = [
+        (name, counts.get(name, 0))
+        for name in FLAG_NAMES
+        if counts.get(name, 0) and not (hide_bit0 and name == "tooFewFixedBlockBytes")
+    ]
+    if not items:
+        return []
+    items.sort(key=lambda kv: -kv[1])
+    name_w = max(len(n) for n, _ in items)
+    count_w = max(len(str(c)) for _, c in items)
+    return [f"{name:>{name_w}}:\t{str(count):>{count_w}}" for name, count in items]
+
+
+def _mask_counts(masks: np.ndarray) -> dict[str, int]:
+    out = {}
+    for i, name in enumerate(FLAG_NAMES):
+        c = int(((masks >> i) & 1).sum())
+        if c:
+            out[name] = c
+    return out
+
+
+def run(ctx: CheckerContext) -> None:
+    p = ctx.printer
+    res = ctx.eager_result
+
+    if ctx.has_records_index:
+        expected = ctx.truth
+        mismatch = np.flatnonzero(res.verdict != expected)
+        if len(mismatch):
+            i = int(mismatch[0])
+            kind = "positive" if res.verdict[i] else "negative"
+            raise RuntimeError(
+                f"False {kind} at {ctx.view.pos_of_flat(i)}"
+            )
+        ctx.print_header_and_confusion(expected, res.verdict)
+        p.echo("")
+
+    masks = res.fail_mask
+    rb = res.reads_before
+    # Exclude successes and the bare at-EOF marker (FullCheck.scala:144-147).
+    considered = (masks != 0) & ~((masks == _BIT0) & (rb == 0))
+    popcount = np.zeros(len(masks), dtype=np.int32)
+    for i in range(len(FLAG_NAMES)):
+        popcount += (masks >> i) & 1
+    num_fields = popcount + (rb > 0)
+
+    def bucket(k: int) -> np.ndarray:
+        return np.flatnonzero(considered & (num_fields == k))
+
+    ones = bucket(1)
+    if len(ones) == 0:
+        p.echo("No positions where only one check failed")
+    else:
+        p.echo("Critical error counts (true negatives where only one check failed):")
+        p.echo(*("\t" + l for l in _counts_lines(_mask_counts(masks[ones]))))
+        p.echo("")
+        p.print_limited(
+            [str(ctx.annotate(int(i))) for i in ones[: max(p.limit, 1)]],
+            total=len(ones),
+            header=f"{len(ones)} critical positions:",
+            truncated_header=lambda n: f"{n} of {len(ones)} critical positions:",
+        )
+
+    p.echo("")
+
+    twos = bucket(2)
+    if len(twos) == 0:
+        p.echo("No positions where exactly two checks failed", "")
+    else:
+        p.print_limited(
+            [str(ctx.annotate(int(i))) for i in twos[: max(p.limit, 1)]],
+            total=len(twos),
+            header=f"{len(twos)} positions where exactly two checks failed:",
+            truncated_header=lambda n: (
+                f"{n} of {len(twos)} positions where exactly two checks failed:"
+            ),
+        )
+        p.echo("")
+        combo_hist: dict[int, int] = {}
+        for m in masks[twos]:
+            combo_hist[int(m)] = combo_hist.get(int(m), 0) + 1
+
+        def combo_str(mask: int) -> str:
+            return ",".join(n for i, n in enumerate(FLAG_NAMES) if mask & (1 << i))
+
+        top = sorted(combo_hist.items(), key=lambda kv: -kv[1])
+        if top[0][1] > 1:
+            with p.indent():
+                p.print_limited(
+                    [f"{count}:\t{combo_str(mask)}" for mask, count in top],
+                    header="Histogram:",
+                    truncated_header=lambda n: "Histogram:",
+                )
+            p.echo("")
+        with p.indent():
+            p.echo("Per-flag totals:")
+            p.echo(*("\t" + l for l in _counts_lines(_mask_counts(masks[twos]))))
+        p.echo("")
+
+    all_considered = np.flatnonzero(considered)
+    p.echo("Total error counts:")
+    p.echo(*("\t" + l for l in _counts_lines(_mask_counts(masks[all_considered]), hide_bit0=True)))
+    p.echo("")

@@ -1,0 +1,175 @@
+"""spark-bam-tpu CLI: the reference's 10 subcommands
+(cli/.../bam/Main.scala:21-41), same names and comparable output formats.
+
+    spark-bam-tpu check-bam [-s|-u] [-m SIZE] [-l LIMIT] [-o OUT] PATH
+    spark-bam-tpu check-blocks ...
+    spark-bam-tpu full-check ...
+    spark-bam-tpu compute-splits [-s|-u] [-m SIZE] PATH
+    spark-bam-tpu compare-splits [-m SIZE] BAMS-FILE
+    spark-bam-tpu count-reads [-m SIZE] [-n N] [-s] PATH
+    spark-bam-tpu time-load [-m SIZE] PATH
+    spark-bam-tpu index-blocks PATH
+    spark-bam-tpu index-records PATH
+    spark-bam-tpu htsjdk-rewrite IN OUT
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from spark_bam_tpu.core.config import Config, parse_bytes
+
+
+def _add_common(sub, split_default=None):
+    sub.add_argument("-m", "--max-split-size", default=split_default,
+                     help="split size (byte shorthand like 2MB ok)")
+    sub.add_argument("-l", "--print-limit", type=int, default=10)
+    sub.add_argument("-o", "--out", default=None, help="write output to file")
+    sub.add_argument("-w", "--warn", action="store_true", help="root log level WARN")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="spark-bam-tpu", description="TPU-native parallel BAM toolkit"
+    )
+    sp = ap.add_subparsers(dest="command", required=True)
+
+    for name in ("check-bam", "check-blocks"):
+        sub = sp.add_parser(name)
+        _add_common(sub)
+        sub.add_argument("-s", "--spark-bam", action="store_true",
+                         help="score the eager checker against the .records index")
+        sub.add_argument("-u", "--upstream", action="store_true",
+                         help="score the seqdoop checker against the .records index")
+        sub.add_argument("path")
+
+    sub = sp.add_parser("full-check")
+    _add_common(sub)
+    sub.add_argument("path")
+
+    sub = sp.add_parser("compute-splits")
+    _add_common(sub)
+    sub.add_argument("-s", "--spark-bam", action="store_true")
+    sub.add_argument("-u", "--upstream", action="store_true")
+    sub.add_argument("path")
+
+    sub = sp.add_parser("compare-splits")
+    _add_common(sub)
+    sub.add_argument("bams", help="file containing one BAM path per line")
+
+    sub = sp.add_parser("count-reads")
+    _add_common(sub)
+    sub.add_argument("-s", "--spark-bam-first", action="store_true")
+    sub.add_argument("-n", "--num-iterations", type=int, default=1)
+    sub.add_argument("path")
+
+    sub = sp.add_parser("time-load")
+    _add_common(sub)
+    sub.add_argument("path")
+
+    sub = sp.add_parser("index-blocks")
+    sub.add_argument("-o", "--out", default=None)
+    sub.add_argument("path")
+
+    sub = sp.add_parser("index-records")
+    sub.add_argument("-o", "--out", default=None)
+    sub.add_argument("-t", "--throw-on-truncation", action="store_true")
+    sub.add_argument("path")
+
+    sub = sp.add_parser("htsjdk-rewrite", aliases=["rewrite"])
+    sub.add_argument("-o", "--out", default=None, help="write output to file")
+    sub.add_argument("-b", "--block-payload", default="65280")
+    sub.add_argument("-i", "--index", action="store_true",
+                     help="also write .blocks/.records sidecars for the output")
+    sub.add_argument("in_path")
+    sub.add_argument("out_path")
+
+    return ap
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    from spark_bam_tpu.cli.output import Printer
+
+    out = open(args.out, "w") if getattr(args, "out", None) else None
+    p = Printer(out=out, limit=getattr(args, "print_limit", 10))
+    config = Config.from_env()
+    split = getattr(args, "max_split_size", None)
+    if split is not None:
+        config = config.replace(split_size=parse_bytes(split))
+
+    try:
+        cmd = args.command
+        if cmd in ("check-bam", "check-blocks", "full-check", "compute-splits",
+                   "time-load"):
+            from spark_bam_tpu.cli.app import CheckerContext
+
+            ctx = CheckerContext(args.path, config, p)
+            if cmd == "check-bam":
+                from spark_bam_tpu.cli import check_bam
+
+                check_bam.run(ctx, args.spark_bam, args.upstream)
+            elif cmd == "check-blocks":
+                from spark_bam_tpu.cli import check_blocks
+
+                check_blocks.run(ctx, args.spark_bam, args.upstream)
+            elif cmd == "full-check":
+                from spark_bam_tpu.cli import full_check
+
+                full_check.run(ctx)
+            elif cmd == "compute-splits":
+                from spark_bam_tpu.cli import compute_splits
+
+                compute_splits.run(
+                    ctx,
+                    config.split_size_or(Config.LOAD_SPLIT_SIZE_DEFAULT),
+                    args.spark_bam,
+                    args.upstream,
+                )
+            elif cmd == "time-load":
+                from spark_bam_tpu.cli import time_load
+
+                time_load.run(ctx, config.split_size_or(Config.LOAD_SPLIT_SIZE_DEFAULT))
+        elif cmd == "compare-splits":
+            from spark_bam_tpu.cli import compare_splits
+
+            compare_splits.run(
+                args.bams, p, config.split_size_or(Config.LOAD_SPLIT_SIZE_DEFAULT),
+                config,
+            )
+        elif cmd == "count-reads":
+            from spark_bam_tpu.cli import count_reads
+
+            count_reads.run(
+                args.path, p, config.split_size_or(Config.LOAD_SPLIT_SIZE_DEFAULT),
+                config, args.spark_bam_first, args.num_iterations,
+            )
+        elif cmd == "index-blocks":
+            from spark_bam_tpu.bgzf.index_blocks import index_blocks
+
+            out_path, count = index_blocks(args.path, args.out)
+            print(f"Wrote {count} blocks to {out_path}", file=sys.stderr)
+        elif cmd == "index-records":
+            from spark_bam_tpu.bam.index_records import index_records
+
+            out_path, count = index_records(
+                args.path, args.out, strict=args.throw_on_truncation
+            )
+            print(f"Wrote {count} records to {out_path}", file=sys.stderr)
+        elif cmd in ("htsjdk-rewrite", "rewrite"):
+            from spark_bam_tpu.cli import rewrite
+
+            rewrite.run(
+                args.in_path, args.out_path, p,
+                block_payload=parse_bytes(args.block_payload),
+                reindex=args.index,
+            )
+        return 0
+    finally:
+        if out:
+            out.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

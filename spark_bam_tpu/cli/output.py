@@ -1,0 +1,58 @@
+"""CLI output helpers: echo / limited sample printing / indentation.
+
+Mirrors the reference's hammerlab print utils semantics: sampled lists print
+``{total} things:`` when everything fits the print limit, else
+``First {limit} of {total} things:`` followed by a tab-ellipsis line.
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+class Printer:
+    def __init__(self, out=None, limit: int = 10):
+        self.out = out or sys.stdout
+        self.limit = limit
+        self._indent = 0
+
+    def echo(self, *lines: str) -> None:
+        for line in lines:
+            for part in str(line).split("\n"):
+                self.out.write(("\t" * self._indent + part + "\n") if part else "\n")
+
+    def indent(self):
+        printer = self
+
+        class _Ctx:
+            def __enter__(self):
+                printer._indent += 1
+
+            def __exit__(self, *exc):
+                printer._indent -= 1
+
+        return _Ctx()
+
+    def print_limited(
+        self,
+        items: list,
+        total: int | None = None,
+        header: str | None = None,
+        truncated_header=None,
+        item_indent: int = 1,
+    ) -> None:
+        """Print up to ``limit`` items, each tab-indented, with the
+        appropriate header and an ellipsis line when truncated."""
+        total = total if total is not None else len(items)
+        if self.limit and total > self.limit:
+            shown = items[: self.limit]
+            if truncated_header:
+                self.echo(truncated_header(len(shown)))
+            for item in shown:
+                self.echo("\t" * item_indent + str(item))
+            self.echo("\t…")
+        else:
+            if header:
+                self.echo(header)
+            for item in items[:total]:
+                self.echo("\t" * item_indent + str(item))

@@ -1,0 +1,33 @@
+"""htsjdk-rewrite analog: round-trip a BAM through our writer so record
+starts stop being block-aligned — manufactures adversarial inputs for split
+testing (reference cli/.../rewrite/HTSJDKRewrite.scala:347-418)."""
+
+from __future__ import annotations
+
+from spark_bam_tpu.bam.header import read_header
+from spark_bam_tpu.bam.index_records import index_records
+from spark_bam_tpu.bam.iterators import RecordStream
+from spark_bam_tpu.bam.writer import write_bam
+from spark_bam_tpu.bgzf.index_blocks import index_blocks
+from spark_bam_tpu.cli.output import Printer
+from spark_bam_tpu.core.channel import open_channel
+
+
+def run(
+    in_path,
+    out_path,
+    p: Printer,
+    block_payload: int = 0xFF00,
+    reindex: bool = False,
+) -> None:
+    with open_channel(in_path) as ch:
+        stream = RecordStream.open(ch)
+        header = stream.header
+        count = write_bam(
+            out_path, header, (rec for _, rec in stream), block_payload=block_payload
+        )
+    p.echo(f"Wrote {count} reads to {out_path}")
+    if reindex:
+        _, n_blocks = index_blocks(out_path)
+        _, n_records = index_records(out_path)
+        p.echo(f"Indexed {n_blocks} blocks, {n_records} records")

@@ -1,0 +1,64 @@
+"""Split computation for the CLI comparison commands.
+
+``spark_bam_splits`` resolves every raw split boundary through the
+vectorized eager engine of a ``CheckerContext`` (one flag pass serves all
+boundaries); ends tile to the next start (reference
+cli/.../spark/LoadReads.scala:164-174, CanLoadBam.scala:262-274).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from spark_bam_tpu.bgzf.find_block_start import find_block_start
+from spark_bam_tpu.cli.app import CheckerContext
+from spark_bam_tpu.core.channel import open_channel
+from spark_bam_tpu.core.pos import Pos
+from spark_bam_tpu.load.splits import Split
+
+
+def spark_bam_splits(ctx: CheckerContext, split_size: int) -> list[Split]:
+    size = ctx.compressed_size
+    true_flat = ctx.true_flat_eager
+    starts: list[Pos] = []
+    with open_channel(ctx.path) as ch:
+        for s in range(0, size, split_size):
+            e = min(s + split_size, size)
+            block = find_block_start(
+                ch, s, ctx.config.bgzf_blocks_to_check, path=ctx.path
+            )
+            if block >= e:
+                continue
+            flat = ctx.view.flat_of_pos(block, 0)
+            j = int(np.searchsorted(true_flat, flat))
+            if j >= len(true_flat):
+                continue
+            if true_flat[j] - flat >= ctx.config.max_read_size:
+                continue
+            start = Pos(*ctx.view.pos_of_flat(int(true_flat[j])))
+            if not starts or starts[-1] != start:
+                starts.append(start)
+    eof = Pos(size, 0)
+    return [
+        Split(start, starts[i + 1] if i + 1 < len(starts) else eof)
+        for i, start in enumerate(starts)
+    ]
+
+
+def diff_splits(ours: list[Split], theirs: list[Split]) -> list[tuple[str, Split]]:
+    """Ordered symmetric difference keyed on split *start* (the reference's
+    orMerge on start Pos, ComputeSplits.scala:111-121). Tagged 'ours'/'theirs'."""
+    our_by_start = {s.start: s for s in ours}
+    their_by_start = {s.start: s for s in theirs}
+    out: list[tuple[str, Split]] = []
+    for start in sorted(set(our_by_start) | set(their_by_start)):
+        o, t = our_by_start.get(start), their_by_start.get(start)
+        if o is not None and t is not None:
+            continue
+        if t is not None:
+            out.append(("theirs", t))
+        else:
+            out.append(("ours", o))
+    return out

@@ -1,0 +1,63 @@
+"""time-load: collect each partition's first read via both loaders; isolates
+split-computation latency (reference cli/.../spark/compare/TimeLoad.scala)."""
+
+from __future__ import annotations
+
+import time
+
+from spark_bam_tpu.bam.record import BamRecord
+from spark_bam_tpu.cli.app import CheckerContext
+from spark_bam_tpu.cli.splits_util import spark_bam_splits
+from spark_bam_tpu.load.hadoop import (
+    hadoop_bam_read_split,
+    hadoop_bam_splits,
+)
+
+
+def run(ctx: CheckerContext, split_size: int) -> None:
+    p = ctx.printer
+
+    t0 = time.perf_counter()
+    our_splits = spark_bam_splits(ctx, split_size)
+    our_first = []
+    for split in our_splits:
+        flat = ctx.view.flat_of_pos(split.start.block_pos, split.start.offset)
+        rec, _ = BamRecord.decode(ctx.view.data, flat)
+        our_first.append(rec.read_name)
+    our_ms = int((time.perf_counter() - t0) * 1000)
+    p.echo(f"spark-bam first-read collection time: {our_ms}")
+
+    try:
+        t0 = time.perf_counter()
+        their_splits = hadoop_bam_splits(ctx.path, split_size, config=ctx.config)
+        their_first = []
+        for split in their_splits:
+            for _, rec in hadoop_bam_read_split(ctx.view, len(ctx.contigs), split):
+                their_first.append(rec.read_name)
+                break
+        their_ms = int((time.perf_counter() - t0) * 1000)
+    except Exception as e:
+        p.echo(
+            "",
+            f"spark-bam collected {len(our_first)} partitions' first-reads",
+            "hadoop-bam threw an exception:",
+            f"{type(e).__module__}.{type(e).__name__}: {e}",
+        )
+        return
+
+    p.echo(f"hadoop-bam first-read collection time: {their_ms}", "")
+    ours, theirs = set(our_first), set(their_first)
+    if ours == theirs:
+        p.echo(f"All {len(our_splits)} partition-start reads matched", "")
+    else:
+        only_ours = sorted(ours - theirs)
+        only_theirs = sorted(theirs - ours)
+        p.echo(
+            f"{len(only_ours)} spark-bam-only reads, {len(only_theirs)} hadoop-bam-only:"
+        )
+        for name in only_ours:
+            p.echo(f"\t{name}")
+        p.echo("")
+        for name in only_theirs:
+            p.echo(f"\t\t{name}")
+        p.echo("")

@@ -145,8 +145,9 @@ def _compute_flags(p, lengths, num_contigs, n):
     empty_seq = empty_ok & (seq_len == 0)
     empty_cig = empty_ok & (n_cigar == 0)
     some_empty = empty_seq | empty_cig
-    F = F | jnp.where(some_empty & empty_seq, _I32(BIT["emptyMappedSeq"]), _I32(0))
-    F = F | jnp.where(some_empty & empty_cig, _I32(BIT["emptyMappedCigar"]), _I32(0))
+    # Swapped on purpose: reference quirk (see check/vectorized.py).
+    F = F | jnp.where(some_empty & empty_seq, _I32(BIT["emptyMappedCigar"]), _I32(0))
+    F = F | jnp.where(some_empty & empty_cig, _I32(BIT["emptyMappedSeq"]), _I32(0))
 
     few_fixed = idx > n - 36
     F = jnp.where(few_fixed, _I32(BIT["tooFewFixedBlockBytes"]), F)

@@ -1,0 +1,179 @@
+"""End-to-end CLI golden-output tests (the reference's MainSuite pattern:
+full stdout compared against checked-in goldens, timing lines via regex)."""
+
+import re
+from pathlib import Path
+
+import pytest
+
+from spark_bam_tpu.cli.main import main
+
+GOLDEN = Path("/root/reference/cli/src/test/resources/output")
+
+
+def run_cli(args, tmp_path, name="out.txt") -> str:
+    out = tmp_path / name
+    assert main(args + ["-o", str(out)]) == 0
+    return out.read_text()
+
+
+def test_check_bam_1bam_golden(bam1, tmp_path):
+    got = run_cli(["check-bam", str(bam1)], tmp_path)
+    assert got == (GOLDEN / "check-bam" / "1.bam").read_text()
+
+
+def test_full_check_1bam_golden(bam1, tmp_path):
+    got = run_cli(["full-check", str(bam1)], tmp_path)
+    assert got == (GOLDEN / "full-check" / "1.bam").read_text()
+
+
+def test_full_check_2bam_golden(bam2, tmp_path):
+    got = run_cli(["full-check", str(bam2)], tmp_path)
+    assert got == (GOLDEN / "full-check" / "2.bam").read_text()
+
+
+def test_check_blocks_1bam_upstream(bam1, tmp_path):
+    got = run_cli(["check-blocks", "-u", str(bam1)], tmp_path)
+    assert got == (
+        "First read-position mismatched in 1 of 25 BGZF blocks\n"
+        "\n"
+        "25871 of 597482 (0.043300049206503294) compressed positions would lead to bad splits\n"
+        "\n"
+        "Offsets of blocks' first reads (0 blocks didn't contain a read start):\n"
+        "N: 25, μ/σ: 2004/8950, med/mad: 191/110\n"
+        " elems: 1 25 28 39 42 45 81 112 136 143 … 268 270 271 287 301 304 311 312 316 45846\n"
+        "   5:\t8\n"
+        "  10:\t27\n"
+        "  25:\t63\n"
+        "  50:\t191\n"
+        "  75:\t294\n"
+        "  90:\t314\n"
+        "  95:\t32187\n"
+        "\n"
+        "1 mismatched blocks:\n"
+        "\t239479 (prev block size: 25871):\t239479:312\t239479:311\n"
+    )
+
+
+def test_check_blocks_2bam(bam2, tmp_path):
+    got = run_cli(["check-blocks", str(bam2)], tmp_path)
+    assert got.startswith(
+        "First read-position matched in 25 BGZF blocks totaling 519KB (compressed)\n"
+        "\n"
+        "Offsets of blocks' first reads (0 blocks didn't contain a read start):\n"
+        "N: 25, μ/σ: 604/1049, med/mad: 470/152\n"
+    )
+
+
+def test_compute_splits_eager_230k(bam1, tmp_path):
+    got = run_cli(["compute-splits", "-s", "-m", "230k", str(bam1)], tmp_path)
+    lines = got.splitlines()
+    assert re.fullmatch(r"Get spark-bam splits: \d+ms", lines[0])
+    assert lines[2:] == [
+        "Split-size distribution:",
+        "N: 3, μ/σ: 194067/57877.4, med/mad: 224301/20521",
+        " elems: 224301 244822 113078",
+        "sorted: 113078 224301 244822",
+        "",
+        "3 splits:",
+        "\t0:45846-239479:312",
+        "\t239479:312-484396:25",
+        "\t484396:25-597482:0",
+        "",
+    ]
+
+
+def test_compute_splits_seqdoop_230k(bam1, tmp_path):
+    got = run_cli(["compute-splits", "-u", "-m", "230k", str(bam1)], tmp_path)
+    lines = got.splitlines()
+    assert re.fullmatch(r"Get hadoop-bam splits: \d+ms", lines[0])
+    assert lines[7:] == [
+        "3 splits:",
+        "\t0:45846-235520:65535",
+        "\t239479:311-471040:65535",
+        "\t484396:25-597482:65535",
+        "",
+    ]
+
+
+def test_compute_splits_compare_230k(bam1, tmp_path):
+    got = run_cli(["compute-splits", "-m", "230k", str(bam1)], tmp_path)
+    lines = got.splitlines()
+    assert lines[3:] == [
+        "2 splits differ (totals: 3, 3):",
+        "\t\t239479:311-471040:65535",
+        "\t239479:312-484396:25",
+        "",
+    ]
+
+
+def test_compute_splits_compare_240k_match(bam1, tmp_path):
+    got = run_cli(["compute-splits", "-m", "240k", str(bam1)], tmp_path)
+    assert "All splits matched!" in got
+    assert "N: 3, μ/σ: 194067/74433.1, med/mad: 244941/3497" in got
+
+
+def test_count_reads_matched(bam1, tmp_path):
+    got = run_cli(["count-reads", "-m", "240k", str(bam1)], tmp_path)
+    lines = got.splitlines()
+    assert re.fullmatch(r"spark-bam read-count time: \d+", lines[0])
+    assert re.fullmatch(r"hadoop-bam read-count time: \d+", lines[1])
+    assert lines[2] == ""
+    assert lines[3] == "Read counts matched: 4917"
+
+
+def test_count_reads_hadoop_fails(bam1, tmp_path):
+    # At 230k the hadoop-bam split start is the 239479:311 false positive;
+    # decoding from it must fail SAM validation.
+    got = run_cli(["count-reads", "-m", "230k", str(bam1)], tmp_path)
+    assert "spark-bam found 4917 reads, hadoop-bam threw exception:" in got
+    assert "SAM validation error" in got
+
+
+def test_time_load(bam1, tmp_path):
+    got = run_cli(["time-load", "-m", "240k", str(bam1)], tmp_path)
+    assert "All 3 partition-start reads matched" in got
+    got = run_cli(["time-load", "-m", "230k", str(bam1)], tmp_path, "out2.txt")
+    assert "spark-bam collected 3 partitions' first-reads" in got
+    assert "hadoop-bam threw an exception:" in got
+
+
+def test_compare_splits(bam1, bam2, tmp_path):
+    bams = tmp_path / "bams.txt"
+    bams.write_text(f"{bam1}\n{bam2}\n")
+    got = run_cli(["compare-splits", "-m", "230k", str(bams)], tmp_path)
+    lines = got.splitlines()
+    assert lines[0] == (
+        "1 of 2 BAMs' splits didn't match (totals: 6, 6; 1, 1 unmatched)"
+    )
+    assert "\t1.bam: 2 splits differ (totals: 3, 3; mismatched: 1, 1):" in lines
+    assert "\t\t\t239479:311-471040:65535" in lines
+    assert "\t\t239479:312-484396:25" in lines
+
+
+def test_compare_splits_all_match(bam2, tmp_path):
+    bams = tmp_path / "bams.txt"
+    bams.write_text(f"{bam2}\n")
+    got = run_cli(["compare-splits", "-m", "100k", str(bams)], tmp_path)
+    assert got.splitlines()[0] == "All 1 BAMs' splits (totals: 6, 6) matched!"
+
+
+def test_index_commands(bam2, tmp_path, capsys):
+    out_blocks = tmp_path / "b.blocks"
+    out_records = tmp_path / "r.records"
+    assert main(["index-blocks", "-o", str(out_blocks), str(bam2)]) == 0
+    assert main(["index-records", "-o", str(out_records), str(bam2)]) == 0
+    assert out_blocks.read_text() == Path(str(bam2) + ".blocks").read_text()
+    assert out_records.read_text() == Path(str(bam2) + ".records").read_text()
+
+
+def test_rewrite_roundtrip(bam2, tmp_path):
+    out_bam = tmp_path / "rewritten.bam"
+    got = run_cli(
+        ["htsjdk-rewrite", "-b", "5000", "-i", str(bam2), str(out_bam)], tmp_path
+    )
+    assert f"Wrote 2500 reads to {out_bam}" in got
+    # The rewritten file loads identically.
+    from spark_bam_tpu.load.api import load_bam
+
+    assert load_bam(out_bam, split_size=1_000_000).count() == 2500
