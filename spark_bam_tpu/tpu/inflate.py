@@ -1,0 +1,92 @@
+"""BGZF inflate feeding the device: host-parallel path + Pallas plan.
+
+Today's production path inflates on host (zlib releases the GIL; a thread
+pool saturates cores — bgzf/flat.py) and ships flat windows to HBM. That is
+already off the critical path for the checker speedup: SURVEY.md §7 "the
+checker/parser speedup does not depend on it [device DEFLATE]".
+
+``InflatePipeline`` overlaps the three stages per window —
+read+inflate (host threads) → H2D transfer → device kernel — double-buffered
+so the device never waits on the host for steady-state streams.
+
+Pallas DEFLATE design (the round-2+ kernel, SURVEY §7 hard-part #1):
+bit-serial Huffman decoding with data-dependent back-references resists
+lane-parallelism, so the plan is block-parallel, not bit-parallel:
+
+1. one BGZF block (≤64 KiB uncompressed) per grid step; many blocks in
+   flight across grid steps — throughput from pipelining, not SIMD;
+2. per block, a two-phase decode in VMEM:
+   a. Huffman phase: build the code tables from the dynamic header in SMEM,
+      then decode symbols with a 12-bit lookup table (fits VMEM); emit
+      (literal | (dist, len)) tuples to a VMEM staging buffer;
+   b. copy phase: resolve LZ77 back-references with `lax.while_loop` over
+      the staging buffer — references reach ≤32 KiB back, inside the block's
+      own VMEM scratch, so no HBM round-trips;
+3. CRC32 validation on device (slice-by-8 table in VMEM) so corrupt blocks
+   are flagged without host involvement.
+
+Keeping host zlib as the correctness fallback is permanent policy: the
+checker consumes identical flat windows from either producer.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from typing import Iterator
+
+import numpy as np
+
+from spark_bam_tpu.bgzf.block import Metadata
+from spark_bam_tpu.bgzf.flat import FlatView, inflate_blocks
+from spark_bam_tpu.core.channel import open_channel
+
+
+def window_plan(metas: list[Metadata], window_uncompressed: int) -> list[list[Metadata]]:
+    """Group consecutive blocks into ≈window-sized uncompressed runs."""
+    groups: list[list[Metadata]] = []
+    cur: list[Metadata] = []
+    size = 0
+    for m in metas:
+        if cur and size + m.uncompressed_size > window_uncompressed:
+            groups.append(cur)
+            cur, size = [], 0
+        cur.append(m)
+        size += m.uncompressed_size
+    if cur:
+        groups.append(cur)
+    return groups
+
+
+class InflatePipeline:
+    """Double-buffered host-inflate → device-window stream."""
+
+    def __init__(self, path, window_uncompressed: int = 64 << 20, threads: int = 8):
+        from spark_bam_tpu.bgzf.index_blocks import blocks_metadata
+
+        self.path = path
+        self.metas = list(blocks_metadata(path))
+        self.total = sum(m.uncompressed_size for m in self.metas)
+        self.groups = window_plan(self.metas, window_uncompressed)
+        self.threads = threads
+
+    def __iter__(self) -> Iterator[FlatView]:
+        ch = open_channel(self.path)
+        pool = ThreadPoolExecutor(max_workers=1)  # pipeline stage, not fan-out
+
+        def produce(group):
+            return inflate_blocks(
+                ch, group, file_total=self.total, threads=self.threads
+            )
+
+        try:
+            nxt = pool.submit(produce, self.groups[0]) if self.groups else None
+            for i, group in enumerate(self.groups):
+                view = nxt.result()
+                if i + 1 < len(self.groups):
+                    nxt = pool.submit(produce, self.groups[i + 1])
+                if i == len(self.groups) - 1:
+                    view.at_eof = True
+                yield view
+        finally:
+            pool.shutdown(wait=False)
+            ch.close()
