@@ -1,0 +1,155 @@
+"""Build + ctypes-load the native runtime (g++ → shared object, cached).
+
+No pybind11 in this environment, so the binding is plain ctypes over an
+``extern "C"`` surface. The build is lazy and cached next to the source;
+everything degrades gracefully to the NumPy/Python paths when a compiler is
+unavailable (``load_native()`` returns None).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import logging
+import os
+import subprocess
+from pathlib import Path
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+_SRC = Path(__file__).parent / "spark_bam_native.cpp"
+_LIB_CACHE: list = []  # [lib or None], filled once
+
+
+def _build(src: Path, out: Path) -> bool:
+    cmd = [
+        "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+        str(src), "-o", str(out), "-lz",
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True)
+        return True
+    except (subprocess.CalledProcessError, FileNotFoundError) as e:
+        log.warning("native build failed (%s); using Python fallbacks", e)
+        return False
+
+
+def load_native():
+    """The loaded shared library with argtypes set, or None."""
+    if _LIB_CACHE:
+        return _LIB_CACHE[0]
+    digest = hashlib.sha256(_SRC.read_bytes()).hexdigest()[:16]
+    out = _SRC.parent / f"_spark_bam_native_{digest}.so"
+    if not out.exists() and not _build(_SRC, out):
+        _LIB_CACHE.append(None)
+        return None
+    try:
+        lib = ctypes.CDLL(str(out))
+    except OSError as e:
+        log.warning("native load failed (%s); using Python fallbacks", e)
+        _LIB_CACHE.append(None)
+        return None
+
+    c_u8p = ctypes.POINTER(ctypes.c_uint8)
+    c_i64p = ctypes.POINTER(ctypes.c_int64)
+    c_i32p = ctypes.POINTER(ctypes.c_int32)
+
+    lib.sbt_inflate_blocks.restype = ctypes.c_long
+    lib.sbt_inflate_blocks.argtypes = [
+        c_u8p, c_i64p, c_i64p, ctypes.c_int64, c_u8p, c_i64p, c_i64p,
+    ]
+    lib.sbt_eager_check.restype = None
+    lib.sbt_eager_check.argtypes = [
+        c_u8p, ctypes.c_int64, c_i64p, ctypes.c_int64,
+        c_i32p, ctypes.c_int32, ctypes.c_int32, c_u8p,
+    ]
+    lib.sbt_find_record_start.restype = ctypes.c_int64
+    lib.sbt_find_record_start.argtypes = [
+        c_u8p, ctypes.c_int64, ctypes.c_int64,
+        c_i32p, ctypes.c_int32, ctypes.c_int32, ctypes.c_int64,
+    ]
+    _LIB_CACHE.append(lib)
+    return lib
+
+
+def _ptr(arr: np.ndarray, ctype):
+    return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def eager_check_native(
+    buf: np.ndarray,
+    candidates: np.ndarray,
+    contig_lengths: np.ndarray,
+    reads_to_check: int = 10,
+) -> np.ndarray | None:
+    """Native eager verdicts for candidate offsets; None if unavailable."""
+    lib = load_native()
+    if lib is None:
+        return None
+    buf = np.ascontiguousarray(buf, dtype=np.uint8)
+    cand = np.ascontiguousarray(candidates, dtype=np.int64)
+    lens = np.ascontiguousarray(contig_lengths, dtype=np.int32)
+    out = np.zeros(len(cand), dtype=np.uint8)
+    lib.sbt_eager_check(
+        _ptr(buf, ctypes.c_uint8), len(buf),
+        _ptr(cand, ctypes.c_int64), len(cand),
+        _ptr(lens, ctypes.c_int32), len(lens),
+        reads_to_check, _ptr(out, ctypes.c_uint8),
+    )
+    return out.astype(bool)
+
+
+def find_record_start_native(
+    buf: np.ndarray,
+    start: int,
+    contig_lengths: np.ndarray,
+    reads_to_check: int = 10,
+    max_read_size: int = 10_000_000,
+) -> int | None:
+    """First boundary at/after start (flat offset), -1 if none; None if the
+    native library is unavailable."""
+    lib = load_native()
+    if lib is None:
+        return None
+    buf = np.ascontiguousarray(buf, dtype=np.uint8)
+    lens = np.ascontiguousarray(contig_lengths, dtype=np.int32)
+    return int(
+        lib.sbt_find_record_start(
+            _ptr(buf, ctypes.c_uint8), len(buf), start,
+            _ptr(lens, ctypes.c_int32), len(lens),
+            reads_to_check, max_read_size,
+        )
+    )
+
+
+def inflate_blocks_native(
+    comp: np.ndarray,
+    offsets: np.ndarray,
+    lengths: np.ndarray,
+    out_lengths: np.ndarray,
+) -> np.ndarray | None:
+    """Batched raw-DEFLATE inflate; returns the flat output buffer or None."""
+    lib = load_native()
+    if lib is None:
+        return None
+    comp = np.ascontiguousarray(comp, dtype=np.uint8)
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    lengths = np.ascontiguousarray(lengths, dtype=np.int64)
+    out_lengths = np.ascontiguousarray(out_lengths, dtype=np.int64)
+    out_offsets = np.zeros(len(out_lengths), dtype=np.int64)
+    np.cumsum(out_lengths[:-1], out=out_offsets[1:])
+    out = np.empty(int(out_lengths.sum()), dtype=np.uint8)
+    rc = lib.sbt_inflate_blocks(
+        _ptr(comp, ctypes.c_uint8),
+        _ptr(offsets, ctypes.c_int64),
+        _ptr(lengths, ctypes.c_int64),
+        len(offsets),
+        _ptr(out, ctypes.c_uint8),
+        _ptr(out_offsets, ctypes.c_int64),
+        _ptr(out_lengths, ctypes.c_int64),
+    )
+    if rc != 0:
+        raise IOError(f"native inflate failed at block {rc - 1}")
+    return out

@@ -1,0 +1,143 @@
+// Native runtime for spark-bam-tpu: the CPU hot loops that stay off-device.
+//
+// The reference's only native touchpoint is the JVM's zlib binding
+// (SURVEY.md §2: bgzf Stream.scala:49-54); everything else is JVM bytecode.
+// Here the host-side hot loops are real C++:
+//
+//   - sbt_inflate_blocks: batched raw-DEFLATE inflate of BGZF payloads
+//     (zlib, thread-free: callers fan out with one call per thread)
+//   - sbt_eager_check:    the sequential eager checker over a flat buffer —
+//     byte-exact with check/eager.py, used for escaped-candidate re-checks
+//     and split-point scans without Python-loop overhead
+//   - sbt_find_record_start: byte-wise scan until a position passes
+//
+// Build: spark_bam_tpu/native/build.py (g++ -O3 -shared; ctypes binding).
+
+#include <cstdint>
+#include <cstring>
+#include <zlib.h>
+
+extern "C" {
+
+// ---------------------------------------------------------------- inflate
+// Inflate `count` raw-deflate payloads; offsets/lengths index into `comp`,
+// out_offsets into `out`. Returns 0 on success, 1-based index of the first
+// failing block otherwise.
+long sbt_inflate_blocks(
+    const uint8_t* comp,
+    const int64_t* offsets,
+    const int64_t* lengths,
+    int64_t count,
+    uint8_t* out,
+    const int64_t* out_offsets,
+    const int64_t* out_lengths) {
+  for (int64_t i = 0; i < count; ++i) {
+    z_stream zs;
+    std::memset(&zs, 0, sizeof(zs));
+    if (inflateInit2(&zs, -15) != Z_OK) return i + 1;
+    zs.next_in = const_cast<uint8_t*>(comp + offsets[i]);
+    zs.avail_in = static_cast<uInt>(lengths[i]);
+    zs.next_out = out + out_offsets[i];
+    zs.avail_out = static_cast<uInt>(out_lengths[i]);
+    int rc = inflate(&zs, Z_FINISH);
+    int64_t produced = static_cast<int64_t>(zs.total_out);
+    inflateEnd(&zs);
+    if (rc != Z_STREAM_END || produced != out_lengths[i]) return i + 1;
+  }
+  return 0;
+}
+
+// ---------------------------------------------------------------- checker
+// Exact port of the eager checker semantics (check/eager.py; reference
+// eager/Checker.scala:18-177) over a flat uncompressed buffer of n bytes
+// that ends at EOF. Returns 1 (boundary) / 0.
+static inline int32_t rd_i32(const uint8_t* p) {
+  uint32_t v = (uint32_t)p[0] | ((uint32_t)p[1] << 8) | ((uint32_t)p[2] << 16) |
+               ((uint32_t)p[3] << 24);
+  return (int32_t)v;
+}
+
+static int eager_ok(
+    const uint8_t* buf, int64_t n, int64_t start,
+    const int32_t* contig_lengths, int32_t num_contigs, int32_t reads_to_check) {
+  int64_t logical = start;   // the recursion's startPos bookkeeping
+  int64_t physical = start;  // actual stream position
+  for (int32_t successes = 0;; ++successes) {
+    if (successes == reads_to_check) return 1;
+    if (physical >= n)
+      // Zero bytes exactly at the expected record edge after >=1 success.
+      return physical == logical && successes > 0;
+    if (physical + 36 > n) return 0;
+
+    const uint8_t* p = buf + physical;
+    int32_t remaining = rd_i32(p);
+    int32_t ref_idx = rd_i32(p + 4);
+    int32_t ref_pos = rd_i32(p + 8);
+    if (ref_idx < -1 || ref_idx >= num_contigs || ref_pos < -1) return 0;
+    if (ref_idx >= 0 && ref_pos > contig_lengths[ref_idx]) return 0;
+
+    int32_t name_len = p[12];
+    if (name_len == 0 || name_len == 1) return 0;
+
+    uint32_t fnc = (uint32_t)rd_i32(p + 16);
+    uint32_t flags = fnc >> 16;
+    int32_t n_cigar = (int32_t)(fnc & 0xffff);
+    int32_t seq_len = rd_i32(p + 20);
+    if ((flags & 4) == 0 && (seq_len == 0 || n_cigar == 0)) return 0;
+
+    // JVM int32 wrap + truncating division.
+    int32_t t = seq_len + 1;
+    int32_t half = t / 2;  // C++ division truncates toward zero, like the JVM
+    int32_t rhs = (int32_t)(32 + name_len + 4 * n_cigar + half + seq_len);
+    if (remaining < rhs) return 0;
+
+    int32_t next_ref = rd_i32(p + 24);
+    int32_t next_pos = rd_i32(p + 28);
+    if (next_ref < -1 || next_ref >= num_contigs || next_pos < -1) return 0;
+    if (next_ref >= 0 && next_pos > contig_lengths[next_ref]) return 0;
+
+    int64_t name_end = physical + 36 + name_len;
+    if (name_end > n) return 0;
+    if (buf[name_end - 1] != 0) return 0;
+    for (int64_t j = physical + 36; j < name_end - 1; ++j) {
+      uint8_t b = buf[j];
+      if (b < 0x21 || b > 0x7e || b == 0x40) return 0;
+    }
+
+    int64_t cig_end = name_end + 4 * (int64_t)n_cigar;
+    if (cig_end > n) return 0;
+    for (int64_t j = name_end; j < cig_end; j += 4)
+      if ((buf[j] & 0xf) > 8) return 0;
+
+    int64_t next_logical = logical + 4 + (int64_t)remaining;
+    int64_t next_physical = cig_end > next_logical ? cig_end : next_logical;
+    if (next_physical > n) next_physical = n;  // stream skip clamps at EOF
+    logical = next_logical;
+    physical = next_physical;
+  }
+}
+
+// Verdicts for `m` candidate offsets.
+void sbt_eager_check(
+    const uint8_t* buf, int64_t n,
+    const int64_t* candidates, int64_t m,
+    const int32_t* contig_lengths, int32_t num_contigs,
+    int32_t reads_to_check, uint8_t* out) {
+  for (int64_t i = 0; i < m; ++i)
+    out[i] = (uint8_t)eager_ok(buf, n, candidates[i], contig_lengths,
+                               num_contigs, reads_to_check);
+}
+
+// First boundary at/after `start`, scanning < max_read_size bytes; -1 if none.
+int64_t sbt_find_record_start(
+    const uint8_t* buf, int64_t n, int64_t start,
+    const int32_t* contig_lengths, int32_t num_contigs,
+    int32_t reads_to_check, int64_t max_read_size) {
+  int64_t limit = start + max_read_size;
+  for (int64_t pos = start; pos < limit && pos < n; ++pos)
+    if (eager_ok(buf, n, pos, contig_lengths, num_contigs, reads_to_check))
+      return pos;
+  return -1;
+}
+
+}  // extern "C"

@@ -1,0 +1,64 @@
+"""Native (C++) runtime vs the Python/NumPy engines."""
+
+import numpy as np
+import pytest
+
+from spark_bam_tpu.bam.header import contig_lengths
+from spark_bam_tpu.bgzf.block import FOOTER_SIZE
+from spark_bam_tpu.bgzf.flat import flatten_file
+from spark_bam_tpu.bgzf.header import Header
+from spark_bam_tpu.bgzf.index_blocks import read_blocks_index
+from spark_bam_tpu.check.vectorized import check_flat
+from spark_bam_tpu.core.channel import open_channel
+from spark_bam_tpu.native.build import (
+    eager_check_native,
+    find_record_start_native,
+    inflate_blocks_native,
+    load_native,
+)
+
+
+@pytest.fixture(scope="module")
+def native():
+    lib = load_native()
+    if lib is None:
+        pytest.skip("no native toolchain")
+    return lib
+
+
+def test_native_eager_matches_vectorized(native, bam2):
+    flat = flatten_file(bam2)
+    lens = np.array(contig_lengths(bam2).lengths_list(), dtype=np.int32)
+    ref = check_flat(flat.data, lens, at_eof=True)
+    rng = np.random.default_rng(11)
+    cand = np.unique(rng.integers(0, flat.size, 5000))
+    got = eager_check_native(flat.data, cand, lens)
+    np.testing.assert_array_equal(got, ref.verdict[cand])
+
+
+def test_native_find_record_start(native, bam1):
+    flat = flatten_file(bam1)
+    lens = np.array(contig_lengths(bam1).lengths_list(), dtype=np.int32)
+    start = flat.flat_of_pos(239479, 0)
+    found = find_record_start_native(flat.data, start, lens)
+    assert flat.pos_of_flat(found) == (239479, 312)
+
+
+def test_native_inflate_matches_zlib(native, bam2):
+    metas = read_blocks_index(str(bam2) + ".blocks")
+    with open_channel(bam2) as ch:
+        comp = np.frombuffer(ch.read_fully(ch.size), dtype=np.uint8)
+    offsets, lengths, out_lengths = [], [], []
+    for m in metas:
+        header = Header.parse(bytes(comp[m.start: m.start + 18]))
+        offsets.append(m.start + header.size)
+        lengths.append(m.compressed_size - header.size - FOOTER_SIZE)
+        out_lengths.append(m.uncompressed_size)
+    out = inflate_blocks_native(
+        comp,
+        np.array(offsets, np.int64),
+        np.array(lengths, np.int64),
+        np.array(out_lengths, np.int64),
+    )
+    flat = flatten_file(bam2)
+    np.testing.assert_array_equal(out, flat.data)
