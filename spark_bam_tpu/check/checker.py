@@ -31,6 +31,25 @@ class Checker(Protocol):
     def __call__(self, pos: Pos): ...
 
 
+class NoReadFoundException(Exception):
+    """Scan budget (max_read_size) exhausted without finding a boundary.
+
+    Reaching EOF cleanly is NOT this error: the reference throws there too
+    (FindRecordStart.scala:22-28 via loadBam), which crashes on trailing
+    splits of ultra-long-read files whose record starts all precede the
+    split; we return "no boundary" instead and the partition loads empty.
+    """
+
+    def __init__(self, path, start, max_read_size: int):
+        super().__init__(
+            f"Failed to find a valid read-start in {max_read_size} attempts"
+            f" in {path} from {start}"
+        )
+        self.path = path
+        self.start = start
+        self.max_read_size = max_read_size
+
+
 _REGISTRY: dict[str, Callable] = {}
 
 

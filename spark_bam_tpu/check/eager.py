@@ -139,7 +139,13 @@ class EagerChecker:
     def next_read_start_with_delta(
         self, start: Pos, max_read_size: int = 10_000_000
     ) -> tuple[Pos, int] | None:
-        """Advance byte-by-byte until a position passes (ref :128-162)."""
+        """Advance byte-by-byte until a position passes (ref :128-162).
+
+        Returns None when EOF is reached without a boundary; raises
+        NoReadFoundException when the max_read_size budget runs out mid-file.
+        """
+        from spark_bam_tpu.check.checker import NoReadFoundException
+
         u = self.u
         u.seek(start)
         for idx in range(max_read_size):
@@ -152,7 +158,7 @@ class EagerChecker:
             if not u.has_next():
                 return None
             u.next_byte()
-        return None
+        raise NoReadFoundException("<stream>", start, max_read_size)
 
     def next_read_start(self, start: Pos, max_read_size: int = 10_000_000) -> Pos | None:
         found = self.next_read_start_with_delta(start, max_read_size)

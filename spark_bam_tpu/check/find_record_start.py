@@ -20,15 +20,7 @@ from spark_bam_tpu.check.vectorized import check_flat
 from spark_bam_tpu.core.pos import Pos
 
 
-class NoReadFoundException(Exception):
-    def __init__(self, path, start: int, max_read_size: int):
-        super().__init__(
-            f"Failed to find a valid read-start in {max_read_size} attempts"
-            f" in {path} from {start}"
-        )
-        self.path = path
-        self.start = start
-        self.max_read_size = max_read_size
+from spark_bam_tpu.check.checker import NoReadFoundException  # re-export
 
 
 def find_record_start(

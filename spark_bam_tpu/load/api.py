@@ -25,7 +25,6 @@ from spark_bam_tpu.bam.record import BamRecord, parse_sam_line
 from spark_bam_tpu.bgzf.find_block_start import find_block_start
 from spark_bam_tpu.bgzf.stream import SeekableBlockStream, SeekableUncompressedBytes
 from spark_bam_tpu.check.eager import EagerChecker
-from spark_bam_tpu.check.find_record_start import NoReadFoundException
 from spark_bam_tpu.core.channel import open_channel
 from spark_bam_tpu.core.config import Config
 from spark_bam_tpu.core.pos import Pos
@@ -50,12 +49,12 @@ def _resolve_split_start(path, split: FileSplit, header: BamHeader, config: Conf
         config.reads_to_check,
     )
     try:
-        found = checker.next_read_start(Pos(block_start, 0), config.max_read_size)
+        # None ⇒ EOF reached cleanly: this trailing split owns no record
+        # starts (they all precede it) and loads empty. A mid-file scan that
+        # exhausts max_read_size raises NoReadFoundException from the checker.
+        return checker.next_read_start(Pos(block_start, 0), config.max_read_size)
     finally:
         checker.close()
-    if found is None:
-        raise NoReadFoundException(str(path), block_start, config.max_read_size)
-    return found
 
 
 def _iter_split_records(path, split: FileSplit, header: BamHeader, config: Config):

@@ -1,0 +1,111 @@
+"""Long-read robustness: records spanning many BGZF blocks.
+
+The reference's correctness here is what distinguishes it from hadoop-bam's
+fixed 256 KB window (SURVEY.md §5 long-context note; docs/motivation.md:97-99
+— a 100 kbp read spans multiple blocks and hadoop-bam rejects it). These
+tests synthesize PacBio-style BAMs with our writer and verify the checkers
+and loaders stay exact when every record crosses block boundaries, including
+the windowed/TPU paths whose chains outrun their halos (escape → re-check,
+never guess).
+"""
+
+import numpy as np
+import pytest
+
+from spark_bam_tpu.bam.header import BamHeader, ContigLengths, read_header
+from spark_bam_tpu.bam.index_records import index_records, read_records_index
+from spark_bam_tpu.bam.record import BamRecord
+from spark_bam_tpu.bam.writer import write_bam
+from spark_bam_tpu.bgzf.flat import flatten_file
+from spark_bam_tpu.bgzf.index_blocks import index_blocks
+from spark_bam_tpu.check.eager import EagerChecker
+from spark_bam_tpu.check.vectorized import check_flat
+from spark_bam_tpu.core.pos import Pos
+from spark_bam_tpu.load.api import load_bam
+
+
+@pytest.fixture(scope="module")
+def longread_bam(tmp_path_factory):
+    """60 reads of 40-120 kbp ⇒ nearly every record spans several blocks."""
+    tmp = tmp_path_factory.mktemp("longreads")
+    path = tmp / "long.bam"
+    rng = np.random.default_rng(5)
+    header = BamHeader(
+        ContigLengths({0: ("chr1", 200_000_000)}),
+        Pos(0, 0), 0, "@HD\tVN:1.6\n@SQ\tSN:chr1\tLN:200000000\n",
+    )
+
+    def records():
+        pos = 1000
+        for i in range(60):
+            n = int(rng.integers(40_000, 120_000))
+            seq = "".join("ACGT"[b] for b in rng.integers(0, 4, n))
+            yield BamRecord(
+                ref_id=0, pos=pos, mapq=60, bin=0, flag=0,
+                next_ref_id=-1, next_pos=-1, tlen=0,
+                read_name=f"pacbio/{i}",
+                cigar=[(n, 0)], seq=seq,
+                qual=bytes(rng.integers(2, 40, n, dtype=np.uint8)),
+            )
+            pos += n + 10
+
+    count = write_bam(path, header, records())
+    assert count == 60
+    index_blocks(path)
+    index_records(path)
+    return path
+
+
+def test_records_span_blocks(longread_bam):
+    records = read_records_index(str(longread_bam) + ".records")
+    assert len(records) == 60
+    # Median record is far bigger than a block: consecutive starts are
+    # usually in different blocks.
+    crossings = sum(
+        1 for a, b in zip(records, records[1:]) if b.block_pos != a.block_pos
+    )
+    assert crossings >= 55
+
+
+def test_vectorized_exact_on_longreads(longread_bam):
+    flat = flatten_file(longread_bam)
+    header = read_header(longread_bam)
+    lens = np.array(header.contig_lengths.lengths_list(), dtype=np.int32)
+    result = check_flat(flat.data, lens, at_eof=True)
+    truth = np.zeros(flat.size, dtype=bool)
+    for pos in read_records_index(str(longread_bam) + ".records"):
+        truth[flat.flat_of_pos(pos.block_pos, pos.offset)] = True
+    np.testing.assert_array_equal(result.verdict, truth)
+
+
+def test_tpu_windowed_longreads_escape_and_recheck(longread_bam):
+    """Windows far smaller than a 10-record chain (≈1 MB): the device kernel
+    must escape rather than guess, and the host re-check restores exactness."""
+    from spark_bam_tpu.tpu.checker import TpuChecker
+
+    flat = flatten_file(longread_bam)
+    header = read_header(longread_bam)
+    lens = np.array(header.contig_lengths.lengths_list(), dtype=np.int32)
+    checker = TpuChecker(lens, window=1 << 19, halo=1 << 17)
+    res = checker.check_buffer(flat.data, at_eof=True)
+    truth = np.zeros(flat.size, dtype=bool)
+    for pos in read_records_index(str(longread_bam) + ".records"):
+        truth[flat.flat_of_pos(pos.block_pos, pos.offset)] = True
+    np.testing.assert_array_equal(res.verdict, truth)
+
+
+def test_load_longreads(longread_bam):
+    ds = load_bam(longread_bam, split_size=200_000)
+    assert ds.count() == 60
+    names = [r.read_name for r in ds]
+    assert names == [f"pacbio/{i}" for i in range(60)]
+
+
+def test_eager_oracle_on_longread_boundary(longread_bam):
+    records = read_records_index(str(longread_bam) + ".records")
+    checker = EagerChecker.open(longread_bam)
+    # A record start mid-file chains across dozens of blocks.
+    assert checker(records[30]) is True
+    off = records[30]
+    assert checker(Pos(off.block_pos, off.offset + 1)) is False
+    checker.close()
