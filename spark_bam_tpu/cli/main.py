@@ -90,6 +90,12 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    import logging
+
+    # --warn: root log level to WARN (reference args/LogArgs.scala:30-33).
+    logging.basicConfig(
+        level=logging.WARNING if getattr(args, "warn", False) else logging.INFO
+    )
     from spark_bam_tpu.cli.output import Printer
 
     out = open(args.out, "w") if getattr(args, "out", None) else None

@@ -1,0 +1,65 @@
+"""Timers, heartbeats, and profiler hooks.
+
+The reference's observability is wall-clock ``Timer.time`` blocks and
+heartbeat logging (SURVEY.md §5: ComputeSplits.scala:74-106,
+IndexBlocks.scala:34-45; its docs admit "no profiling having been done").
+Per the survey's recommendation we wire stage timers + the JAX profiler in
+from day one: ``profile_trace`` wraps any block in a TensorBoard-viewable
+device trace when ``SPARK_BAM_PROFILE_DIR`` is set, and is a no-op
+otherwise.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import time
+
+log = logging.getLogger(__name__)
+
+
+class Timer:
+    """Named stage timer: ``with Timer() as t: ...; t.ms``."""
+
+    def __init__(self, name: str = "", echo=None):
+        self.name = name
+        self.echo = echo
+        self.ms = 0
+
+    def __enter__(self) -> "Timer":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.ms = int((time.perf_counter() - self._t0) * 1000)
+        if self.echo is not None and self.name:
+            self.echo(f"{self.name}: {self.ms}ms")
+
+
+@contextlib.contextmanager
+def heartbeat(what: str, interval_seconds: float = 10.0):
+    """Yields a callable ``beat(progress)``; logs at most every interval."""
+    last = time.monotonic()
+
+    def beat(progress):
+        nonlocal last
+        now = time.monotonic()
+        if now - last >= interval_seconds:
+            log.info("%s: %s", what, progress)
+            last = now
+
+    yield beat
+
+
+@contextlib.contextmanager
+def profile_trace(name: str = "spark-bam-tpu"):
+    """JAX device trace when SPARK_BAM_PROFILE_DIR is set; else no-op."""
+    trace_dir = os.environ.get("SPARK_BAM_PROFILE_DIR")
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(os.path.join(trace_dir, name)):
+        yield

@@ -1,0 +1,28 @@
+"""Benchmark harvesting of CLI outputs into TSV rows."""
+
+from spark_bam_tpu.benchmarks.harvest import parse_output
+from spark_bam_tpu.cli.main import main
+
+
+def test_harvest_check_bam(bam1, tmp_path):
+    out = tmp_path / "1.out"
+    assert main(["check-bam", str(bam1), "-o", str(out)]) == 0
+    info = parse_output(str(out))
+    assert info.uncompressed_positions == 1_608_257
+    assert info.compressed_size == "583K"
+    assert info.compression_ratio == 2.69
+    assert info.num_reads == 4917
+    assert info.false_positives == 5
+    assert info.false_negatives == 0
+    row = info.tsv_row()
+    assert "1608257" in row and "583K" in row
+
+
+def test_harvest_check_blocks(bam1, tmp_path):
+    out = tmp_path / "1.blocks.out"
+    assert main(["check-blocks", "-u", str(bam1), "-o", str(out)]) == 0
+    info = parse_output(str(out))
+    assert info.bad_blocks == 1
+    assert info.num_blocks == 25
+    assert info.bad_compressed_positions == 25871
+    assert info.total_compressed_positions == 597482

@@ -1,0 +1,33 @@
+"""TPU end-to-end load path vs golden counts and the sequential loader."""
+
+import numpy as np
+import pytest
+
+from spark_bam_tpu.bam.index_records import read_records_index
+from spark_bam_tpu.load.tpu_load import (
+    count_reads_tpu,
+    load_reads_columnar,
+    record_starts,
+)
+
+
+def test_count_reads_tpu(bam1, bam2):
+    assert count_reads_tpu(bam1) == 4917
+    assert count_reads_tpu(bam2) == 2500
+
+
+def test_record_starts_match_index(bam2):
+    result = record_starts(bam2)
+    golden = read_records_index(str(bam2) + ".records")
+    assert result.positions() == golden
+
+
+def test_load_reads_columnar_interval(bam2):
+    batch = load_reads_columnar(bam2, loci="1:0-100000")
+    assert len(batch) == 2450  # golden interval count
+    assert (batch["flag"] & 4).sum() == 0  # no unmapped rows survive
+
+
+def test_load_reads_columnar_flags(bam2):
+    batch = load_reads_columnar(bam2, flags_required=0x1)
+    assert (batch["flag"] & 1).all()
