@@ -61,10 +61,32 @@ class PosAnnotation:
 
 
 class CheckerContext:
-    def __init__(self, path, config: Config = Config(), printer: Printer | None = None):
+    def __init__(
+        self,
+        path,
+        config: Config = Config(),
+        printer: Printer | None = None,
+        ranges=None,
+    ):
         self.path = str(path)
         self.config = config
         self.printer = printer or Printer()
+        self.ranges = ranges  # RangeSet of compressed byte ranges, or None
+
+    @cached_property
+    def position_mask(self) -> np.ndarray | None:
+        """Mask of flat positions whose *block start* is inside the byte
+        ranges (reference Blocks.Args --intervals, Blocks.scala:33-41)."""
+        if self.ranges is None:
+            return None
+        mask = np.zeros(self.view.size, dtype=bool)
+        starts = self.view.block_starts
+        flats = self.view.block_flat
+        for i, start in enumerate(starts):
+            if int(start) in self.ranges:
+                end = self.view.size if i + 1 == len(flats) else int(flats[i + 1])
+                mask[int(flats[i]): end] = True
+        return mask
 
     @cached_property
     def header(self):
@@ -155,12 +177,19 @@ class CheckerContext:
     ) -> None:
         """The shared check-bam/full-check report (CheckerApp.scala:64-222)."""
         p = self.printer
+        sel = self.position_mask
+        if sel is not None:
+            expected = expected & sel
+            actual = actual & sel
+            in_scope = int(sel.sum())
+        else:
+            in_scope = self.view.size
         tp = int((expected & actual).sum())
         fp_idx = np.flatnonzero(~expected & actual)
         fn_idx = np.flatnonzero(expected & ~actual)
-        tn = int((~expected & ~actual).sum())
         num_reads = tp + len(fn_idx)
-        total = num_reads + tn + len(fp_idx)
+        tn = in_scope - num_reads - len(fp_idx)
+        total = in_scope
         ratio = total / self.compressed_size
 
         p.echo(

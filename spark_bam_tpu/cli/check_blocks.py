@@ -34,7 +34,11 @@ def run(ctx: CheckerContext, spark_bam: bool = False, hadoop_bam: bool = False) 
     flat1 = np.flatnonzero(v1)
     flat2 = np.flatnonzero(v2)
 
-    metas = list(blocks_metadata(ctx.path))
+    metas = [
+        m
+        for m in blocks_metadata(ctx.path)
+        if ctx.ranges is None or m.start in ctx.ranges
+    ]
     total_compressed = ctx.compressed_size
     max_read_size = ctx.config.max_read_size
 

@@ -55,6 +55,8 @@ def run(ctx: CheckerContext) -> None:
     rb = res.reads_before
     # Exclude successes and the bare at-EOF marker (FullCheck.scala:144-147).
     considered = (masks != 0) & ~((masks == _BIT0) & (rb == 0))
+    if ctx.position_mask is not None:
+        considered &= ctx.position_mask
     popcount = np.zeros(len(masks), dtype=np.int32)
     for i in range(len(FLAG_NAMES)):
         popcount += (masks >> i) & 1

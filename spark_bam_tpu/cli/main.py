@@ -27,6 +27,11 @@ def _add_common(sub, split_default=None):
     sub.add_argument("-l", "--print-limit", type=int, default=10)
     sub.add_argument("-o", "--out", default=None, help="write output to file")
     sub.add_argument("-w", "--warn", action="store_true", help="root log level WARN")
+    sub.add_argument(
+        "-i", "--intervals", default=None,
+        help="comma-separated compressed byte-ranges (start-end|start+len|point,"
+             " byte shorthand ok); only blocks starting inside are checked",
+    )
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -110,8 +115,12 @@ def main(argv=None) -> int:
         if cmd in ("check-bam", "check-blocks", "full-check", "compute-splits",
                    "time-load"):
             from spark_bam_tpu.cli.app import CheckerContext
+            from spark_bam_tpu.core.ranges import parse_ranges
 
-            ctx = CheckerContext(args.path, config, p)
+            ctx = CheckerContext(
+                args.path, config, p,
+                ranges=parse_ranges(getattr(args, "intervals", None)),
+            )
             if cmd == "check-bam":
                 from spark_bam_tpu.cli import check_bam
 
