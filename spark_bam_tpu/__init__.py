@@ -33,20 +33,29 @@ __all__ = [
     "load_bam_intervals",
     "load_splits_and_reads",
     "load_reads_and_positions",
+    "count_reads_tpu",
+    "load_reads_columnar",
 ]
+
+_LOAD_API = {
+    "load_bam",
+    "load_reads",
+    "load_sam",
+    "load_bam_intervals",
+    "load_splits_and_reads",
+    "load_reads_and_positions",
+}
+_TPU_API = {"count_reads_tpu", "load_reads_columnar", "record_starts"}
 
 
 def __getattr__(name):
     # Lazy: the load API pulls in numpy/jax; keep `import spark_bam_tpu` cheap.
-    if name in {
-        "load_bam",
-        "load_reads",
-        "load_sam",
-        "load_bam_intervals",
-        "load_splits_and_reads",
-        "load_reads_and_positions",
-    }:
+    if name in _LOAD_API:
         from spark_bam_tpu.load import api
 
         return getattr(api, name)
+    if name in _TPU_API:
+        from spark_bam_tpu.load import tpu_load
+
+        return getattr(tpu_load, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

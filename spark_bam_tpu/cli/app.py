@@ -111,12 +111,50 @@ class CheckerContext:
     # ------------------------------------------------------------- engines
     @cached_property
     def eager_result(self) -> ChainResult:
+        if self._use_tpu_backend():
+            from spark_bam_tpu.tpu.checker import TpuChecker
+
+            want = min(self.config.window_size, max(self.view.size, 1))
+            window = 1 << max(20, (want - 1).bit_length())
+            checker = TpuChecker(
+                self.lengths,
+                window=window,
+                halo=min(self.config.halo_size, window // 4),
+                reads_to_check=self.config.reads_to_check,
+            )
+            res = checker.check_buffer(self.view.data, at_eof=True)
+            return ChainResult(
+                verdict=res.verdict,
+                reads_parsed=res.reads_parsed,
+                fail_mask=res.fail_mask,
+                reads_before=res.reads_before,
+                exact=res.exact,
+                escaped=res.escaped,
+            )
         return check_flat(
             self.view.data,
             self.lengths,
             at_eof=True,
             reads_to_check=self.config.reads_to_check,
         )
+
+    def _use_tpu_backend(self) -> bool:
+        if self.config.backend == "numpy":
+            return False
+        if self.config.backend == "tpu":
+            return True
+        if self.config.backend == "auto":
+            # Device pays off once the input outweighs kernel compile+launch;
+            # small files resolve faster in the NumPy engine.
+            if self.view.size < (32 << 20):
+                return False
+            try:
+                import jax
+
+                return jax.devices()[0].platform in ("tpu", "axon")
+            except Exception:
+                return False
+        return False
 
     @cached_property
     def eager_verdict(self) -> np.ndarray:

@@ -32,6 +32,10 @@ def _add_common(sub, split_default=None):
         help="comma-separated compressed byte-ranges (start-end|start+len|point,"
              " byte shorthand ok); only blocks starting inside are checked",
     )
+    # Reference FindBlockArgs (-z) / FindReadArgs knobs.
+    sub.add_argument("-z", "--bgzf-blocks-to-check", type=int, default=None)
+    sub.add_argument("--reads-to-check", type=int, default=None)
+    sub.add_argument("--max-read-size", type=int, default=None)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -109,6 +113,10 @@ def main(argv=None) -> int:
     split = getattr(args, "max_split_size", None)
     if split is not None:
         config = config.replace(split_size=parse_bytes(split))
+    for knob in ("bgzf_blocks_to_check", "reads_to_check", "max_read_size"):
+        value = getattr(args, knob, None)
+        if value is not None:
+            config = config.replace(**{knob: value})
 
     try:
         cmd = args.command

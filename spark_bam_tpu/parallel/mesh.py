@@ -34,6 +34,31 @@ def make_mesh(devices=None, axis: str = "data") -> Mesh:
     return Mesh(np.array(devices), (axis,))
 
 
+def init_distributed(
+    coordinator: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> int:
+    """Multi-host bring-up: initialize jax.distributed (NCCL/MPI analog is
+    XLA's ICI/DCN collectives; the reference's Spark cluster role).
+
+    With no arguments, reads the standard JAX coordination env vars
+    (JAX_COORDINATOR_ADDRESS etc.) or no-ops on single-host. Returns the
+    global device count. Each host then feeds its own windows (the workload
+    needs no cross-host data motion beyond ≤64 KiB halos at shard seams —
+    SURVEY.md §2.9).
+    """
+    import os
+
+    if coordinator or os.environ.get("JAX_COORDINATOR_ADDRESS"):
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    return len(jax.devices())
+
+
 @functools.partial(jax.jit, static_argnames=("reads_to_check",))
 def sharded_check_step(
     windows: jnp.ndarray,      # (B, W+PAD) uint8, batch-dim sharded over the mesh

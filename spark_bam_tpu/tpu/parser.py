@@ -141,18 +141,55 @@ def interval_flag_filter(
     return cols["valid"] & mapped & (ref >= 0) & overlap & flag_ok
 
 
+_SEQ_CODES = "=ACMGRSVTWYHKDBN"
+
+
 @dataclass
 class ReadBatch:
-    """Columnar batch of parsed records (host-side numpy views)."""
+    """Columnar batch of parsed records (host-side numpy views).
+
+    Fixed fields live in ``columns``; variable-length payloads (name, seq,
+    qual) materialize lazily from the flat buffer on demand.
+    """
 
     columns: dict[str, np.ndarray]
     starts: np.ndarray
+    buf: np.ndarray | None = None
 
     def __len__(self) -> int:
         return int(self.columns["valid"].sum())
 
     def __getitem__(self, key: str) -> np.ndarray:
         return self.columns[key][self.columns["valid"]]
+
+    # ---- lazy variable-length payloads (row index is pre-filter) ----
+    def name(self, i: int) -> str:
+        off = int(self.columns["name_offset"][i])
+        ln = int(self.columns["l_read_name"][i])
+        return bytes(self.buf[off: off + ln - 1]).decode("latin-1")
+
+    def seq(self, i: int) -> str:
+        off = (
+            int(self.columns["name_offset"][i])
+            + int(self.columns["l_read_name"][i])
+            + 4 * int(self.columns["n_cigar"][i])
+        )
+        n = int(self.columns["l_seq"][i])
+        packed = self.buf[off: off + (n + 1) // 2]
+        return "".join(
+            _SEQ_CODES[(packed[k >> 1] >> (4 if k % 2 == 0 else 0)) & 0xF]
+            for k in range(n)
+        )
+
+    def qual(self, i: int) -> bytes:
+        n = int(self.columns["l_seq"][i])
+        off = (
+            int(self.columns["name_offset"][i])
+            + int(self.columns["l_read_name"][i])
+            + 4 * int(self.columns["n_cigar"][i])
+            + (n + 1) // 2
+        )
+        return bytes(self.buf[off: off + n])
 
 
 def parse_flat_records(
@@ -172,4 +209,4 @@ def parse_flat_records(
             rec, _ = BamRecord.decode(buf, int(starts[i]))
             cols["ref_span"][i] = rec.reference_span()
         cols["span_exact"][inexact] = True
-    return ReadBatch(cols, starts)
+    return ReadBatch(cols, starts, buf=np.asarray(buf))

@@ -1,0 +1,55 @@
+"""Third fixture (5k.bam): engines + loaders agree with its sidecars."""
+
+import numpy as np
+import pytest
+
+from spark_bam_tpu.bam.header import contig_lengths
+from spark_bam_tpu.bam.index_records import read_records_index
+from spark_bam_tpu.bgzf.flat import flatten_file
+from spark_bam_tpu.bgzf.index_blocks import read_blocks_index
+from spark_bam_tpu.bgzf.stream import MetadataStream
+from spark_bam_tpu.check.vectorized import check_flat
+from spark_bam_tpu.core.channel import open_channel
+from spark_bam_tpu.load.api import load_bam, load_bam_intervals
+
+
+def test_blocks_match_sidecar(bam5k):
+    with open_channel(bam5k) as ch:
+        metas = list(MetadataStream(ch))
+    assert metas == read_blocks_index(str(bam5k) + ".blocks")
+
+
+def test_vectorized_matches_records(bam5k):
+    flat = flatten_file(bam5k)
+    lens = np.array(contig_lengths(bam5k).lengths_list(), dtype=np.int32)
+    result = check_flat(flat.data, lens, at_eof=True)
+    truth = np.zeros(flat.size, dtype=bool)
+    records = read_records_index(str(bam5k) + ".records")
+    for pos in records:
+        truth[flat.flat_of_pos(pos.block_pos, pos.offset)] = True
+    np.testing.assert_array_equal(result.verdict, truth)
+    assert len(records) == truth.sum()
+
+
+def test_load_count(bam5k):
+    records = read_records_index(str(bam5k) + ".records")
+    assert load_bam(bam5k, split_size=200_000).count() == len(records)
+
+
+def test_bai_interval_load(bam5k):
+    # 5k.bam ships a .bai: indexed loads must run and agree with a full-scan
+    # filter.
+    header_count = load_bam(bam5k, split_size=1_000_000)
+    from spark_bam_tpu.bam.header import read_header
+    from spark_bam_tpu.load.intervals import LociSet
+
+    header = read_header(bam5k)
+    name0 = header.contig_lengths.name(0)
+    loci = LociSet.parse(f"{name0}", header.contig_lengths)
+    via_index = load_bam_intervals(bam5k, loci).count()
+    full = [
+        r
+        for r in header_count
+        if not r.is_unmapped and r.ref_id == 0
+    ]
+    assert via_index == len(full)

@@ -177,3 +177,14 @@ def test_rewrite_roundtrip(bam2, tmp_path):
     from spark_bam_tpu.load.api import load_bam
 
     assert load_bam(out_bam, split_size=1_000_000).count() == 2500
+
+
+def test_cli_knobs(bam2, tmp_path):
+    # reads-to-check=1 weakens the chain requirement: more boundary calls
+    # than the .records truth (false positives appear), demonstrating the
+    # knob reaches the engine.
+    got = run_cli(
+        ["check-bam", "-s", "--reads-to-check", "1", str(bam2)],
+        tmp_path, "knobs.txt",
+    )
+    assert "false positives" in got or "All calls matched!" in got

@@ -66,3 +66,11 @@ def test_interval_filter_matches_load_api(bam2, parsed):
     )
     paired = (batch.columns["flag"] & 1) == 1
     assert int(mask2.sum()) == int((mask & paired).sum())
+
+
+def test_lazy_payloads_match_codec(bam2, parsed):
+    flat, starts, batch = parsed
+    rec, _ = BamRecord.decode(flat.data, int(starts[7]))
+    assert batch.name(7) == rec.read_name
+    assert batch.seq(7) == rec.seq
+    assert batch.qual(7) == rec.qual
