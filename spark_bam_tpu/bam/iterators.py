@@ -46,17 +46,20 @@ class _RecordIteratorBase:
 
 
 class PosStream(_RecordIteratorBase):
-    """Yield the virtual position of every record start (no decoding)."""
+    """Yield the virtual position of every record start (no decoding).
+
+    A truncation that cuts a record's length prefix raises EOFError (the
+    reference's getInt does the same, PosStream.scala:18; IndexRecords
+    catches it in tolerant mode); a cut elsewhere ends the stream cleanly,
+    also like the reference.
+    """
 
     def __iter__(self) -> Iterator[Pos]:
         while True:
             pos = self.cur_pos()
             if pos is None:
                 return
-            try:
-                remaining = self.u.read_i32()
-            except EOFError:
-                return
+            remaining = self.u.read_i32()  # EOFError propagates
             self.u.skip(remaining)
             yield pos
 

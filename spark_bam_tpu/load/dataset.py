@@ -37,6 +37,12 @@ class Dataset(Generic[P, T]):
             self.partitions, lambda p: fn(compute(p)), self.parallel
         )
 
+    def map(self, fn: Callable[[T], object]) -> "Dataset":
+        return self.map_partitions(lambda it: (fn(x) for x in it))
+
+    def filter(self, pred: Callable[[T], bool]) -> "Dataset":
+        return self.map_partitions(lambda it: (x for x in it if pred(x)))
+
     def count(self) -> int:
         return sum(
             map_partitions(

@@ -1,0 +1,81 @@
+"""Failure-tolerance behaviors (SURVEY.md §5): truncated files, missing
+indexes, dataset transforms."""
+
+import shutil
+
+import pytest
+
+from spark_bam_tpu.bam.index_records import index_records, read_records_index
+from spark_bam_tpu.cli.main import main
+from spark_bam_tpu.load.api import load_bam
+
+
+def test_index_records_truncation_mid_block(bam2, tmp_path):
+    # Chop the compressed file mid-block: the final partial block vanishes
+    # and the indexer reports the records it saw (matches the reference:
+    # its block stream also ends cleanly at a truncated block).
+    truncated = tmp_path / "trunc.bam"
+    data = open(bam2, "rb").read()
+    truncated.write_bytes(data[: len(data) // 2])
+
+    out, count = index_records(truncated, tmp_path / "t.records")
+    golden = read_records_index(str(bam2) + ".records")
+    found = read_records_index(out)
+    assert 0 < count < len(golden)
+    assert found == golden[:count]
+
+
+def test_index_records_truncated_length_prefix(bam2, tmp_path):
+    # Rebuild the uncompressed stream cut 2 bytes into a record's length
+    # prefix: tolerant mode reports what it saw, strict (-t) raises
+    # (reference IndexRecords.scala:69-81).
+    from spark_bam_tpu.bam.iterators import RecordStream
+    from spark_bam_tpu.bam.writer import BgzfWriter, encode_bam_header
+    from spark_bam_tpu.core.channel import open_channel
+
+    with open_channel(bam2) as ch:
+        rs = RecordStream.open(ch)
+        header = rs.header
+        records = [rec.encode() for _, rec in rs][:20]
+
+    bad = tmp_path / "cut.bam"
+    with open(bad, "wb") as f, BgzfWriter(f, block_payload=100_000) as w:
+        w.write(encode_bam_header(header))
+        for enc in records:
+            w.write(enc)
+        w.write(b"\x99\x01")  # a dangling 2-byte length-prefix fragment
+
+    out, count = index_records(bad, tmp_path / "t.records")
+    assert count == 20
+    with pytest.raises(EOFError):
+        index_records(bad, tmp_path / "t2.records", strict=True)
+
+
+def test_full_check_without_records_index(bam2, tmp_path):
+    # Without a .records sidecar the scan still runs; no confusion header.
+    bam_copy = tmp_path / "noindex.bam"
+    shutil.copyfile(bam2, bam_copy)
+    out = tmp_path / "out.txt"
+    assert main(["full-check", str(bam_copy), "-o", str(out)]) == 0
+    got = out.read_text()
+    assert "uncompressed positions" not in got  # header block needs the index
+    assert "Total error counts:" in got
+
+
+def test_check_bam_without_blocks_index(bam1, tmp_path):
+    # Without a .blocks sidecar the search path plans blocks (1.noblocks.bam
+    # symlinks the same data in the reference fixtures).
+    bam_copy = tmp_path / "noblocks.bam"
+    shutil.copyfile(bam1, bam_copy)
+    shutil.copyfile(str(bam1) + ".records", str(bam_copy) + ".records")
+    out = tmp_path / "out.txt"
+    assert main(["check-bam", "-u", str(bam_copy), "-o", str(out)]) == 0
+    assert "5 false positives, 0 false negatives" in out.read_text()
+
+
+def test_dataset_map_filter(bam2):
+    ds = load_bam(bam2, split_size=1_000_000)
+    mapped = ds.map(lambda r: r.read_name)
+    assert mapped.count() == 2500
+    unmapped_only = ds.filter(lambda r: r.is_unmapped)
+    assert unmapped_only.count() == 50  # 2500 reads, 50 unmapped
