@@ -118,6 +118,53 @@ def shard_windows(
     return jax.device_put(windows, sharding)
 
 
+def make_shard_map_check_step(mesh: Mesh, reads_to_check: int = 10, axis: str = "data"):
+    """Explicit-collective variant of the sharded step.
+
+    Where ``sharded_check_step`` lets GSPMD infer the partitioning, this one
+    is written per-shard with ``shard_map``: each device runs the kernel on
+    its local windows and the stats reduce with an explicit ``lax.psum``
+    over the mesh axis — the XLA collective riding ICI. Semantically
+    identical; kept as the explicit form the multi-host deployment uses.
+    """
+    from jax.experimental.shard_map import shard_map
+
+    def local_step(windows, ns, at_eofs, truth, lengths, num_contigs):
+        def one(window, n, at_eof, tr):
+            res = check_window(
+                window, lengths, num_contigs, n, at_eof,
+                reads_to_check=reads_to_check,
+            )
+            w = window.shape[0] - PAD
+            in_range = jnp.arange(w, dtype=jnp.int32) < n
+            v = res["verdict"] & in_range
+            t = tr & in_range
+            return v, jnp.stack([
+                jnp.sum((v & t).astype(jnp.int32)),
+                jnp.sum((v & ~t).astype(jnp.int32)),
+                jnp.sum((~v & t).astype(jnp.int32)),
+                jnp.sum((~v & ~t).astype(jnp.int32)),
+                jnp.sum(in_range.astype(jnp.int32)),
+            ])
+
+        verdicts, stats = jax.vmap(one)(windows, ns, at_eofs, truth)
+        totals = jax.lax.psum(jnp.sum(stats, axis=0), axis)  # ← ICI all-reduce
+        return verdicts, totals
+
+    return jax.jit(
+        shard_map(
+            local_step,
+            mesh=mesh,
+            in_specs=(P(axis), P(axis), P(axis), P(axis), P(), P()),
+            out_specs=(P(axis), P()),
+            # The kernel's scan carries start from unvarying constants; skip
+            # the replication check rather than thread pvary through shared
+            # kernel code.
+            check_rep=False,
+        )
+    )
+
+
 def batch_windows(
     buf: np.ndarray,
     window: int,
