@@ -1,0 +1,63 @@
+"""Prefetch channel over a deliberately slow backend."""
+
+import time
+
+import pytest
+
+from spark_bam_tpu.bgzf.stream import MetadataStream
+from spark_bam_tpu.bgzf.index_blocks import read_blocks_index
+from spark_bam_tpu.core.channel import ByteChannel, MMapChannel
+from spark_bam_tpu.core.prefetch import PrefetchChannel
+
+
+class SlowChannel(ByteChannel):
+    """Simulated high-latency backend: fixed delay per ranged read."""
+
+    def __init__(self, path, delay: float):
+        super().__init__()
+        self.inner = MMapChannel(path)
+        self.delay = delay
+        self.reads = 0
+
+    def _read_at(self, pos, n):
+        self.reads += 1
+        time.sleep(self.delay)
+        return self.inner._read_at(pos, n)
+
+    @property
+    def size(self):
+        return self.inner.size
+
+    def close(self):
+        self.inner.close()
+
+
+def test_prefetch_correctness(bam2):
+    slow = SlowChannel(bam2, delay=0.0)
+    ch = PrefetchChannel(slow, chunk_size=64 << 10, depth=3)
+    metas = list(MetadataStream(ch))
+    assert metas == read_blocks_index(str(bam2) + ".blocks")
+    ch.close()
+
+
+def test_prefetch_overlaps_latency(bam2):
+    # With 5 ms per ranged read and ~9 chunks, a serial scan pays ≥45 ms of
+    # latency; the prefetcher overlaps most of it.
+    def scan(ch):
+        t0 = time.perf_counter()
+        n = sum(1 for _ in MetadataStream(ch))
+        return n, time.perf_counter() - t0
+
+    serial = SlowChannel(bam2, delay=0.005)
+    n1, t_serial = scan(serial)
+    serial.close()
+
+    slow = SlowChannel(bam2, delay=0.005)
+    pre = PrefetchChannel(slow, chunk_size=64 << 10, depth=4)
+    # Warm the pipeline with one touch, as a shard reader would.
+    pre._read_at(0, 1)
+    n2, t_pre = scan(pre)
+    pre.close()
+
+    assert n1 == n2 == 25
+    assert t_pre < t_serial
