@@ -108,6 +108,19 @@ class CheckerContext:
     def compressed_size(self) -> int:
         return os.path.getsize(self.path)
 
+    @cached_property
+    def selected_compressed_size(self) -> int:
+        """Sum of the checked blocks' compressed sizes (the reference's
+        compressedSizeAccumulator: per-block, honors --intervals, excludes
+        the EOF sentinel)."""
+        from spark_bam_tpu.bgzf.index_blocks import blocks_metadata
+
+        return sum(
+            m.compressed_size
+            for m in blocks_metadata(self.path)
+            if self.ranges is None or m.start in self.ranges
+        )
+
     # ------------------------------------------------------------- engines
     @cached_property
     def eager_result(self) -> ChainResult:
@@ -228,11 +241,12 @@ class CheckerContext:
         num_reads = tp + len(fn_idx)
         tn = in_scope - num_reads - len(fp_idx)
         total = in_scope
-        ratio = total / self.compressed_size
+        compressed = self.selected_compressed_size
+        ratio = total / compressed
 
         p.echo(
             f"{total} uncompressed positions",
-            f"{format_bytes_binary(self.compressed_size)} compressed",
+            f"{format_bytes_binary(compressed)} compressed",
             "Compression ratio: %.2f" % ratio,
             f"{num_reads} reads",
         )

@@ -12,11 +12,14 @@ from spark_bam_tpu.cli.app import CheckerContext
 _BIT0 = BIT["tooFewFixedBlockBytes"]
 
 
-def _counts_lines(counts: dict[str, int], hide_bit0: bool = False) -> list[str]:
+def _counts_lines(
+    counts: dict[str, int], hide_bit0: bool = False, include_zeros: bool = False
+) -> list[str]:
     items = [
         (name, counts.get(name, 0))
         for name in FLAG_NAMES
-        if counts.get(name, 0) and not (hide_bit0 and name == "tooFewFixedBlockBytes")
+        if (include_zeros or counts.get(name, 0))
+        and not (hide_bit0 and name == "tooFewFixedBlockBytes")
     ]
     if not items:
         return []
@@ -117,5 +120,12 @@ def run(ctx: CheckerContext) -> None:
 
     all_considered = np.flatnonzero(considered)
     p.echo("Total error counts:")
-    p.echo(*("\t" + l for l in _counts_lines(_mask_counts(masks[all_considered]), hide_bit0=True)))
+    # include_zeros: the reference's Counts.lines defaults to showing zero
+    # counts here (only the critical/per-flag sections exclude them).
+    p.echo(*(
+        "\t" + l
+        for l in _counts_lines(
+            _mask_counts(masks[all_considered]), hide_bit0=True, include_zeros=True
+        )
+    ))
     p.echo("")

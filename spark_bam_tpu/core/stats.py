@@ -120,10 +120,17 @@ class Stats:
 
 
 def format_bytes_binary(n: int, include_b: bool = False) -> str:
-    """hammerlab-bytes format: 1024-based, integer, K/M/G/T suffix
-    ("583K"; includeB ⇒ "519KB")."""
+    """hammerlab-bytes format: 1024-based, 3 significant figures, K/M/G/T
+    suffix ("583K", "25.6K"; includeB ⇒ "519KB")."""
     suffix = "B" if include_b else ""
     for unit, shift in (("E", 60), ("P", 50), ("T", 40), ("G", 30), ("M", 20), ("K", 10)):
         if n >= (1 << shift):
-            return f"{round(n / (1 << shift))}{unit}{suffix}"
+            v = n / (1 << shift)
+            if v < 10:
+                s = f"{v:.2f}".rstrip("0").rstrip(".")
+            elif v < 100:
+                s = f"{v:.1f}".rstrip("0").rstrip(".")
+            else:
+                s = str(round(v))
+            return f"{s}{unit}{suffix}"
     return f"{n}{'B' if include_b else ''}"

@@ -188,3 +188,25 @@ def test_cli_knobs(bam2, tmp_path):
         tmp_path, "knobs.txt",
     )
     assert "false positives" in got or "All calls matched!" in got
+
+
+def test_full_check_interval_goldens(bam2, tmp_path):
+    """The reference's -i golden files (FullCheckTest.scala:34-60)."""
+    for name, args in [
+        ("2.bam.first", ["-i", "0"]),
+        ("2.bam.second", ["-i", "26169"]),
+        ("2.bam.200k", ["-i", "0-200k", "-m", "100k"]),
+    ]:
+        got = run_cli(["full-check", *args, str(bam2)], tmp_path, name + ".txt")
+        assert got == (GOLDEN / "full-check" / name).read_text(), name
+
+
+def test_full_check_noindex_golden(bam1, tmp_path):
+    """full-check without .records: no confusion header (golden
+    1.noblocks.bam)."""
+    import shutil
+
+    bam_copy = tmp_path / "1.noblocks.bam"
+    shutil.copyfile(bam1, bam_copy)
+    got = run_cli(["full-check", str(bam_copy)], tmp_path)
+    assert got == (GOLDEN / "full-check" / "1.noblocks.bam").read_text()
