@@ -63,10 +63,15 @@ def plan_blocks(
         # (the reference scans after filtering, Blocks.scala:89-107).
         partitions: dict[int, list[Metadata]] = {}
         offset = 0
+        last_partition = -1
         for m in metas:
-            partitions.setdefault(offset // split_size, []).append(m)
+            last_partition = offset // split_size
+            partitions.setdefault(last_partition, []).append(m)
             offset += m.compressed_size
-        num_partitions = math.ceil(offset / split_size) if offset else 0
+        # Partition count runs through the *last block's* partition (pinned
+        # by the reference's BlocksTest boundaries golden: trailing empties
+        # beyond it are not materialized).
+        num_partitions = last_partition + 1
         return Blocks(
             partitions=[partitions.get(i, []) for i in range(num_partitions)],
             bounds=[

@@ -1,0 +1,83 @@
+"""Block partition planner vs the reference's BlocksTest goldens
+(check/src/test/.../BlocksTest.scala:85-232, IndexedBlocksTest /
+UnindexedBlocksTest)."""
+
+import shutil
+
+import pytest
+
+from spark_bam_tpu.check.blocks import plan_blocks
+from spark_bam_tpu.core.config import Config
+from spark_bam_tpu.core.ranges import parse_ranges
+
+
+def starts(blocks):
+    return [[m.start for m in p] for p in blocks.partitions]
+
+
+def test_all_blocks_100k(bam1):
+    blocks = plan_blocks(bam1, Config(split_size=100 << 10))
+    assert starts(blocks) == [
+        [0, 14146, 39374, 65429, 89707],
+        [113583, 138333, 163285, 188181],
+        [213608, 239479, 263656, 287709],
+        [312794, 336825, 361204, 386382],
+        [410905, 435247, 459832, 484396, 508565],
+        [533464, 558458, 583574],
+    ]
+    assert blocks.bounds == [
+        (0, 102400), (102400, 204800), (204800, 307200),
+        (307200, 409600), (409600, 512000), (512000, 614400),
+    ]
+
+
+def test_header_block_only(bam1):
+    blocks = plan_blocks(bam1, Config(), ranges=parse_ranges("0"))
+    assert starts(blocks) == [[0]]
+    assert blocks.bounds == [(0, 2097152)]
+
+
+def test_intra_header_block_range(bam1):
+    blocks = plan_blocks(bam1, Config(), ranges=parse_ranges("0+10k"))
+    assert starts(blocks) == [[0]]
+    assert blocks.bounds == [(0, 2097152)]
+
+
+def test_block_boundaries_indexed(bam1):
+    blocks = plan_blocks(
+        bam1,
+        Config(split_size=10 << 10),
+        ranges=parse_ranges("10k-39374,287709-312795"),
+    )
+    assert starts(blocks) == [[14146], [], [287709], [], [312794]]
+    assert blocks.bounds == [
+        (0, 10240), (10240, 20480), (20480, 30720),
+        (30720, 40960), (40960, 51200),
+    ]
+
+
+def test_block_boundaries_unindexed(bam1, tmp_path):
+    # Without a .blocks sidecar the search path plans by file-offset splits
+    # overlapping the ranges (UnindexedBlocksTest golden).
+    bam_copy = tmp_path / "noblocks.bam"
+    shutil.copyfile(bam1, bam_copy)
+    blocks = plan_blocks(
+        bam_copy,
+        Config(split_size=10 << 10),
+        ranges=parse_ranges("10k-39374,287709-312795"),
+    )
+    assert starts(blocks) == [[14146], [], [], [287709], [], [312794]]
+    assert blocks.bounds == [
+        (10240, 20480), (20480, 30720), (30720, 40960),
+        (286720, 296960), (296960, 307200), (307200, 317440),
+    ]
+
+
+def test_unindexed_matches_indexed_plan(bam2, tmp_path):
+    bam_copy = tmp_path / "noblocks2.bam"
+    shutil.copyfile(bam2, bam_copy)
+    indexed = plan_blocks(bam2, Config(split_size=100 << 10))
+    searched = plan_blocks(bam_copy, Config(split_size=100 << 10))
+    assert [m.start for p in searched.partitions for m in p] == [
+        m.start for p in indexed.partitions for m in p
+    ]

@@ -210,3 +210,16 @@ def test_full_check_noindex_golden(bam1, tmp_path):
     shutil.copyfile(bam1, bam_copy)
     got = run_cli(["full-check", str(bam_copy)], tmp_path)
     assert got == (GOLDEN / "full-check" / "1.noblocks.bam").read_text()
+
+
+def test_check_blocks_1bam_default_and_spark(bam1, tmp_path):
+    # Default (eager vs seqdoop) mismatches exactly like -u; -s (truth vs
+    # eager) matches everywhere (CheckBlocksTest.scala:9-53).
+    got = run_cli(["check-blocks", str(bam1)], tmp_path, "d.txt")
+    assert got.splitlines()[0] == "First read-position mismatched in 1 of 25 BGZF blocks"
+    assert "\t239479 (prev block size: 25871):\t239479:312\t239479:311" in got
+
+    got_s = run_cli(["check-blocks", "-s", str(bam1)], tmp_path, "s.txt")
+    assert got_s.splitlines()[0] == (
+        "First read-position matched in 25 BGZF blocks totaling 583KB (compressed)"
+    )
