@@ -1,0 +1,55 @@
+"""Forcing jax onto a virtual multi-device CPU platform.
+
+This image's sitecustomize imports jax at interpreter startup and pins
+``JAX_PLATFORMS`` to the real TPU tunnel, so caller-set env vars alone are
+latched too late; the platform must also be forced through the config API.
+Shared by ``tests/conftest.py`` and ``__graft_entry__.dryrun_multichip`` so
+the subtle bootstrap lives in exactly one place.
+
+This module must stay importable without pulling in jax at module scope.
+"""
+
+import os
+import re
+
+_COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def force_cpu_devices(n_devices: int) -> None:
+    """Force jax onto ``n_devices`` virtual CPU devices.
+
+    Must run before any jax backend is initialized (first ``jax.devices()`` /
+    first traced computation); after that the host-device-count flag is
+    latched and this has no effect.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    opt = f"{_COUNT_FLAG}={n_devices}"
+    if _COUNT_FLAG in flags:
+        flags = re.sub(rf"{_COUNT_FLAG}=\d+", opt, flags)
+    else:
+        flags = f"{flags} {opt}".strip()
+    os.environ["XLA_FLAGS"] = flags
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    try:
+        import jax
+    except ImportError:
+        # Env vars are set; a later jax install in this process still sees
+        # them. Callers that need jax will fail at their own import site.
+        return
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
+    # Initializing here (with our flags set) both latches the virtual-device
+    # count and lets us fail loud instead of silently running on the real
+    # TPU tunnel when some earlier import already initialized a backend.
+    if jax.default_backend() != "cpu" or len(jax.devices("cpu")) < n_devices:
+        raise RuntimeError(
+            f"force_cpu_devices({n_devices}) too late: a jax backend was "
+            f"already initialized (default={jax.default_backend()!r}, "
+            f"cpu devices={len(jax.devices('cpu'))}); call it before any "
+            "jax.devices()/traced computation, or use a fresh process"
+        )

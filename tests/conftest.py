@@ -5,30 +5,19 @@ exercised without TPU hardware; set the XLA flags before jax is imported
 anywhere.
 """
 
-import os
 import sys
 from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
 
 # Force, not setdefault: the environment pins JAX_PLATFORMS to the real TPU
 # tunnel; tests want the fast deterministic CPU backend with 8 virtual
 # devices so multi-chip sharding is exercised. Real-TPU runs go through
 # bench.py / __graft_entry__.py.
-os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
-)
-os.environ["JAX_PLATFORMS"] = "cpu"
+from spark_bam_tpu.core.platform import force_cpu_devices  # noqa: E402
 
-# The environment's sitecustomize imports jax before this conftest runs, so
-# the env var alone is too late — override through the config API as well.
-try:
-    import jax
-
-    jax.config.update("jax_platforms", "cpu")
-except Exception:
-    pass
-
-REPO_ROOT = Path(__file__).resolve().parent.parent
-sys.path.insert(0, str(REPO_ROOT))
+force_cpu_devices(8)
 
 import pytest  # noqa: E402
 
