@@ -53,14 +53,20 @@ class FlatView:
         return self.block_starts[idx], flat - self.block_flat[idx]
 
 
-def _inflate_one(ch: ByteChannel, meta: Metadata, out: np.ndarray, flat_off: int):
+def read_block_payload(ch: ByteChannel, meta: Metadata):
+    """The raw-DEFLATE payload bytes of one block (header/footer stripped);
+    zero-copy on mmap-backed channels."""
     if isinstance(ch, MMapChannel):
         comp = ch.memoryview(meta.start, meta.compressed_size)
     else:
         ch.seek(meta.start)
         comp = ch.read_fully(meta.compressed_size)
     header = Header.parse(comp[:18])
-    payload = comp[header.size: meta.compressed_size - FOOTER_SIZE]
+    return comp[header.size: meta.compressed_size - FOOTER_SIZE]
+
+
+def _inflate_one(ch: ByteChannel, meta: Metadata, out: np.ndarray, flat_off: int):
+    payload = read_block_payload(ch, meta)
     data = inflate_block_payload(payload, meta.uncompressed_size)
     out[flat_off: flat_off + len(data)] = np.frombuffer(data, dtype=np.uint8)
 

@@ -70,6 +70,11 @@ def load_native():
         c_u8p, ctypes.c_int64, ctypes.c_int64,
         c_i32p, ctypes.c_int32, ctypes.c_int32, ctypes.c_int64,
     ]
+    lib.sbt_tokenize_deflate.restype = ctypes.c_long
+    lib.sbt_tokenize_deflate.argtypes = [
+        c_u8p, c_i64p, c_i64p, ctypes.c_int64,
+        c_u8p, c_i32p, ctypes.c_int64, c_i64p,
+    ]
     _LIB_CACHE.append(lib)
     return lib
 
@@ -122,6 +127,41 @@ def find_record_start_native(
             reads_to_check, max_read_size,
         )
     )
+
+
+def tokenize_deflate_native(
+    comp: np.ndarray,
+    offsets: np.ndarray,
+    lengths: np.ndarray,
+    stride: int,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray] | None:
+    """Phase 1 of the two-phase device inflate: entropy-decode raw-DEFLATE
+    payloads into fixed-shape (lit, parent, out_lens) token rows for the
+    device LZ77 resolver (tpu/inflate.py). Returns None if the native
+    library is unavailable."""
+    lib = load_native()
+    if lib is None:
+        return None
+    comp = np.ascontiguousarray(comp, dtype=np.uint8)
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    lengths = np.ascontiguousarray(lengths, dtype=np.int64)
+    count = len(offsets)
+    lit = np.empty((count, stride), dtype=np.uint8)
+    parent = np.empty((count, stride), dtype=np.int32)
+    out_lens = np.zeros(count, dtype=np.int64)
+    rc = lib.sbt_tokenize_deflate(
+        _ptr(comp, ctypes.c_uint8),
+        _ptr(offsets, ctypes.c_int64),
+        _ptr(lengths, ctypes.c_int64),
+        count,
+        _ptr(lit, ctypes.c_uint8),
+        _ptr(parent, ctypes.c_int32),
+        stride,
+        _ptr(out_lens, ctypes.c_int64),
+    )
+    if rc != 0:
+        raise IOError(f"deflate tokenize failed at block {rc - 1}")
+    return lit, parent, out_lens
 
 
 def inflate_blocks_native(

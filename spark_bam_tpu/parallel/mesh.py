@@ -127,7 +127,16 @@ def make_shard_map_check_step(mesh: Mesh, reads_to_check: int = 10, axis: str = 
     over the mesh axis — the XLA collective riding ICI. Semantically
     identical; kept as the explicit form the multi-host deployment uses.
     """
-    from jax.experimental.shard_map import shard_map
+    try:
+        from jax import shard_map as _shard_map
+
+        def shard_map(f, *, mesh, in_specs, out_specs, check_rep):
+            return _shard_map(
+                f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                check_vma=check_rep,
+            )
+    except ImportError:  # jax < 0.7
+        from jax.experimental.shard_map import shard_map
 
     def local_step(windows, ns, at_eofs, truth, lengths, num_contigs):
         def one(window, n, at_eof, tr):

@@ -1,29 +1,29 @@
-"""BGZF inflate feeding the device: host-parallel path + Pallas plan.
+"""BGZF inflate feeding the device: host-parallel path + two-phase device path.
 
-Today's production path inflates on host (zlib releases the GIL; a thread
-pool saturates cores — bgzf/flat.py) and ships flat windows to HBM. That is
+Production path A inflates on host (zlib releases the GIL; a thread pool
+saturates cores — bgzf/flat.py) and ships flat windows to HBM. That is
 already off the critical path for the checker speedup: SURVEY.md §7 "the
 checker/parser speedup does not depend on it [device DEFLATE]".
 
-``InflatePipeline`` overlaps the three stages per window —
-read+inflate (host threads) → H2D transfer → device kernel — double-buffered
-so the device never waits on the host for steady-state streams.
+Path B is the **two-phase device inflate** (SURVEY §7 hard-part #1).
+Bit-serial Huffman decoding resists lane-parallelism, so the split is:
 
-Pallas DEFLATE design (the round-2+ kernel, SURVEY §7 hard-part #1):
-bit-serial Huffman decoding with data-dependent back-references resists
-lane-parallelism, so the plan is block-parallel, not bit-parallel:
+1. *Host entropy phase* (`sbt_tokenize_deflate`, native/): decode the
+   DEFLATE bitstream into per-output-byte tokens — ``lit[i]`` (the byte, if
+   position ``i`` was emitted by a literal) and ``parent[i]`` (``i`` for
+   literals; ``i - dist`` for back-reference bytes). No byte copying
+   happens on host: the LZ77 "copy" half of inflate — the memory-bandwidth
+   half — is deferred entirely.
+2. *Device copy phase* (`resolve_lz77`): every output byte's value is the
+   byte at its pointer chain's root literal. Chains collapse in
+   ``log2(64 KiB) = 16`` lock-step pointer-doubling rounds — pure gathers
+   over a (blocks, 64 Ki) batch, fully lane-parallel, the same shape the
+   checker's chain walk uses. Overlapping copies (RLE runs) are just deep
+   chains; correctness is depth-independent.
 
-1. one BGZF block (≤64 KiB uncompressed) per grid step; many blocks in
-   flight across grid steps — throughput from pipelining, not SIMD;
-2. per block, a two-phase decode in VMEM:
-   a. Huffman phase: build the code tables from the dynamic header in SMEM,
-      then decode symbols with a 12-bit lookup table (fits VMEM); emit
-      (literal | (dist, len)) tuples to a VMEM staging buffer;
-   b. copy phase: resolve LZ77 back-references with `lax.while_loop` over
-      the staging buffer — references reach ≤32 KiB back, inside the block's
-      own VMEM scratch, so no HBM round-trips;
-3. CRC32 validation on device (slice-by-8 table in VMEM) so corrupt blocks
-   are flagged without host involvement.
+``InflatePipeline`` overlaps the stages per window — read+tokenize/inflate
+(host threads) → H2D transfer → device kernel — double-buffered so the
+device never waits on the host for steady-state streams.
 
 Keeping host zlib as the correctness fallback is permanent policy: the
 checker consumes identical flat windows from either producer.
@@ -31,14 +31,133 @@ checker consumes identical flat windows from either producer.
 
 from __future__ import annotations
 
+import functools
 from concurrent.futures import ThreadPoolExecutor
 from typing import Iterator
 
 import numpy as np
 
-from spark_bam_tpu.bgzf.block import Metadata
-from spark_bam_tpu.bgzf.flat import FlatView, inflate_blocks
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from spark_bam_tpu.bgzf.block import MAX_BLOCK_SIZE, Metadata
+from spark_bam_tpu.bgzf.flat import FlatView, inflate_blocks, read_block_payload
 from spark_bam_tpu.core.channel import open_channel
+
+# Fixed token-row width: one BGZF block inflates to ≤ MAX_BLOCK_SIZE
+# (reference Block.scala:49-51).
+STRIDE = MAX_BLOCK_SIZE
+_DOUBLING_ROUNDS = (STRIDE - 1).bit_length()  # collapses any chain in-range
+
+
+@jax.jit
+def resolve_lz77(lit: jnp.ndarray, parent: jnp.ndarray) -> jnp.ndarray:
+    """Device phase 2: resolve all LZ77 back-references in parallel.
+
+    ``lit``/``parent`` are (B, STRIDE) token rows from the host entropy
+    phase. Pointer chains (copy → … → root literal) collapse with log-step
+    doubling — ``parent = parent[parent]`` per round — then one final
+    gather reads each root's literal byte. 16 rounds cover any chain that
+    fits a 64 KiB block; padded tails are identity pointers, so they
+    resolve to themselves harmlessly.
+    """
+
+    def round_(p, _):
+        return jnp.take_along_axis(p, p, axis=1), None
+
+    roots, _ = lax.scan(round_, parent, None, length=_DOUBLING_ROUNDS)
+    return jnp.take_along_axis(lit, roots, axis=1)
+
+
+def inflate_blocks_device(
+    comp: np.ndarray,
+    offsets: np.ndarray,
+    lengths: np.ndarray,
+    out_lengths: np.ndarray,
+) -> np.ndarray | None:
+    """Two-phase inflate of raw-DEFLATE payloads: host tokenize + device
+    LZ77 resolution. Returns the concatenated output bytes, or None when
+    the native tokenizer is unavailable (callers fall back to zlib)."""
+    from spark_bam_tpu.native.build import tokenize_deflate_native
+
+    toks = tokenize_deflate_native(comp, offsets, lengths, stride=STRIDE)
+    if toks is None:
+        return None
+    lit, parent, out_lens = toks
+    out_lengths = np.asarray(out_lengths, dtype=np.int64)
+    if not np.array_equal(out_lens, out_lengths):
+        raise IOError("tokenized output sizes disagree with block footers")
+    # Pad the batch dim to a power of two so jit shape churn is bounded to
+    # log2(max blocks) compiles, not one per distinct window block count.
+    b = len(out_lens)
+    b_pad = max(1 << max(b - 1, 0).bit_length(), 1)
+    if b_pad != b:
+        lit = np.concatenate([lit, np.zeros((b_pad - b, STRIDE), dtype=np.uint8)])
+        ident = np.broadcast_to(
+            np.arange(STRIDE, dtype=np.int32), (b_pad - b, STRIDE)
+        )
+        parent = np.concatenate([parent, ident])
+    resolved = np.asarray(
+        resolve_lz77(jnp.asarray(lit), jnp.asarray(parent))
+    )[:b]
+    return np.concatenate(
+        [resolved[i, :n] for i, n in enumerate(out_lens.tolist())]
+    ) if len(out_lens) else np.empty(0, dtype=np.uint8)
+
+
+def inflate_group_device(
+    ch,
+    metas: list[Metadata],
+    file_total: int | None = None,
+    at_eof: bool = False,
+) -> FlatView | None:
+    """Two-phase device inflate of a run of blocks → FlatView (the device
+    producer counterpart of bgzf/flat.py inflate_blocks)."""
+    comp_parts, offs, lens = [], [], []
+    off = 0
+    for m in metas:
+        payload = np.frombuffer(read_block_payload(ch, m), dtype=np.uint8)
+        comp_parts.append(payload)
+        offs.append(off)
+        lens.append(len(payload))
+        off += len(payload)
+    comp = (
+        np.concatenate(comp_parts) if comp_parts else np.empty(0, dtype=np.uint8)
+    )
+    usizes = np.array([m.uncompressed_size for m in metas], dtype=np.int64)
+    data = inflate_blocks_device(
+        comp, np.array(offs, dtype=np.int64), np.array(lens, dtype=np.int64), usizes
+    )
+    if data is None:
+        return None
+    block_flat = np.zeros(len(metas), dtype=np.int64)
+    if len(metas):
+        np.cumsum(usizes[:-1], out=block_flat[1:])
+    total = int(usizes.sum())
+    return FlatView(
+        data,
+        np.array([m.start for m in metas], dtype=np.int64),
+        block_flat,
+        file_total,
+        at_eof or (file_total is not None and total == file_total),
+    )
+
+
+def inflate_file_device(path) -> FlatView | None:
+    """Whole-file two-phase device inflate → FlatView (mirrors
+    bgzf/flat.py flatten_file, with the device doing the copy phase)."""
+    from spark_bam_tpu.bgzf.index_blocks import blocks_metadata
+
+    metas = list(blocks_metadata(path))
+    with open_channel(path) as ch:
+        view = inflate_group_device(
+            ch,
+            metas,
+            file_total=sum(m.uncompressed_size for m in metas),
+            at_eof=True,
+        )
+    return view
 
 
 def window_plan(metas: list[Metadata], window_uncompressed: int) -> list[list[Metadata]]:
@@ -60,7 +179,13 @@ def window_plan(metas: list[Metadata], window_uncompressed: int) -> list[list[Me
 class InflatePipeline:
     """Double-buffered host-inflate → device-window stream."""
 
-    def __init__(self, path, window_uncompressed: int = 64 << 20, threads: int = 8):
+    def __init__(
+        self,
+        path,
+        window_uncompressed: int = 64 << 20,
+        threads: int = 8,
+        device_copy: bool = False,
+    ):
         from spark_bam_tpu.bgzf.index_blocks import blocks_metadata
 
         self.path = path
@@ -68,12 +193,25 @@ class InflatePipeline:
         self.total = sum(m.uncompressed_size for m in self.metas)
         self.groups = window_plan(self.metas, window_uncompressed)
         self.threads = threads
+        self.device_copy = device_copy
 
     def __iter__(self) -> Iterator[FlatView]:
         ch = open_channel(self.path)
         pool = ThreadPoolExecutor(max_workers=1)  # pipeline stage, not fan-out
 
         def produce(group):
+            if self.device_copy:
+                # Host zlib is the permanent correctness fallback: a stream
+                # the tokenizer can't take (or a size disagreement) demotes
+                # the window, never kills the pipeline.
+                try:
+                    view = inflate_group_device(ch, group, file_total=self.total)
+                except Exception:
+                    # Any device-phase failure (bad stream, device OOM, …)
+                    # demotes the window, never kills the stream.
+                    view = None
+                if view is not None:
+                    return view
             return inflate_blocks(
                 ch, group, file_total=self.total, threads=self.threads
             )

@@ -1,0 +1,202 @@
+"""Two-phase device inflate: host entropy tokenize + device LZ77 resolution.
+
+Differential tests against zlib — the permanent correctness oracle
+(SURVEY.md §7 hard-part #1: "keep host-zlib as the correctness fallback").
+Covers all three DEFLATE block types (stored / fixed / dynamic Huffman),
+deep overlapping-copy chains (RLE), multi-block streams, and a whole
+reference BAM.
+"""
+
+import zlib
+
+import numpy as np
+import pytest
+
+from spark_bam_tpu.bgzf.flat import flatten_file
+from spark_bam_tpu.native.build import load_native, tokenize_deflate_native
+from spark_bam_tpu.tpu.inflate import (
+    STRIDE,
+    inflate_blocks_device,
+    inflate_file_device,
+)
+
+pytestmark = pytest.mark.skipif(
+    load_native() is None, reason="native runtime unavailable"
+)
+
+
+def _deflate(data: bytes, level: int = 6, strategy: int = zlib.Z_DEFAULT_STRATEGY):
+    co = zlib.compressobj(level, zlib.DEFLATED, -15, 8, strategy)
+    return co.compress(data) + co.flush()
+
+
+def _roundtrip_one(data: bytes, **kw) -> None:
+    comp = np.frombuffer(_deflate(data, **kw), dtype=np.uint8)
+    out = inflate_blocks_device(
+        comp,
+        np.array([0], dtype=np.int64),
+        np.array([len(comp)], dtype=np.int64),
+        np.array([len(data)], dtype=np.int64),
+    )
+    assert out is not None
+    assert out.tobytes() == data
+
+
+def test_dynamic_huffman_roundtrip():
+    rng = np.random.default_rng(0)
+    # Compressible but non-trivial: repeated 64-byte motifs + noise.
+    motifs = rng.integers(0, 256, (8, 64), dtype=np.uint8)
+    picks = rng.integers(0, 8, 500)
+    data = np.concatenate([motifs[p] for p in picks]).tobytes()
+    _roundtrip_one(data)
+
+
+def test_stored_blocks():
+    rng = np.random.default_rng(1)
+    data = rng.integers(0, 256, 40_000, dtype=np.uint8).tobytes()
+    _roundtrip_one(data, level=0)
+
+
+def test_fixed_huffman():
+    _roundtrip_one(b"fixed huffman " * 200, strategy=zlib.Z_FIXED)
+
+
+def test_deep_rle_chains():
+    # dist=1 overlapping copies: every byte's chain points at the single
+    # root literal through a ~64K-deep chain — the pointer-doubling
+    # worst case.
+    _roundtrip_one(b"a" * (STRIDE - 1))
+
+
+def test_empty_payload():
+    _roundtrip_one(b"")
+
+
+def test_batched_blocks_roundtrip():
+    rng = np.random.default_rng(2)
+    datas = [
+        b"x" * striped
+        for striped in (1, 100, 65_535)
+    ] + [rng.integers(0, 256, 10_000, dtype=np.uint8).tobytes()]
+    comps = [np.frombuffer(_deflate(d), dtype=np.uint8) for d in datas]
+    offsets = np.zeros(len(comps), dtype=np.int64)
+    lengths = np.array([len(c) for c in comps], dtype=np.int64)
+    np.cumsum(lengths[:-1], out=offsets[1:])
+    out = inflate_blocks_device(
+        np.concatenate(comps),
+        offsets,
+        lengths,
+        np.array([len(d) for d in datas], dtype=np.int64),
+    )
+    assert out.tobytes() == b"".join(datas)
+
+
+def test_no_distance_codes_stream():
+    # RFC 1951 §3.2.7: a match-free block may declare a single distance
+    # code of zero bits. Real encoders (libdeflate in htslib) emit this
+    # shape; the tokenizer must accept it. Hand-assembled: dynamic block,
+    # litlen lens {65:1, 256:1}, one zero-length dist code, data "AA".
+    bits = []
+
+    def put(value, n):  # LSB-first field
+        bits.extend((value >> k) & 1 for k in range(n))
+
+    def put_code(code, n):  # Huffman code, MSB-first
+        bits.extend((code >> (n - 1 - k)) & 1 for k in range(n))
+
+    put(1, 1)   # BFINAL
+    put(2, 2)   # BTYPE = dynamic
+    put(0, 5)   # HLIT  = 257 codes
+    put(0, 5)   # HDIST = 1 code
+    put(14, 4)  # HCLEN = 18 entries
+    # Code-length code lens in the fixed order 16,17,18,0,8,7,...,1:
+    # {0:2, 1:2, 17:2, 18:2}, canonical codes 00,01,10,11.
+    for cl_len in [0, 2, 2, 2] + [0] * 13 + [2]:
+        put(cl_len, 3)
+    cl = {0: (0, 2), 1: (1, 2), 17: (2, 2), 18: (3, 2)}
+
+    def put_cl(sym):
+        put_code(*cl[sym])
+
+    put_cl(18); put(65 - 11, 7)    # 65 zeros
+    put_cl(1)                      # symbol 65 ('A') → len 1
+    put_cl(18); put(138 - 11, 7)   # 138 zeros
+    put_cl(18); put(52 - 11, 7)    # 52 zeros  (66..255 = 190 total)
+    put_cl(1)                      # symbol 256 (EOB) → len 1
+    put_cl(0)                      # the single dist code: len 0
+    # Payload: 'A' 'A' EOB with litlen codes {65: 0, 256: 1}.
+    put_code(0, 1); put_code(0, 1); put_code(1, 1)
+
+    raw = bytearray()
+    for i in range(0, len(bits), 8):
+        raw.append(sum(b << k for k, b in enumerate(bits[i: i + 8])))
+    raw = bytes(raw)
+    assert zlib.decompress(raw, -15) == b"AA"  # the stream really is valid
+
+    out = inflate_blocks_device(
+        np.frombuffer(raw, dtype=np.uint8),
+        np.array([0], dtype=np.int64),
+        np.array([len(raw)], dtype=np.int64),
+        np.array([2], dtype=np.int64),
+    )
+    assert out.tobytes() == b"AA"
+
+
+def test_tokenizer_rejects_truncated_stream():
+    comp = np.frombuffer(_deflate(b"hello world" * 50), dtype=np.uint8)
+    with pytest.raises(IOError):
+        inflate_blocks_device(
+            comp[: len(comp) // 2],
+            np.array([0], dtype=np.int64),
+            np.array([len(comp) // 2], dtype=np.int64),
+            np.array([550], dtype=np.int64),
+        )
+
+
+def test_size_mismatch_raises():
+    comp = np.frombuffer(_deflate(b"hello world" * 50), dtype=np.uint8)
+    with pytest.raises(IOError):
+        inflate_blocks_device(
+            comp,
+            np.array([0], dtype=np.int64),
+            np.array([len(comp)], dtype=np.int64),
+            np.array([549], dtype=np.int64),  # footer lies about the size
+        )
+
+
+def test_tokenize_shapes():
+    data = b"shape check " * 32
+    comp = np.frombuffer(_deflate(data), dtype=np.uint8)
+    lit, parent, out_lens = tokenize_deflate_native(
+        comp,
+        np.array([0], dtype=np.int64),
+        np.array([len(comp)], dtype=np.int64),
+        stride=STRIDE,
+    )
+    assert lit.shape == (1, STRIDE) and parent.shape == (1, STRIDE)
+    assert out_lens[0] == len(data)
+    # Padded tail must be identity pointers.
+    tail = np.arange(len(data), STRIDE, dtype=np.int32)
+    assert np.array_equal(parent[0, len(data):], tail)
+
+
+def test_pipeline_device_copy_matches_host(bam2):
+    from spark_bam_tpu.tpu.inflate import InflatePipeline
+
+    host = flatten_file(bam2)
+    views = list(InflatePipeline(bam2, window_uncompressed=256 << 10,
+                                 device_copy=True))
+    assert len(views) > 1  # multiple windows actually exercised
+    got = np.concatenate([v.data for v in views])
+    assert np.array_equal(got, host.data)
+    assert views[-1].at_eof
+
+
+def test_whole_bam_matches_host_inflate(bam2):
+    host = flatten_file(bam2)
+    dev = inflate_file_device(bam2)
+    assert dev is not None
+    assert np.array_equal(dev.data, host.data)
+    assert np.array_equal(dev.block_starts, host.block_starts)
+    assert np.array_equal(dev.block_flat, host.block_flat)
+    assert dev.at_eof
