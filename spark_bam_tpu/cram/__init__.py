@@ -1,0 +1,18 @@
+"""CRAM 3.0 container format: reader, writer, codecs.
+
+The reference delegates ``.cram`` loading to an external library
+(load/.../CanLoadBam.scala:348-382 → hadoop-bam ``CRAMInputFormat`` +
+htsjdk). No such library exists here, so the capability is built in: a
+from-scratch CRAM 3.0 implementation — containers/slices/blocks, ITF8/LTF8
+varints, the core-block bit codecs (HUFFMAN/BETA/BYTE_ARRAY_*/EXTERNAL),
+rANS 4x8 entropy coding, reference-based and reference-less record decode —
+feeding the same ``BamRecord``/``Dataset`` surfaces as the BAM path.
+
+Containers are the CRAM analog of BGZF blocks for split planning: they are
+self-delimiting, so ``load_cram`` partitions a file by container byte
+ranges exactly the way ``Blocks`` partitions BGZF files (SURVEY.md §2.8).
+"""
+
+from spark_bam_tpu.cram.reader import CramReader, load_cram_header
+from spark_bam_tpu.cram.writer import CramWriter
+

@@ -168,22 +168,68 @@ def load_sam(
     return Dataset(ranges, compute, parallel)
 
 
+def load_cram(
+    path,
+    split_size=None,
+    config: Config = Config(),
+    parallel: ParallelConfig = ParallelConfig(),
+    reference=None,
+) -> Dataset:
+    """Records of a CRAM, partitioned by container byte ranges.
+
+    The reference delegates .cram to hadoop-bam's ``CRAMInputFormat``
+    (CanLoadBam.scala:354-366), whose splits are container-aligned; here
+    the built-in CRAM reader (cram/) supplies the container table and the
+    decode. ``reference`` (FASTA path or {name: bytes}) is needed only for
+    files with reference-based sequence encoding (``RR=true``)."""
+    from spark_bam_tpu.cram import CramReader
+
+    if isinstance(reference, (str, bytes)) or hasattr(reference, "__fspath__"):
+        from spark_bam_tpu.cram.fasta import read_fasta
+
+        reference = read_fasta(reference)  # parse once, not per partition
+    config = config.replace(split_size=split_size) if split_size else config
+    size = config.split_size_or(Config.LOAD_SPLIT_SIZE_DEFAULT)
+    with CramReader(path) as r:
+        infos = r.container_infos()
+    groups: list[list] = []
+    cur: list = []
+    cur_bytes = 0
+    for info in infos:
+        length = info.end - info.offset
+        if cur and cur_bytes + length > size:
+            groups.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(info)
+        cur_bytes += length
+    if cur:
+        groups.append(cur)
+
+    def compute(group):
+        with CramReader(path, reference=reference) as r:
+            yield from r.records(group[0].offset, group[-1].offset + 1)
+
+    return Dataset(groups, compute, parallel)
+
+
 def load_reads(
     path,
     split_size=None,
     config: Config = Config(),
     parallel: ParallelConfig = ParallelConfig(),
+    reference=None,
 ) -> Dataset:
-    """Extension dispatch: .sam / .bam (.cram requires a reference-guided
-    codec — not implemented; reference delegates it to hadoop-bam too,
-    CanLoadBam.scala:348-382)."""
+    """Extension dispatch: .sam / .bam / .cram (ref CanLoadBam.scala:348-382;
+    the reference delegates .cram to hadoop-bam, here it's built in).
+    ``reference`` is forwarded to the CRAM loader for reference-based
+    (RR=true) files; other formats ignore it."""
     s = str(path)
     if s.endswith(".sam"):
         return load_sam(path, split_size, config, parallel)
     if s.endswith(".bam"):
         return load_bam(path, split_size, config, parallel)
     if s.endswith(".cram"):
-        raise NotImplementedError("CRAM loading is not supported yet")
+        return load_cram(path, split_size, config, parallel, reference=reference)
     raise ValueError(f"Can't tell format of path: {s}")
 
 

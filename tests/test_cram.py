@@ -1,0 +1,342 @@
+"""CRAM 3.0: varints, rANS, codecs, writer/reader round-trips, load_cram.
+
+The reference's .cram support is delegation to hadoop-bam/htsjdk
+(CanLoadBam.scala:348-382); here the format is built in, so the tests are
+(a) primitive round-trips, (b) the canonical EOF sentinel byte-for-byte,
+(c) full-fidelity record round-trips over the reference BAM fixtures, and
+(d) container-partitioned loading.
+"""
+
+import numpy as np
+import pytest
+
+from spark_bam_tpu.bam.iterators import RecordStream
+from spark_bam_tpu.bam.record import BamRecord
+from spark_bam_tpu.bgzf.stream import BlockStream, UncompressedBytes
+from spark_bam_tpu.core.channel import open_channel
+from spark_bam_tpu.cram import CramReader, CramWriter, rans
+from spark_bam_tpu.cram.codecs import (
+    BitReader,
+    BitWriter,
+    Decoders,
+    beta,
+    huffman,
+)
+from spark_bam_tpu.cram.container import eof_container
+from spark_bam_tpu.cram.nums import Cursor, itf8, ltf8
+
+
+def read_bam(path):
+    stream = RecordStream(UncompressedBytes(BlockStream(open_channel(path))))
+    header = stream.header
+    recs = [rec for _, rec in stream]
+    stream.close()
+    return header, recs
+
+
+# ------------------------------------------------------------- primitives
+@pytest.mark.parametrize(
+    "value",
+    [0, 1, 127, 128, 0x3FFF, 0x4000, 0x1FFFFF, 0x200000, 0x0FFFFFFF,
+     0x10000000, 2**31 - 1, -1, -2, -(2**31)],
+)
+def test_itf8_roundtrip(value):
+    cur = Cursor(itf8(value))
+    assert cur.itf8() == value
+    assert cur.at_end()
+
+
+@pytest.mark.parametrize(
+    "value",
+    [0, 127, 128, 0x3FFF, 0x200000 - 1, 0x10000000, 2**34, 2**41, 2**48,
+     2**55, 2**62, -1, -(2**63)],
+)
+def test_ltf8_roundtrip(value):
+    cur = Cursor(ltf8(value))
+    assert cur.ltf8() == value
+    assert cur.at_end()
+
+
+def test_eof_container_is_canonical():
+    # The spec's fixed 38-byte v3.0 EOF, reproduced structurally (both
+    # CRCs computed, not pasted).
+    assert eof_container().hex() == (
+        "0f000000ffffffff0fe0454f4600000000010005bdd94f"
+        "0001000606010001000100ee63014b"
+    )
+
+
+@pytest.mark.parametrize("order", [0, 1])
+def test_rans_roundtrip(order):
+    rng = np.random.default_rng(7)
+    cases = [
+        b"",
+        b"a",
+        b"skewed " * 500,
+        bytes(rng.integers(0, 256, 10_000, dtype=np.uint8)),
+        bytes(rng.integers(33, 43, 30_000, dtype=np.uint8)),  # qual-like
+    ]
+    for data in cases:
+        assert rans.decompress(rans.compress(data, order)) == data
+
+
+def test_core_block_codecs():
+    # Huffman (multi-symbol + 0-bit constant), beta: encode with BitWriter,
+    # decode through the Decoders dispatch.
+    w = BitWriter()
+    # canonical codes for values [5, 6, 7] lens [1, 2, 2]: 5→0, 6→10, 7→11
+    for bits, n in [(0b0, 1), (0b10, 2), (0b11, 2), (0b0, 1)]:
+        w.write_bits(bits, n)
+    w.write_bits(37, 8)  # beta(offset=0, length=8)
+    dec = Decoders(BitReader(w.getvalue()), {})
+    h = dec.int_reader(huffman([5, 6, 7], [1, 2, 2]))
+    assert [h(), h(), h(), h()] == [5, 6, 7, 5]
+    b = dec.int_reader(beta(0, 8))
+    assert b() == 37
+    const = dec.int_reader(huffman([42], [0]))
+    assert const() == 42  # zero-bit constant reads nothing
+
+
+# ------------------------------------------------------------ round-trips
+@pytest.mark.parametrize("method", ["gzip", "rans", "raw"])
+def test_roundtrip_2bam(bam2, tmp_path, method):
+    header, recs = read_bam(bam2)
+    out = tmp_path / "2.cram"
+    with CramWriter(out, header.contig_lengths, header.text, method=method) as w:
+        w.write_all(recs)
+    with CramReader(out) as r:
+        back = list(r)
+        assert r.contigs == header.contig_lengths
+    assert back == recs  # full 13-field equality, bin/tags/quals included
+
+
+def test_roundtrip_multi_container(bam1, tmp_path):
+    header, recs = read_bam(bam1)
+    out = tmp_path / "1.cram"
+    with CramWriter(
+        out, header.contig_lengths, header.text, records_per_container=1000
+    ) as w:
+        w.write_all(recs)
+    with CramReader(out) as r:
+        infos = r.container_infos()
+        assert len(infos) == 5  # 4917 records / 1000 per container
+        assert [i.n_records for i in infos] == [1000] * 4 + [917]
+        assert [i.record_counter for i in infos] == [0, 1000, 2000, 3000, 4000]
+        assert back_count(r) == len(recs)
+        assert list(r) == recs
+
+
+def back_count(reader):
+    return sum(1 for _ in reader)
+
+
+def test_roundtrip_5k_with_unmapped(bam5k, tmp_path):
+    header, recs = read_bam(bam5k)
+    assert any(r.flag & 4 for r in recs)  # fixture has unmapped reads
+    out = tmp_path / "5k.cram"
+    with CramWriter(out, header.contig_lengths, header.text) as w:
+        w.write_all(recs)
+    with CramReader(out) as r:
+        assert list(r) == recs
+
+
+def test_seqless_mapped_record(tmp_path):
+    # Sequence '*' with a real cigar: CF_NO_SEQ path; cigar survives, seq
+    # and qual stay empty.
+    from spark_bam_tpu.bam.header import ContigLengths
+
+    contigs = ContigLengths({0: ("chr1", 1000)})
+    rec = BamRecord(
+        ref_id=0, pos=99, mapq=7, bin=4681, flag=0, next_ref_id=-1,
+        next_pos=-1, tlen=0, read_name="noseq",
+        cigar=[(30, 0), (5, 2), (20, 0)], seq="", qual=b"", tags=b"",
+    )
+    out = tmp_path / "noseq.cram"
+    with CramWriter(out, contigs) as w:
+        w.write(rec)
+    with CramReader(out) as r:
+        back = list(r)
+    assert len(back) == 1
+    got = back[0]
+    assert got.cigar == rec.cigar
+    assert got.seq == "" and got.qual == b""
+    assert got.read_name == "noseq" and got.mapq == 7
+
+
+def test_reference_based_decode():
+    # Feature reconstruction against a reference: match gaps pull bases
+    # from the FASTA, X substitutions go through the substitution matrix.
+    from spark_bam_tpu.bam.header import ContigLengths
+    from spark_bam_tpu.cram.bam_bridge import subst_tables
+    from spark_bam_tpu.cram.structure import DEFAULT_SUBST_MATRIX
+
+    reader = CramReader.__new__(CramReader)
+    reader.contigs = ContigLengths({0: ("c", 40)})
+    reader.reference = {"c": b"ACGTACGTACGTACGTACGT"}
+    sub = subst_tables(DEFAULT_SUBST_MATRIX)
+    # Hand-rolled series readers: 2 features — X at read pos 3 (code 0),
+    # D of 2 at read pos 5; rl=6, pos=0. Layout: gap M2, X, gap M1, D2, M2.
+    seq_feats = [("X", 3, 0), ("D", 5, 2)]
+    fi = iter(seq_feats)
+    state = {}
+
+    def r_fn():
+        return len(seq_feats)
+
+    def r_fc():
+        c, p, payload = next(fi)
+        state["payload"] = payload
+        state["pos"] = p
+        return ord(c)
+
+    prev = [0]
+
+    def r_fp():
+        d = state["pos"] - prev[0]
+        prev[0] = state["pos"]
+        return d
+
+    payload = lambda: state["payload"]  # noqa: E731
+    rec = reader._decode_mapped(
+        0, 0, 0, 6, 0, sub, None, 0, False,
+        r_fn, r_fc, r_fp,
+        payload, payload, payload, payload, payload,  # bb/in/sc/qq/bs
+        payload, payload, payload, payload,           # dl/rs/hc/pd
+        lambda: 60,                                   # mq
+        lambda: ord("N"), lambda: 0xFF,               # ba/qs
+        lambda n: b"#" * n,                           # qs bulk
+    )
+    # ref = ACGTACGT..; read: AC + subst(G→code0=A) + T + (del 2) + GT
+    assert rec.seq == "ACATGT"
+    assert rec.cigar == [(4, 0), (2, 2), (2, 0)]
+    assert rec.mapq == 60
+
+
+# ------------------------------------------------- foreign-writer surface
+def _foreign_cram(tmp_path, with_embedded: bool):
+    """Hand-assemble a container the way real writers shape them — core
+    bit-stream codecs (HUFFMAN/BETA), AP-delta, a single-ref slice, names
+    off, NF mate chaining, and (optionally) an embedded reference whose
+    slice starts mid-contig — none of which our own writer emits."""
+    from spark_bam_tpu.cram import codecs
+    from spark_bam_tpu.cram.container import (
+        COMPRESSION_HEADER,
+        CORE,
+        EXTERNAL,
+        MAPPED_SLICE,
+        RAW,
+        Block,
+        ContainerHeader,
+        file_definition,
+        sam_header_container,
+    )
+    from spark_bam_tpu.cram.structure import CompressionHeader, SliceHeader
+
+    ds = {
+        "BF": huffman([65, 129], [1, 1]),
+        "CF": huffman([0, 4], [1, 1]),
+        "RL": beta(0, 6),
+        "AP": beta(0, 4),
+        "NF": huffman([0], [0]),
+        "TL": huffman([0], [0]),
+        "FN": huffman([0, 1], [1, 1]),
+        "FC": huffman([ord("X")], [0]),
+        "FP": beta(0, 4),
+        "BS": huffman([1], [0]),
+        "MQ": beta(0, 7),
+    }
+    ch = CompressionHeader(
+        read_names_included=False,
+        ap_delta=True,
+        reference_required=True,
+        tag_dict=[[]],
+        data_series=ds,
+        tags={},
+    )
+    w = BitWriter()
+    # rec A: BF=65, CF=4 (mate downstream), RL=8, AP∆=0, NF=0 (0 bits),
+    #        TL (0 bits), FN=1, FC='X' (0 bits), FP∆=3, BS=1 (0 bits), MQ=30
+    w.write_bits(0, 1); w.write_bits(1, 1); w.write_bits(8, 6)
+    w.write_bits(0, 4); w.write_bits(1, 1); w.write_bits(3, 4)
+    w.write_bits(30, 7)
+    # rec B: BF=129, CF=0, RL=8, AP∆=5, TL, FN=0, MQ=30
+    w.write_bits(1, 1); w.write_bits(0, 1); w.write_bits(8, 6)
+    w.write_bits(5, 4); w.write_bits(0, 1); w.write_bits(30, 7)
+
+    ref_bytes = b"AACCGGTTAACCGGTT"  # contig "c" positions 10..25 (0-based)
+    blocks = [Block(CORE, 0, w.getvalue()).serialize(RAW)]
+    content_ids: list[int] = []
+    embedded_id = -1
+    if with_embedded:
+        blocks.append(Block(EXTERNAL, 100, ref_bytes).serialize(RAW))
+        content_ids = [100]
+        embedded_id = 100
+    sh = SliceHeader(
+        ref_seq_id=0, start=11, span=13, n_records=2, record_counter=0,
+        n_blocks=len(blocks), content_ids=content_ids,
+        embedded_ref_id=embedded_id,
+    )
+    ch_block = Block(COMPRESSION_HEADER, 0, ch.serialize()).serialize(RAW)
+    sh_block = Block(MAPPED_SLICE, 0, sh.serialize()).serialize(RAW)
+    body = ch_block + sh_block + b"".join(blocks)
+    hdr = ContainerHeader(
+        length=len(body), ref_seq_id=0, start=11, span=13, n_records=2,
+        record_counter=0, bases=16, n_blocks=2 + len(blocks),
+        landmarks=[len(ch_block)],
+    )
+    out = tmp_path / "foreign.cram"
+    with open(out, "wb") as f:
+        f.write(file_definition())
+        f.write(sam_header_container("@HD\tVN:1.6\n@SQ\tSN:c\tLN:100\n"))
+        f.write(hdr.serialize() + body)
+        f.write(eof_container())
+    return out
+
+
+def _check_foreign_records(recs):
+    a, b = recs
+    assert (a.flag, a.pos, a.mapq, a.read_name) == (65, 10, 30, "q0")
+    assert a.seq == "AAGCGGTT"  # gap M2 + X(code1: C→G) + gap M5 off the ref
+    assert a.cigar == [(8, 0)]
+    assert (b.flag, b.pos, b.seq) == (129, 15, "GTTAACCG")
+    # NF mate chaining resolved both directions.
+    assert (a.next_ref_id, a.next_pos, a.tlen) == (0, 15, 13)
+    assert (b.next_ref_id, b.next_pos, b.tlen) == (0, 10, -13)
+
+
+def test_foreign_shape_embedded_ref(tmp_path):
+    path = _foreign_cram(tmp_path, with_embedded=True)
+    with CramReader(path) as r:  # no external reference: embedded used
+        _check_foreign_records(list(r))
+
+
+def test_foreign_shape_external_reference(tmp_path):
+    path = _foreign_cram(tmp_path, with_embedded=False)
+    ref = {"c": b"??????????AACCGGTTAACCGGTT"}
+    with CramReader(path, reference=ref) as r:
+        _check_foreign_records(list(r))
+
+
+def test_reference_required_raises_without_reference(tmp_path):
+    path = _foreign_cram(tmp_path, with_embedded=False)
+    with CramReader(path) as r:
+        with pytest.raises(ValueError, match="RR=true"):
+            list(r)
+
+
+# ---------------------------------------------------------------- loading
+def test_load_cram_partitioned(bam2, tmp_path):
+    from spark_bam_tpu.load.api import load_cram, load_reads
+
+    header, recs = read_bam(bam2)
+    out = tmp_path / "2.cram"
+    with CramWriter(
+        out, header.contig_lengths, header.text, records_per_container=500
+    ) as w:
+        w.write_all(recs)
+    ds = load_cram(out, split_size=200 * 1024)
+    assert len(ds.partitions) > 1
+    got = list(ds)
+    assert got == recs
+    # Extension dispatch reaches the same loader.
+    assert sum(1 for _ in load_reads(out)) == len(recs)
