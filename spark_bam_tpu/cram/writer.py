@@ -70,6 +70,9 @@ class _ContainerBuilder:
         self.td_index: dict[tuple, int] = {}
         self.n_records = 0
         self.bases = 0
+        # ref id → [min 0-based start, max 0-based end) over mapped records;
+        # -1 present iff the slice holds unmapped reads. Feeds the .crai.
+        self.ref_spans: dict[int, list[int]] = {}
 
     def put_int(self, series: str, v: int) -> None:
         self.streams[series] += itf8(v)
@@ -96,6 +99,12 @@ class _ContainerBuilder:
                 # again on decode (CF_NO_SEQ).
                 rl = sum(ln for ln, op in rec.cigar if op in _READ_CONSUMING)
                 seq = "N" * rl
+        if rec.is_unmapped or rec.ref_id < 0:
+            self.ref_spans.setdefault(-1, [0, 0])
+        else:
+            span = self.ref_spans.setdefault(rec.ref_id, [rec.pos, rec.end_pos()])
+            span[0] = min(span[0], rec.pos)
+            span[1] = max(span[1], rec.end_pos())
         self.put_int("BF", flag)
         self.put_int("CF", cf)
         self.put_int("RI", rec.ref_id)
@@ -222,7 +231,9 @@ class _ContainerBuilder:
             n_blocks=3 + len(ext_blocks),
             landmarks=[len(ch_block)],
         )
-        return header.serialize() + blocks
+        slice_offset = len(ch_block)
+        slice_size = len(blocks) - slice_offset
+        return header.serialize() + blocks, slice_offset, slice_size
 
 
 class CramWriter:
@@ -233,10 +244,14 @@ class CramWriter:
         sam_text: str = "",
         records_per_container: int = 4096,
         method: str = "gzip",
+        index: bool = True,
     ):
+        self.path = path
         self.f = open(path, "wb")
         self.method = _METHODS[method]
         self.records_per_container = records_per_container
+        self.index = index
+        self.crai_entries: list = []
         self.counter = 0
         self.builder = _ContainerBuilder()
         text = sam_text or synthesize_sam_text(contigs)
@@ -254,15 +269,37 @@ class CramWriter:
 
     def _flush(self) -> None:
         if self.builder.n_records:
+            from spark_bam_tpu.cram.crai import CraiEntry
+
             start_counter = self.counter
             self.counter += self.builder.n_records
-            self.f.write(self.builder.serialize(start_counter, self.method))
+            container_offset = self.f.tell()
+            data, slice_offset, slice_size = self.builder.serialize(
+                start_counter, self.method
+            )
+            self.f.write(data)
+            for ref in sorted(self.builder.ref_spans):
+                lo, hi = self.builder.ref_spans[ref]
+                self.crai_entries.append(
+                    CraiEntry(
+                        ref,
+                        lo + 1 if ref >= 0 else 0,
+                        hi - lo if ref >= 0 else 0,
+                        container_offset,
+                        slice_offset,
+                        slice_size,
+                    )
+                )
             self.builder = _ContainerBuilder()
 
     def close(self) -> None:
         self._flush()
         self.f.write(eof_container())
         self.f.close()
+        if self.index:
+            from spark_bam_tpu.cram.crai import write_crai
+
+            write_crai(str(self.path) + ".crai", self.crai_entries)
 
     def __enter__(self):
         return self

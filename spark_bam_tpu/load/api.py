@@ -184,14 +184,32 @@ def load_cram(
     files with reference-based sequence encoding (``RR=true``)."""
     from spark_bam_tpu.cram import CramReader
 
-    if isinstance(reference, (str, bytes)) or hasattr(reference, "__fspath__"):
-        from spark_bam_tpu.cram.fasta import read_fasta
-
-        reference = read_fasta(reference)  # parse once, not per partition
+    reference = _resolve_reference(reference)
     config = config.replace(split_size=split_size) if split_size else config
     size = config.split_size_or(Config.LOAD_SPLIT_SIZE_DEFAULT)
     with CramReader(path) as r:
         infos = r.container_infos()
+    groups = _group_by_size(infos, size)
+
+    def compute(group):
+        with CramReader(path, reference=reference) as r:
+            yield from r.records(group[0].offset, group[-1].offset + 1)
+
+    return Dataset(groups, compute, parallel)
+
+
+def _resolve_reference(reference):
+    """FASTA path → {name: bytes}, parsed once (not per partition)."""
+    if isinstance(reference, (str, bytes)) or hasattr(reference, "__fspath__"):
+        from spark_bam_tpu.cram.fasta import read_fasta
+
+        return read_fasta(reference)
+    return reference
+
+
+def _group_by_size(infos, size: int) -> list[list]:
+    """Greedy size-capped grouping of container infos by compressed bytes
+    (the container analog of pack_chunks)."""
     groups: list[list] = []
     cur: list = []
     cur_bytes = 0
@@ -204,12 +222,82 @@ def load_cram(
         cur_bytes += length
     if cur:
         groups.append(cur)
+    return groups
+
+
+def load_cram_intervals(
+    path,
+    loci: LociSet | str,
+    split_size=None,
+    config: Config = Config(),
+    parallel: ParallelConfig = ParallelConfig(),
+    reference=None,
+) -> Dataset:
+    """Indexed random access on a CRAM: only records overlapping ``loci``.
+
+    The ``.crai`` sidecar (one line per slice×reference with container
+    offsets — cram/crai.py) plays the role the ``.bai`` plays for
+    ``load_bam_intervals``; without one, every container is scanned and
+    the overlap filter alone narrows the result."""
+    from spark_bam_tpu.cram import CramReader
+    from spark_bam_tpu.cram.crai import read_crai
+
+    reference = _resolve_reference(reference)
+    with CramReader(path) as r:
+        header = r.bam_header
+        infos = r.container_infos()
+    if isinstance(loci, str):
+        loci = LociSet.parse(loci, header.contig_lengths)
+    name_to_idx = {
+        name: idx for idx, (name, _) in header.contig_lengths.items()
+    }
+    crai_path = str(path) + ".crai"
+    selected = infos
+    if os.path.exists(crai_path):
+        # ref id → 0-based intervals, whole-contig expanded, computed once.
+        by_ref = {
+            name_to_idx[contig]: ivs or [(0, header.contig_lengths[name_to_idx[contig]][1])]
+            for contig, ivs in loci.intervals.items()
+            if contig in name_to_idx
+        }
+        wanted = set()
+        for entry in read_crai(crai_path):
+            ivs = by_ref.get(entry.ref_seq_id)
+            if ivs and any(entry.overlaps(entry.ref_seq_id, s, e) for s, e in ivs):
+                wanted.add(entry.container_offset)
+        selected = [info for info in infos if info.offset in wanted]
+
+    config = config.replace(split_size=split_size) if split_size else config
+    size = config.split_size_or(Config.LOAD_SPLIT_SIZE_DEFAULT)
+    groups = _group_by_size(selected, size)
+
+    def overlaps(rec: BamRecord) -> bool:
+        if rec.ref_id < 0 or rec.is_unmapped:
+            return False
+        return loci.overlaps(
+            header.contig_lengths.name(rec.ref_id), rec.pos, rec.end_pos()
+        )
 
     def compute(group):
         with CramReader(path, reference=reference) as r:
-            yield from r.records(group[0].offset, group[-1].offset + 1)
+            for offset, end in _contiguous_runs(group):
+                for rec in r.records(offset, end):
+                    if overlaps(rec):
+                        yield rec
 
     return Dataset(groups, compute, parallel)
+
+
+def _contiguous_runs(group):
+    """Collapse container infos into (offset, end) runs so non-adjacent
+    selections don't decode the containers between them."""
+    runs = []
+    for info in group:
+        if runs and runs[-1][1] == info.offset:
+            runs[-1][1] = info.end
+        else:
+            runs.append([info.offset, info.end])
+    return [(s, e) for s, e in runs]
 
 
 def load_reads(

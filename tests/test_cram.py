@@ -324,6 +324,58 @@ def test_reference_required_raises_without_reference(tmp_path):
             list(r)
 
 
+# ------------------------------------------------------------------ .crai
+def test_crai_roundtrip_and_overlap(tmp_path):
+    from spark_bam_tpu.cram.crai import CraiEntry, read_crai, write_crai
+
+    entries = [
+        CraiEntry(0, 101, 500, 1000, 50, 4000),
+        CraiEntry(-1, 0, 0, 5000, 50, 2000),
+    ]
+    p = tmp_path / "x.cram.crai"
+    write_crai(p, entries)
+    assert read_crai(p) == entries
+    e = entries[0]
+    assert e.overlaps(0, 100, 101)       # touches first base (0-based 100)
+    assert not e.overlaps(0, 0, 100)     # ends before it
+    assert not e.overlaps(1, 100, 200)   # other ref
+    assert not entries[1].overlaps(-1, 0, 10)  # unmapped line never matches
+
+
+def test_load_cram_intervals_matches_bam(bam2, tmp_path):
+    from spark_bam_tpu.load.api import load_bam_intervals, load_cram_intervals
+
+    header, recs = read_bam(bam2)
+    out = tmp_path / "2.cram"
+    with CramWriter(
+        out, header.contig_lengths, header.text, records_per_container=250
+    ) as w:
+        w.write_all(recs)
+    assert (tmp_path / "2.cram.crai").exists()
+
+    loci = "1:13000-14000,1:60000-61000"
+    want = list(load_bam_intervals(bam2, loci))
+    assert want  # the locus actually selects records
+    got = list(load_cram_intervals(out, loci))
+    assert got == want
+
+    # The .crai actually prunes containers: indexed selection must decode
+    # fewer containers than a full scan would.
+    from spark_bam_tpu.cram import CramReader
+    from spark_bam_tpu.cram.crai import read_crai
+
+    with CramReader(out) as r:
+        total = len(r.container_infos())
+    hit = {e.container_offset for e in read_crai(str(out) + ".crai")
+           if e.ref_seq_id == 0 and e.overlaps(0, 13000, 14000)
+           or e.ref_seq_id == 0 and e.overlaps(0, 60000, 61000)}
+    assert 0 < len(hit) < total
+
+    # Without the sidecar the same records come back via full scan.
+    (tmp_path / "2.cram.crai").unlink()
+    assert list(load_cram_intervals(out, loci)) == want
+
+
 # ---------------------------------------------------------------- loading
 def test_load_cram_partitioned(bam2, tmp_path):
     from spark_bam_tpu.load.api import load_cram, load_reads
