@@ -317,8 +317,11 @@ def decompress(blob: bytes) -> bytes:
     del comp_sz
     if out_sz == 0:
         return b""
-    if order == 0:
-        return _decode_o0(cur, out_sz)
-    if order == 1:
-        return _decode_o1(cur, out_sz)
+    if order in (0, 1):
+        from spark_bam_tpu.native.build import rans_decompress_native
+
+        native = rans_decompress_native(bytes(blob), out_sz)
+        if native is not None:
+            return native
+        return _decode_o0(cur, out_sz) if order == 0 else _decode_o1(cur, out_sz)
     raise ValueError(f"unknown rANS order {order}")

@@ -75,6 +75,10 @@ def load_native():
         c_u8p, c_i64p, c_i64p, ctypes.c_int64,
         c_u8p, c_i32p, ctypes.c_int64, c_i64p,
     ]
+    lib.sbt_rans_decompress.restype = ctypes.c_int64
+    lib.sbt_rans_decompress.argtypes = [
+        c_u8p, ctypes.c_int64, c_u8p, ctypes.c_int64,
+    ]
     _LIB_CACHE.append(lib)
     return lib
 
@@ -162,6 +166,23 @@ def tokenize_deflate_native(
     if rc != 0:
         raise IOError(f"deflate tokenize failed at block {rc - 1}")
     return lit, parent, out_lens
+
+
+def rans_decompress_native(blob: bytes, out_size: int) -> bytes | None:
+    """Native rANS 4x8 decode (cram/rans.py is the fallback + encoder).
+    Returns None when the library is unavailable; raises on bad input."""
+    lib = load_native()
+    if lib is None:
+        return None
+    data = np.frombuffer(blob, dtype=np.uint8)
+    out = np.empty(out_size, dtype=np.uint8)
+    produced = lib.sbt_rans_decompress(
+        _ptr(np.ascontiguousarray(data), ctypes.c_uint8), len(data),
+        _ptr(out, ctypes.c_uint8), out_size,
+    )
+    if produced != out_size:
+        raise IOError(f"rANS decode produced {produced}, wanted {out_size}")
+    return out.tobytes()
 
 
 def inflate_blocks_native(

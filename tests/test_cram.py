@@ -80,6 +80,51 @@ def test_rans_roundtrip(order):
         assert rans.decompress(rans.compress(data, order)) == data
 
 
+def test_rans_native_matches_python():
+    from spark_bam_tpu.cram.rans import _decode_o0, _decode_o1
+    from spark_bam_tpu.native.build import load_native, rans_decompress_native
+
+    if load_native() is None:
+        pytest.skip("native runtime unavailable")
+    rng = np.random.default_rng(11)
+    cases = [
+        b"q" * 5000,
+        bytes(rng.integers(0, 256, 20_000, dtype=np.uint8)),
+        bytes(rng.integers(33, 43, 50_000, dtype=np.uint8)),
+    ]
+    for order in (0, 1):
+        for data in cases:
+            blob = rans.compress(data, order)
+            cur = Cursor(blob)
+            actual_order = cur.u8()
+            cur.u32()
+            out_sz = cur.u32()
+            python = (
+                _decode_o0(cur, out_sz) if actual_order == 0
+                else _decode_o1(cur, out_sz)
+            )
+            assert rans_decompress_native(blob, out_sz) == python == data
+
+
+def test_rans_native_rejects_malformed_input():
+    from spark_bam_tpu.native.build import load_native, rans_decompress_native
+
+    if load_native() is None:
+        pytest.skip("native runtime unavailable")
+    import struct
+
+    # Over-subscribed frequency table: symbol 0x00 claims 0x7FFF twice via
+    # the two-byte form — used to write ~32KB past the lookup table.
+    evil_table = bytes([0x00, 0xFF, 0xFF, 0x01, 0xFF, 0xFF, 0x00])
+    blob = bytes([0]) + struct.pack("<I", len(evil_table)) + struct.pack("<I", 100) + evil_table
+    with pytest.raises(IOError):
+        rans_decompress_native(blob, 100)
+    # Truncated stream (no states) must error, not crash.
+    short = bytes([0]) + struct.pack("<I", 1) + struct.pack("<I", 10) + b"\x00"
+    with pytest.raises(IOError):
+        rans_decompress_native(short, 10)
+
+
 def test_core_block_codecs():
     # Huffman (multi-symbol + 0-bit constant), beta: encode with BitWriter,
     # decode through the Decoders dispatch.
