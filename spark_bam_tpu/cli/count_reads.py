@@ -8,7 +8,7 @@ import traceback
 
 from spark_bam_tpu.cli.output import Printer
 from spark_bam_tpu.core.config import Config
-from spark_bam_tpu.load.api import load_bam
+from spark_bam_tpu.load.api import load_bam, load_reads
 from spark_bam_tpu.load.hadoop import hadoop_bam_count
 
 
@@ -20,6 +20,17 @@ def run(
     spark_bam_first: bool = False,
     iterations: int = 1,
 ) -> None:
+    if str(path).endswith(".cram"):
+        # No hadoop-bam leg for CRAM (the reference delegates CRAM entirely;
+        # there is no competitor count to diff against).
+        for _ in range(max(iterations, 1)):
+            t0 = time.perf_counter()
+            count = load_reads(path, split_size, config).count()
+            ms = int((time.perf_counter() - t0) * 1000)
+            p.echo(f"spark-bam read-count time: {ms}")
+            p.echo(f"Read count: {count}", "")
+        return
+
     def run_once():
         t0 = time.perf_counter()
         spark_count = load_bam(path, split_size, config).count()

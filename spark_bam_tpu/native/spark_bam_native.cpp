@@ -391,10 +391,13 @@ static bool read_freqs(Rd& r, uint16_t F[256]) {
   while (r.ok) {
     int f = r.u8();
     if (f >= 0x80) f = ((f & 0x7F) << 8) | r.u8();
-    F[sym & 0xFF] = (uint16_t)f;
+    F[sym] = (uint16_t)f;
     if (rle) {
       --rle;
       ++sym;
+      // A run past symbol 255 is malformed (the Python fallback rejects
+      // it too); wrapping would silently clobber low-symbol frequencies.
+      if (sym > 255) return false;
     } else if (r.pos < r.n && sym + 1 == r.p[r.pos]) {
       sym = r.u8();
       rle = r.u8();
@@ -458,12 +461,13 @@ static int64_t decode_o1(Rd& r, uint8_t* out, int64_t out_sz) {
   int ctx = r.u8();
   int rle = 0;
   while (r.ok) {
-    if (!read_freqs(r, ctxs[ctx & 0xFF].freq)) return -1;
-    if (!ctxs[ctx & 0xFF].build()) return -1;
-    present[ctx & 0xFF] = true;
+    if (!read_freqs(r, ctxs[ctx].freq)) return -1;
+    if (!ctxs[ctx].build()) return -1;
+    present[ctx] = true;
     if (rle) {
       --rle;
       ++ctx;
+      if (ctx > 255) return -1;  // context run past 255: malformed
     } else if (r.pos < r.n && ctx + 1 == r.p[r.pos]) {
       ctx = r.u8();
       rle = r.u8();

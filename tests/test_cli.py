@@ -122,6 +122,24 @@ def test_count_reads_matched(bam1, tmp_path):
     assert lines[3] == "Read counts matched: 4917"
 
 
+def test_count_reads_cram(bam2, tmp_path):
+    from spark_bam_tpu.bam.iterators import RecordStream
+    from spark_bam_tpu.bgzf.stream import BlockStream, UncompressedBytes
+    from spark_bam_tpu.core.channel import open_channel
+    from spark_bam_tpu.cram import CramWriter
+
+    stream = RecordStream(UncompressedBytes(BlockStream(open_channel(bam2))))
+    header = stream.header
+    recs = [rec for _, rec in stream]
+    cram = tmp_path / "2.cram"
+    with CramWriter(cram, header.contig_lengths, header.text) as w:
+        w.write_all(recs)
+    got = run_cli(["count-reads", str(cram)], tmp_path)
+    lines = got.splitlines()
+    assert re.fullmatch(r"spark-bam read-count time: \d+", lines[0])
+    assert lines[1] == "Read count: 2500"
+
+
 def test_count_reads_hadoop_fails(bam1, tmp_path):
     # At 230k the hadoop-bam split start is the 239479:311 false positive;
     # decoding from it must fail SAM validation.

@@ -123,6 +123,12 @@ def test_rans_native_rejects_malformed_input():
     short = bytes([0]) + struct.pack("<I", 1) + struct.pack("<I", 10) + b"\x00"
     with pytest.raises(IOError):
         rans_decompress_native(short, 10)
+    # RLE symbol run extending past 255 must be rejected (the Python
+    # decoder IndexErrors on it; wrapping would clobber low symbols).
+    run_table = bytes([250, 1, 251, 10]) + bytes([1] * 11) + bytes([0])
+    blob = bytes([0]) + struct.pack("<I", len(run_table)) + struct.pack("<I", 10) + run_table
+    with pytest.raises(IOError):
+        rans_decompress_native(blob, 10)
 
 
 def test_core_block_codecs():

@@ -1,0 +1,128 @@
+"""Fuzz: native decoders against zlib ground truth and corrupted input.
+
+The native DEFLATE tokenizer and rANS decoder parse untrusted bytes in
+process; these tests hammer them with (a) every zlib strategy/level
+combination — the tokenizer must agree with zlib byte-for-byte after
+device resolution — and (b) random truncations/corruptions, which must
+produce a Python exception, never a crash or hang.
+"""
+
+import zlib
+
+import numpy as np
+import pytest
+
+from spark_bam_tpu.cram import rans
+from spark_bam_tpu.native.build import load_native, rans_decompress_native
+from spark_bam_tpu.tpu.inflate import inflate_blocks_device
+
+pytestmark = pytest.mark.skipif(
+    load_native() is None, reason="native runtime unavailable"
+)
+
+
+def _device_inflate_one(comp: bytes, out_len: int):
+    return inflate_blocks_device(
+        np.frombuffer(comp, dtype=np.uint8),
+        np.array([0], dtype=np.int64),
+        np.array([len(comp)], dtype=np.int64),
+        np.array([out_len], dtype=np.int64),
+    )
+
+
+def _corpus():
+    rng = np.random.default_rng(99)
+    motifs = rng.integers(0, 256, (4, 48), dtype=np.uint8)
+    structured = np.concatenate(
+        [motifs[i] for i in rng.integers(0, 4, 400)]
+    ).tobytes()
+    return [
+        b"",
+        b"\x00" * 3000,
+        b"abc" * 7000,
+        structured,
+        bytes(rng.integers(0, 256, 30_000, dtype=np.uint8)),
+        bytes(rng.integers(65, 70, 60_000, dtype=np.uint8)),
+    ]
+
+
+def test_tokenizer_agrees_with_zlib_across_strategies():
+    strategies = [
+        zlib.Z_DEFAULT_STRATEGY, zlib.Z_FILTERED, zlib.Z_HUFFMAN_ONLY,
+        zlib.Z_RLE, zlib.Z_FIXED,
+    ]
+    for data in _corpus():
+        for level in (0, 1, 6, 9):
+            for strategy in strategies:
+                co = zlib.compressobj(level, zlib.DEFLATED, -15, 8, strategy)
+                comp = co.compress(data) + co.flush()
+                out = _device_inflate_one(comp, len(data))
+                assert out is not None and out.tobytes() == data, (
+                    level, strategy, len(data),
+                )
+
+
+def test_tokenizer_multi_deflate_block_streams():
+    # Z_FULL_FLUSH forces mid-stream block boundaries (and window resets),
+    # exercising the multi-block loop and stored/dynamic interleavings.
+    rng = np.random.default_rng(5)
+    parts = [
+        bytes(rng.integers(0, 256, n, dtype=np.uint8))
+        for n in (1, 500, 10_000)
+    ] + [b"run" * 4000]
+    co = zlib.compressobj(6, zlib.DEFLATED, -15)
+    comp = b""
+    for part in parts:
+        comp += co.compress(part) + co.flush(zlib.Z_FULL_FLUSH)
+    comp += co.flush()
+    data = b"".join(parts)
+    out = _device_inflate_one(comp, len(data))
+    assert out.tobytes() == data
+
+
+def test_tokenizer_never_crashes_on_corrupt_streams():
+    rng = np.random.default_rng(17)
+    base = zlib.compress(b"corpus " * 3000)[2:-4]  # raw-ish deflate body
+    for trial in range(200):
+        blob = bytearray(base)
+        kind = trial % 3
+        if kind == 0:
+            blob = blob[: rng.integers(0, len(blob))]
+        elif kind == 1 and len(blob):
+            for _ in range(int(rng.integers(1, 8))):
+                blob[int(rng.integers(0, len(blob)))] ^= int(rng.integers(1, 256))
+        else:
+            blob = bytearray(rng.integers(0, 256, 300, dtype=np.uint8).tobytes())
+        try:
+            _device_inflate_one(bytes(blob), 21_000)
+        except (IOError, ValueError):
+            pass  # rejection is the expected outcome
+
+
+def test_rans_never_crashes_on_corrupt_streams():
+    rng = np.random.default_rng(23)
+    for order in (0, 1):
+        base = rans.compress(b"payload!" * 2000, order)
+        for trial in range(200):
+            blob = bytearray(base)
+            kind = trial % 3
+            if kind == 0:
+                blob = blob[: rng.integers(0, len(blob))]
+            elif kind == 1:
+                for _ in range(int(rng.integers(1, 8))):
+                    blob[int(rng.integers(0, len(blob)))] ^= int(
+                        rng.integers(1, 256)
+                    )
+            else:
+                blob = bytearray(
+                    rng.integers(0, 256, 64, dtype=np.uint8).tobytes()
+                )
+            if len(blob) < 9:
+                continue
+            out_sz = int.from_bytes(blob[5:9], "little")
+            if out_sz > 1 << 22:
+                continue  # cap the fuzz allocation, not a decoder input limit
+            try:
+                rans_decompress_native(bytes(blob), out_sz)
+            except IOError:
+                pass
