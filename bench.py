@@ -27,7 +27,10 @@ import numpy as np
 sys.path.insert(0, str(Path(__file__).resolve().parent))
 
 FIXTURE = Path("/root/reference/test_bams/src/main/resources/2.bam")
-WINDOW_MB = 8
+# 32 MB windows amortize dispatch overhead ~4x over 8 MB and are the
+# largest power of two whose kernel fits v5e HBM (64 MB compiles to ~17 GB
+# of intermediates and OOMs a 16 GB chip).
+WINDOW_MB = 32
 ITERS = 20
 
 

@@ -19,13 +19,15 @@ def run(
     config: Config = Config(),
     spark_bam_first: bool = False,
     iterations: int = 1,
+    reference=None,
 ) -> None:
     if str(path).endswith(".cram"):
         # No hadoop-bam leg for CRAM (the reference delegates CRAM entirely;
-        # there is no competitor count to diff against).
+        # there is no competitor count to diff against). ``reference`` (-F)
+        # enables RR=true files with external references.
         for _ in range(max(iterations, 1)):
             t0 = time.perf_counter()
-            count = load_reads(path, split_size, config).count()
+            count = load_reads(path, split_size, config, reference=reference).count()
             ms = int((time.perf_counter() - t0) * 1000)
             p.echo(f"spark-bam read-count time: {ms}")
             p.echo(f"Read count: {count}", "")

@@ -71,6 +71,8 @@ def build_parser() -> argparse.ArgumentParser:
     _add_common(sub)
     sub.add_argument("-s", "--spark-bam-first", action="store_true")
     sub.add_argument("-n", "--num-iterations", type=int, default=1)
+    sub.add_argument("-F", "--reference", default=None,
+                     help="FASTA for reference-based (RR=true) CRAM decode")
     sub.add_argument("path")
 
     sub = sp.add_parser("time-load")
@@ -167,6 +169,7 @@ def main(argv=None) -> int:
             count_reads.run(
                 args.path, p, config.split_size_or(Config.LOAD_SPLIT_SIZE_DEFAULT),
                 config, args.spark_bam_first, args.num_iterations,
+                reference=args.reference,
             )
         elif cmd == "index-blocks":
             from spark_bam_tpu.bgzf.index_blocks import index_blocks

@@ -70,7 +70,11 @@ class Config:
     checker: str = "eager"              # eager | full | indexed | seqdoop
     backend: str = "auto"               # auto | tpu | numpy | python | native
     # --- TPU execution shape ---
-    window_size: int = 64 << 20         # uncompressed bytes checked per device window
+    # Uncompressed bytes checked per device window. The streaming path
+    # rounds (window + carry) up to a power of two for the kernel shape, so
+    # 24 MB + the 4 MB halo stays within a 32 MB kernel — the largest that
+    # fits a 16 GB-HBM chip (64 MB windows OOM at compile time).
+    window_size: int = 24 << 20
     halo_size: int = 4 << 20            # extra trailing bytes so chains can complete
     # --- misc ---
     warn: bool = False                  # root log-level toggle (args/LogArgs.scala:30-33)

@@ -375,6 +375,18 @@ def test_reference_required_raises_without_reference(tmp_path):
             list(r)
 
 
+def test_count_reads_cli_with_reference_flag(tmp_path):
+    # RR=true CRAM + external FASTA through the CLI's -F flag.
+    from spark_bam_tpu.cli.main import main
+
+    path = _foreign_cram(tmp_path, with_embedded=False)
+    fasta = tmp_path / "c.fa"
+    fasta.write_text(">c\nNNNNNNNNNNAACCGGTTAACCGGTT\n")
+    out = tmp_path / "out.txt"
+    assert main(["count-reads", "-F", str(fasta), str(path), "-o", str(out)]) == 0
+    assert "Read count: 2" in out.read_text()
+
+
 # ------------------------------------------------------------------ .crai
 def test_crai_roundtrip_and_overlap(tmp_path):
     from spark_bam_tpu.cram.crai import CraiEntry, read_crai, write_crai
