@@ -296,8 +296,47 @@ def check_flat(
     at_eof: bool = True,
     reads_to_check: int = 10,
 ) -> ChainResult:
-    """Flag pass + chain walk over one flat buffer."""
+    """Flag pass + chain walk over one flat buffer.
+
+    All-position mode mirrors the device kernel's survivor compaction:
+    positions whose own record fails a check (F != 0, the overwhelming
+    majority) resolve elementwise from the flag pass — their step-0 outcome
+    in ``chain_verdicts`` depends only on F — and the 10-round walk runs
+    only over survivors (~1% of positions).
+    """
     masks = compute_flags(np.asarray(buf, dtype=np.uint8), contig_lengths)
-    if candidates is None:
-        candidates = np.arange(masks.n, dtype=np.int64)
-    return chain_verdicts(masks, candidates, at_eof=at_eof, reads_to_check=reads_to_check)
+    if candidates is not None:
+        return chain_verdicts(
+            masks, candidates, at_eof=at_eof, reads_to_check=reads_to_check
+        )
+    n = masks.n
+    F = masks.F
+    nonzero = F != 0
+    if at_eof:
+        fail0 = nonzero
+        esc0 = np.zeros(n, dtype=bool)
+        inexact0 = esc0
+    else:
+        definitive = F & DEFINITIVE_MASK
+        boundary = F & ESCAPE_MASK
+        fail0 = nonzero & (definitive != 0)
+        esc0 = nonzero & (definitive == 0) & (boundary != 0)
+        inexact0 = fail0 & (boundary != 0)
+    verdict = np.zeros(n, dtype=bool)
+    fail_mask = np.where(fail0, F, 0).astype(np.int32)
+    reads_parsed = np.zeros(n, dtype=np.int32)
+    reads_before = np.zeros(n, dtype=np.int32)
+    escaped = esc0.copy()
+    exact = ~(inexact0 | esc0)
+    surv = np.flatnonzero(~nonzero).astype(np.int64)
+    if len(surv):
+        cr = chain_verdicts(
+            masks, surv, at_eof=at_eof, reads_to_check=reads_to_check
+        )
+        verdict[surv] = cr.verdict
+        fail_mask[surv] = cr.fail_mask
+        reads_parsed[surv] = cr.reads_parsed
+        reads_before[surv] = cr.reads_before
+        exact[surv] = cr.exact
+        escaped[surv] = cr.escaped
+    return ChainResult(verdict, reads_parsed, fail_mask, reads_before, exact, escaped)
