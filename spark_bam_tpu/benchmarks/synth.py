@@ -1,0 +1,111 @@
+"""Synthesize large benchmark BAMs by compressed-block repetition.
+
+The reference's headline numbers are whole-workload wall-clock on multi-GB
+BAMs (reference docs/benchmarks.md:53-62 — count-reads / time-load on
+559 GB-14 TB corpora); the small checked-in fixtures can't exercise that
+regime. This builds an arbitrarily large, fully valid BAM out of ``2.bam``
+in seconds: the fixture's record region (everything after the BAM header)
+is re-compressed into a self-contained run of BGZF blocks *once*, then that
+compressed run is byte-repeated N times. Every repeat starts at a block
+boundary and at a record boundary, so the result is a spec-valid BAM whose
+read count is exactly ``reps * 2500``.
+
+Generation cost is one ~1.5 MB compression plus file IO — no per-record
+work — so a ≥1 GB file materializes in a few seconds and can be cached
+across runs (``ensure_big_bam``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+
+from spark_bam_tpu.bam.header import read_header
+from spark_bam_tpu.bam.writer import (
+    BGZF_EOF,
+    DEFAULT_BLOCK_PAYLOAD as _PAYLOAD,
+    compress_block,
+)
+from spark_bam_tpu.bgzf.flat import flatten_file
+
+FIXTURE = Path("/root/reference/test_bams/src/main/resources/2.bam")
+FIXTURE_READS = 2500
+
+
+def _count_records(rec_bytes: memoryview) -> int:
+    """Record count of a flat record region (length-prefix walk)."""
+    import struct
+
+    n, off, total = 0, 0, len(rec_bytes)
+    while off + 4 <= total:
+        (size,) = struct.unpack_from("<i", rec_bytes, off)
+        off += 4 + size
+        n += 1
+    if off != total:
+        raise ValueError("record region does not end on a record boundary")
+    return n
+
+
+def _chunks_to_blocks(data: bytes, level: int = 6) -> bytes:
+    out = bytearray()
+    for i in range(0, len(data), _PAYLOAD):
+        out += compress_block(data[i: i + _PAYLOAD], level)
+    return bytes(out)
+
+
+def synth_bam(
+    out_path: Path,
+    target_bytes: int,
+    fixture: Path = FIXTURE,
+    level: int = 1,
+) -> dict:
+    """Write a ≥``target_bytes`` (compressed) BAM to ``out_path``.
+
+    Returns a manifest dict: reps, reads, compressed/uncompressed sizes.
+    """
+    flat = flatten_file(fixture)
+    hdr = read_header(fixture)
+    split = hdr.uncompressed_size
+    rec_bytes = flat.data[split:].tobytes()
+    reads_per_rep = _count_records(memoryview(rec_bytes))
+    hdr_blob = _chunks_to_blocks(flat.data[:split].tobytes(), level)
+    rec_blob = _chunks_to_blocks(rec_bytes, level)
+    body = max(target_bytes - len(hdr_blob) - len(BGZF_EOF), len(rec_blob))
+    reps = -(-body // len(rec_blob))  # ceil
+
+    tmp = out_path.with_suffix(".tmp")
+    with open(tmp, "wb") as f:
+        f.write(hdr_blob)
+        for _ in range(reps):
+            f.write(rec_blob)
+        f.write(BGZF_EOF)
+    os.replace(tmp, out_path)
+
+    rec_usize = flat.size - split
+    manifest = {
+        "fixture": str(fixture),
+        "reps": reps,
+        "reads": reps * reads_per_rep,
+        "compressed_bytes": out_path.stat().st_size,
+        "uncompressed_bytes": split + reps * rec_usize,
+        "level": level,
+    }
+    out_path.with_suffix(".manifest.json").write_text(json.dumps(manifest))
+    return manifest
+
+
+def ensure_big_bam(
+    target_bytes: int = 1 << 30,
+    cache_dir: Path = Path("/tmp/spark_bam_bench"),
+    fixture: Path = FIXTURE,
+) -> tuple[Path, dict]:
+    """Build (or reuse a cached) ≥``target_bytes`` benchmark BAM."""
+    cache_dir.mkdir(parents=True, exist_ok=True)
+    out = cache_dir / f"big_{target_bytes >> 20}mb.bam"
+    mf = out.with_suffix(".manifest.json")
+    if out.exists() and mf.exists():
+        manifest = json.loads(mf.read_text())
+        if manifest.get("compressed_bytes") == out.stat().st_size:
+            return out, manifest
+    return out, synth_bam(out, target_bytes, fixture)
