@@ -59,8 +59,14 @@ def read_block_payload(ch: ByteChannel, meta: Metadata):
     if isinstance(ch, MMapChannel):
         comp = ch.memoryview(meta.start, meta.compressed_size)
     else:
-        ch.seek(meta.start)
-        comp = ch.read_fully(meta.compressed_size)
+        # Positioned read: no shared-cursor mutation, safe for the
+        # concurrent block readers above this.
+        comp = ch.read_at(meta.start, meta.compressed_size)
+        if len(comp) != meta.compressed_size:
+            raise EOFError(
+                f"wanted {meta.compressed_size} bytes at {meta.start}, "
+                f"got {len(comp)}"
+            )
     header = Header.parse(comp[:18])
     return comp[header.size: meta.compressed_size - FOOTER_SIZE]
 
@@ -71,6 +77,69 @@ def _inflate_one(ch: ByteChannel, meta: Metadata, out: np.ndarray, flat_off: int
     out[flat_off: flat_off + len(data)] = np.frombuffer(data, dtype=np.uint8)
 
 
+def _inflate_fast_native(
+    ch: ByteChannel, metas: list[Metadata], out: np.ndarray, block_flat: np.ndarray,
+    usizes: np.ndarray, threads: int = 1,
+) -> bool:
+    """Batched native fast inflate. On mmap channels the compressed bytes
+    are consumed zero-copy straight from the page cache. With ``threads``,
+    contiguous block slices inflate in parallel (the C call releases the
+    GIL); each slice writes a disjoint, exact-size output region, so
+    word-copy slack never races a neighbour. Returns False when the native
+    library is unavailable."""
+    from spark_bam_tpu.native.build import inflate_blocks_fast_into, load_native
+
+    if load_native() is None or not metas:
+        return False
+    offsets = np.empty(len(metas), dtype=np.int64)
+    lengths = np.empty(len(metas), dtype=np.int64)
+    if isinstance(ch, MMapChannel):
+        comp = np.frombuffer(ch.memoryview(0, ch.size), dtype=np.uint8)
+        for i, m in enumerate(metas):
+            header = Header.parse(ch.memoryview(m.start, 18))
+            offsets[i] = m.start + header.size
+            lengths[i] = m.compressed_size - header.size - FOOTER_SIZE
+    else:
+        # Fan the payload reads out (read_at is positioned + thread-safe)
+        # so high-latency channels overlap round-trips, then concatenate.
+        with ThreadPoolExecutor(max_workers=min(8, max(threads, 1))) as pool:
+            parts = list(
+                pool.map(
+                    lambda m: np.frombuffer(
+                        read_block_payload(ch, m), dtype=np.uint8
+                    ),
+                    metas,
+                )
+            )
+        off = 0
+        for i, part in enumerate(parts):
+            offsets[i] = off
+            lengths[i] = len(part)
+            off += len(part)
+        comp = np.concatenate(parts) if parts else np.empty(0, dtype=np.uint8)
+
+    n_chunks = max(1, min(threads, len(metas) // 32))
+    if n_chunks == 1:
+        return inflate_blocks_fast_into(
+            comp, offsets, lengths, out, block_flat, usizes
+        )
+    bounds = np.linspace(0, len(metas), n_chunks + 1, dtype=np.int64)
+
+    def run_chunk(k: int) -> bool:
+        lo, hi = int(bounds[k]), int(bounds[k + 1])
+        flat_lo = int(block_flat[lo])
+        flat_hi = (
+            len(out) if hi == len(metas) else int(block_flat[hi])
+        )
+        return inflate_blocks_fast_into(
+            comp, offsets[lo:hi], lengths[lo:hi],
+            out[flat_lo:flat_hi], block_flat[lo:hi] - flat_lo, usizes[lo:hi],
+        )
+
+    with ThreadPoolExecutor(max_workers=n_chunks) as pool:
+        return all(pool.map(run_chunk, range(n_chunks)))
+
+
 def inflate_blocks(
     ch: ByteChannel,
     metas: list[Metadata],
@@ -78,24 +147,35 @@ def inflate_blocks(
     at_eof: bool = False,
     threads: int = 8,
 ) -> FlatView:
-    """Inflate a run of blocks into one flat buffer (parallel zlib)."""
+    """Inflate a run of blocks into one flat buffer.
+
+    Prefers the native table-driven decoder (~2x zlib, single call for the
+    whole run); falls back to parallel host zlib when the native library is
+    unavailable.
+    """
     usizes = np.array([m.uncompressed_size for m in metas], dtype=np.int64)
     block_flat = np.zeros(len(metas), dtype=np.int64)
     if len(metas):
         np.cumsum(usizes[:-1], out=block_flat[1:])
     total = int(usizes.sum())
-    out = np.empty(total, dtype=np.uint8)
-    if len(metas) > 1 and threads > 1:
-        with ThreadPoolExecutor(max_workers=threads) as pool:
-            list(
-                pool.map(
-                    lambda im: _inflate_one(ch, im[1], out, int(block_flat[im[0]])),
-                    enumerate(metas),
+    # 8 bytes of slack: the native decoder's word copies may overrun a
+    # block's end (never the allocation); the view handed out is exact.
+    out_alloc = np.empty(total + 8, dtype=np.uint8)
+    out = out_alloc[:total]
+    if not _inflate_fast_native(
+        ch, metas, out_alloc, block_flat, usizes, threads=threads
+    ):
+        if len(metas) > 1 and threads > 1:
+            with ThreadPoolExecutor(max_workers=threads) as pool:
+                list(
+                    pool.map(
+                        lambda im: _inflate_one(ch, im[1], out, int(block_flat[im[0]])),
+                        enumerate(metas),
+                    )
                 )
-            )
-    else:
-        for i, m in enumerate(metas):
-            _inflate_one(ch, m, out, int(block_flat[i]))
+        else:
+            for i, m in enumerate(metas):
+                _inflate_one(ch, m, out, int(block_flat[i]))
     return FlatView(
         out,
         np.array([m.start for m in metas], dtype=np.int64),

@@ -15,6 +15,7 @@ import io
 import mmap
 import os
 import struct
+import threading
 from collections import OrderedDict
 
 
@@ -36,6 +37,12 @@ class ByteChannel:
         pass
 
     # -- shared behavior ----------------------------------------------------
+    def read_at(self, pos: int, n: int) -> bytes:
+        """Positioned read that does NOT touch the shared cursor — the bulk
+        IO primitive for concurrent readers of one channel (the cursor API
+        below remains single-threaded). May be short at EOF."""
+        return self._read_at(pos, n)
+
     def position(self) -> int:
         return self._pos
 
@@ -112,17 +119,21 @@ class FileStreamChannel(ByteChannel):
         super().__init__()
         self._f = fobj
         self._size = size
+        self._io_lock = threading.Lock()  # seek+read must be atomic
 
     def _read_at(self, pos: int, n: int) -> bytes:
-        self._f.seek(pos)
-        return self._f.read(n) or b""
+        with self._io_lock:
+            self._f.seek(pos)
+            return self._f.read(n) or b""
 
     @property
     def size(self) -> int:
         if self._size is None:
-            cur = self._f.tell()
-            self._size = self._f.seek(0, io.SEEK_END)
-            self._f.seek(cur)
+            with self._io_lock:  # shares the fd cursor with _read_at
+                if self._size is None:
+                    cur = self._f.tell()
+                    self._size = self._f.seek(0, io.SEEK_END)
+                    self._f.seek(cur)
         return self._size
 
     def close(self) -> None:
@@ -143,16 +154,21 @@ class CachingChannel(ByteChannel):
         self.chunk_size = chunk_size
         self.max_chunks = max_chunks
         self._cache: OrderedDict[int, bytes] = OrderedDict()
+        self._cache_lock = threading.Lock()
 
     def _chunk(self, idx: int) -> bytes:
-        chunk = self._cache.get(idx)
-        if chunk is None:
-            chunk = self.inner._read_at(idx * self.chunk_size, self.chunk_size)
+        with self._cache_lock:
+            chunk = self._cache.get(idx)
+            if chunk is not None:
+                self._cache.move_to_end(idx)
+                return chunk
+        # Fetch outside the lock: misses may overlap; a duplicate fetch of
+        # the same chunk is benign (last writer wins).
+        chunk = self.inner._read_at(idx * self.chunk_size, self.chunk_size)
+        with self._cache_lock:
             self._cache[idx] = chunk
             if len(self._cache) > self.max_chunks:
                 self._cache.popitem(last=False)
-        else:
-            self._cache.move_to_end(idx)
         return chunk
 
     def _read_at(self, pos: int, n: int) -> bytes:

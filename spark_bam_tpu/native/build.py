@@ -13,6 +13,7 @@ import hashlib
 import logging
 import os
 import subprocess
+import threading
 from pathlib import Path
 
 import numpy as np
@@ -21,25 +22,43 @@ log = logging.getLogger(__name__)
 
 _SRC = Path(__file__).parent / "spark_bam_native.cpp"
 _LIB_CACHE: list = []  # [lib or None], filled once
+_LOAD_LOCK = threading.Lock()  # concurrent first-use (pipeline threads)
 
 
 def _build(src: Path, out: Path) -> bool:
-    cmd = [
-        "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-        str(src), "-o", str(out), "-lz",
-    ]
-    try:
-        subprocess.run(cmd, check=True, capture_output=True)
-        return True
-    except (subprocess.CalledProcessError, FileNotFoundError) as e:
-        log.warning("native build failed (%s); using Python fallbacks", e)
-        return False
+    base = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17"]
+    # Build to a temp name then atomically rename: a killed/concurrent build
+    # can never leave a half-written .so that later loads would trip over.
+    tmp = out.with_suffix(f".tmp{os.getpid()}")
+    tail = [str(src), "-o", str(tmp), "-lz"]
+    # -march=native helps the bit-twiddling hot loops measurably; the .so is
+    # built lazily per machine (never shipped), so native tuning is safe.
+    # Retry generic in case the toolchain rejects it.
+    for flags in ([*base, "-march=native", *tail], [*base, *tail]):
+        try:
+            subprocess.run(flags, check=True, capture_output=True)
+            os.replace(tmp, out)
+            return True
+        except FileNotFoundError as e:
+            log.warning("native build failed (%s); using Python fallbacks", e)
+            return False
+        except subprocess.CalledProcessError:
+            continue
+    log.warning("native build failed; using Python fallbacks")
+    return False
 
 
 def load_native():
     """The loaded shared library with argtypes set, or None."""
     if _LIB_CACHE:
         return _LIB_CACHE[0]
+    with _LOAD_LOCK:
+        if _LIB_CACHE:  # another thread finished while we waited
+            return _LIB_CACHE[0]
+        return _load_native_locked()
+
+
+def _load_native_locked():
     digest = hashlib.sha256(_SRC.read_bytes()).hexdigest()[:16]
     out = _SRC.parent / f"_spark_bam_native_{digest}.so"
     if not out.exists() and not _build(_SRC, out):
@@ -78,6 +97,11 @@ def load_native():
     lib.sbt_rans_decompress.restype = ctypes.c_int64
     lib.sbt_rans_decompress.argtypes = [
         c_u8p, ctypes.c_int64, c_u8p, ctypes.c_int64,
+    ]
+    lib.sbt_inflate_blocks_fast.restype = ctypes.c_long
+    lib.sbt_inflate_blocks_fast.argtypes = [
+        c_u8p, c_i64p, c_i64p, ctypes.c_int64, c_u8p, c_i64p, c_i64p,
+        ctypes.c_int64,
     ]
     _LIB_CACHE.append(lib)
     return lib
@@ -183,6 +207,60 @@ def rans_decompress_native(blob: bytes, out_size: int) -> bytes | None:
     if produced != out_size:
         raise IOError(f"rANS decode produced {produced}, wanted {out_size}")
     return out.tobytes()
+
+
+def inflate_blocks_fast_into(
+    comp: np.ndarray,
+    offsets: np.ndarray,
+    lengths: np.ndarray,
+    out: np.ndarray,
+    out_offsets: np.ndarray,
+    out_lengths: np.ndarray,
+) -> bool:
+    """Fast table-driven inflate of raw-DEFLATE payloads into ``out``.
+
+    Word copies only engage where >=8 bytes of room remain before the end
+    of ``out`` (they degrade to byte copies near it), so callers may pass
+    exact-size buffers; +8 slack past the last block's end recovers full
+    speed on the tail. Blocks the fast decoder rejects are re-run through
+    zlib, so a True return always means exact output; returns False only
+    when the native library is unavailable (caller falls back entirely).
+    """
+    lib = load_native()
+    if lib is None:
+        return False
+    count = len(offsets)
+    if count == 0:
+        return True
+    start = 0
+    while start < count:
+        rc = lib.sbt_inflate_blocks_fast(
+            _ptr(comp, ctypes.c_uint8),
+            _ptr(offsets[start:], ctypes.c_int64),
+            _ptr(lengths[start:], ctypes.c_int64),
+            count - start,
+            _ptr(out, ctypes.c_uint8),
+            _ptr(out_offsets[start:], ctypes.c_int64),
+            _ptr(out_lengths[start:], ctypes.c_int64),
+            len(out),
+        )
+        if rc == 0:
+            return True
+        # Block (start + rc - 1) was rejected: decode it with zlib (the
+        # permanent correctness fallback) and resume after it.
+        import zlib
+
+        i = start + int(rc) - 1
+        o, l = int(offsets[i]), int(lengths[i])
+        data = zlib.decompress(comp[o: o + l].tobytes(), -15)
+        if len(data) != int(out_lengths[i]):
+            raise IOError(
+                f"inflate produced {len(data)} bytes, footer says {int(out_lengths[i])}"
+            )
+        oo = int(out_offsets[i])
+        out[oo: oo + len(data)] = np.frombuffer(data, dtype=np.uint8)
+        start = i + 1
+    return True
 
 
 def inflate_blocks_native(

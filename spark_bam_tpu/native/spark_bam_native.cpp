@@ -556,3 +556,366 @@ long sbt_tokenize_deflate(
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------- fast inflate
+// libdeflate-style raw-DEFLATE decoder specialized for BGZF blocks: 64-bit
+// bit buffer refilled 8 bytes at a time, single-level 15-bit direct-indexed
+// Huffman tables (15 = DEFLATE's max code length, so no subtables), and
+// word-wise LZ77 copies under an 8-byte-slack contract against the whole
+// output allocation. The host-inflate wall is THE end-to-end bottleneck on
+// small hosts (the reference's hot loop is the JVM zlib binding,
+// bgzf/.../block/Stream.scala:49-54); this decoder is ~2x zlib here. Any
+// block it rejects falls back to zlib (sbt_inflate_blocks) for identical
+// results — it never guesses.
+
+namespace fastinf {
+
+struct FB {
+  const uint8_t* p;
+  const uint8_t* end;
+  uint64_t buf;  // LSB-first bit buffer
+  int cnt;       // valid bits in buf
+};
+
+static inline void refill(FB& b) {
+  if (b.end - b.p >= 8) {
+    uint64_t w;
+    std::memcpy(&w, b.p, 8);  // little-endian hosts only (x86/arm64)
+    b.buf |= w << b.cnt;
+    int take = (63 - b.cnt) >> 3;
+    b.p += take;
+    b.cnt += take << 3;
+  } else {
+    while (b.cnt <= 56 && b.p < b.end) {
+      b.buf |= (uint64_t)(*b.p++) << b.cnt;
+      b.cnt += 8;
+    }
+  }
+}
+
+static inline uint32_t take_bits(FB& b, int n) {
+  uint32_t v = (uint32_t)(b.buf & ((1ull << n) - 1));
+  b.buf >>= n;
+  b.cnt -= n;
+  return v;
+}
+
+// Two-level decode tables (zlib/libdeflate scheme): an 11-bit primary
+// table (8 KB, L1-resident; build cost ~2048 entries, not 32768) plus
+// per-prefix subtables for the rare >11-bit codes.
+//
+// u32 entry:
+//   direct : (symbol << 8) | total_code_length         (length 1..11)
+//   subptr : 0x80000000 | (subtable_offset << 8) | sub_bits
+//   0      : invalid
+constexpr int kRootBits = 11;
+constexpr uint32_t kRootSize = 1u << kRootBits;
+// Root + generous subtable arena (legal complete codes need far less;
+// the build errors out rather than overrun).
+constexpr uint32_t kTabCap = kRootSize + 4096;
+
+static inline uint32_t bitrev(uint32_t c, int len) {
+  uint32_t r = 0;
+  for (int i = 0; i < len; ++i) {
+    r = (r << 1) | (c & 1);
+    c >>= 1;
+  }
+  return r;
+}
+
+static bool build_table(uint32_t* tab, const uint8_t* lens, int n) {
+  int count[16] = {0};
+  for (int i = 0; i < n; ++i) count[lens[i]]++;
+  count[0] = 0;  // zero-length = absent, excluded from the Kraft sum
+  int left = 1;
+  int maxlen = 0;
+  for (int len = 1; len <= 15; ++len) {
+    left <<= 1;
+    left -= count[len];
+    if (left < 0) return false;  // over-subscribed
+    if (count[len]) maxlen = len;
+  }
+  // A complete code covers every root entry (short fills + long-prefix
+  // subptrs); only incomplete codes (legal for degenerate distance
+  // tables, RFC 1951 §3.2.7) need the invalid-fill.
+  if (left != 0) std::memset(tab, 0, kRootSize * sizeof(uint32_t));
+  uint32_t codes[288 + 30];
+  {
+    uint32_t code = 0;
+    uint32_t next[16] = {0};
+    for (int len = 1; len <= 15; ++len) {
+      code = (code + (uint32_t)count[len - 1]) << 1;
+      next[len] = code;
+    }
+    for (int sym = 0; sym < n; ++sym)
+      if (lens[sym]) codes[sym] = next[lens[sym]]++;
+  }
+
+  // Short codes: direct root fill.
+  for (int sym = 0; sym < n; ++sym) {
+    int L = lens[sym];
+    if (!L || L > kRootBits) continue;
+    uint32_t e = ((uint32_t)sym << 8) | (uint32_t)L;
+    for (uint32_t idx = bitrev(codes[sym], L); idx < kRootSize;
+         idx += (1u << L))
+      tab[idx] = e;
+  }
+  if (maxlen <= kRootBits) return true;
+
+  // Long codes: size each used root prefix, then allocate + fill.
+  uint8_t submax[kRootSize];
+  std::memset(submax, 0, sizeof(submax));
+  for (int sym = 0; sym < n; ++sym) {
+    int L = lens[sym];
+    if (L <= kRootBits) continue;
+    uint32_t pfx = bitrev(codes[sym], L) & (kRootSize - 1);
+    if (L - kRootBits > submax[pfx]) submax[pfx] = (uint8_t)(L - kRootBits);
+  }
+  uint32_t suboff[kRootSize];
+  uint32_t alloc = kRootSize;
+  for (uint32_t pfx = 0; pfx < kRootSize; ++pfx) {
+    if (!submax[pfx]) continue;
+    uint32_t size = 1u << submax[pfx];
+    if (alloc + size > kTabCap) return false;
+    suboff[pfx] = alloc;
+    std::memset(tab + alloc, 0, size * sizeof(uint32_t));
+    tab[pfx] = 0x80000000u | (alloc << 8) | submax[pfx];
+    alloc += size;
+  }
+  for (int sym = 0; sym < n; ++sym) {
+    int L = lens[sym];
+    if (L <= kRootBits) continue;
+    uint32_t r = bitrev(codes[sym], L);
+    uint32_t pfx = r & (kRootSize - 1);
+    uint32_t hi = r >> kRootBits;  // remaining L - kRootBits stream bits
+    uint32_t e = ((uint32_t)sym << 8) | (uint32_t)L;
+    for (uint32_t idx = hi; idx < (1u << submax[pfx]);
+         idx += (1u << (L - kRootBits)))
+      tab[suboff[pfx] + idx] = e;
+  }
+  return true;
+}
+
+// Decode one symbol's table entry from the low bits of `buf`; returns the
+// final (direct) entry, 0 if invalid.
+static inline uint32_t lookup(const uint32_t* tab, uint64_t buf) {
+  uint32_t e = tab[(uint32_t)buf & (kRootSize - 1)];
+  if (e & 0x80000000u) {
+    uint32_t sb = e & 0xffu;
+    e = tab[((e >> 8) & 0x3fffffu) +
+            (((uint32_t)(buf >> kRootBits)) & ((1u << sb) - 1))];
+  }
+  return e;
+}
+
+static bool build_fixed(uint32_t* lit_tab, uint32_t* dist_tab) {
+  uint8_t lens[288];
+  for (int i = 0; i < 144; ++i) lens[i] = 8;
+  for (int i = 144; i < 256; ++i) lens[i] = 9;
+  for (int i = 256; i < 280; ++i) lens[i] = 7;
+  for (int i = 280; i < 288; ++i) lens[i] = 8;
+  if (!build_table(lit_tab, lens, 288)) return false;
+  for (int i = 0; i < 30; ++i) lens[i] = 5;
+  return build_table(dist_tab, lens, 30);
+}
+
+// Inflate one raw-DEFLATE stream. `hard_end` bounds the *whole* output
+// allocation (8-byte word-copy slack may spill past this block's region
+// into bytes that later blocks overwrite, never past hard_end). Returns
+// bytes produced, or -1 on any error (caller falls back to zlib).
+static int64_t inflate_one(const uint8_t* in, int64_t nin, uint8_t* out,
+                           int64_t out_len, uint8_t* hard_end) {
+  FB b{in, in + nin, 0, 0};
+  uint8_t* dst = out;
+  uint8_t* dst_end = out + out_len;
+  thread_local static uint32_t lit_tab[kTabCap];
+  thread_local static uint32_t dist_tab[kTabCap];
+  thread_local static uint32_t fixed_lit[kTabCap];
+  thread_local static uint32_t fixed_dist[kTabCap];
+  thread_local static bool fixed_ready = false;
+
+  for (;;) {
+    refill(b);
+    if (b.cnt < 3) return -1;
+    uint32_t bfinal = take_bits(b, 1);
+    uint32_t btype = take_bits(b, 2);
+    if (btype == 3) return -1;
+    if (btype == 0) {  // stored: byte-align, LEN/NLEN, raw copy
+      take_bits(b, b.cnt & 7);
+      const uint8_t* q = b.p - (b.cnt >> 3);
+      b.buf = 0;
+      b.cnt = 0;
+      b.p = q;
+      if (b.end - b.p < 4) return -1;
+      uint32_t len = (uint32_t)b.p[0] | ((uint32_t)b.p[1] << 8);
+      uint32_t nlen = (uint32_t)b.p[2] | ((uint32_t)b.p[3] << 8);
+      if ((len ^ 0xffffu) != nlen) return -1;
+      b.p += 4;
+      if (b.end - b.p < (int64_t)len || dst + len > dst_end) return -1;
+      std::memcpy(dst, b.p, len);
+      dst += len;
+      b.p += len;
+    } else {
+      const uint32_t* lt;
+      const uint32_t* dt;
+      if (btype == 1) {
+        if (!fixed_ready) {
+          if (!build_fixed(fixed_lit, fixed_dist)) return -1;
+          fixed_ready = true;
+        }
+        lt = fixed_lit;
+        dt = fixed_dist;
+      } else {
+        refill(b);
+        if (b.cnt < 14) return -1;
+        int hlit = (int)take_bits(b, 5) + 257;
+        int hdist = (int)take_bits(b, 5) + 1;
+        int hclen = (int)take_bits(b, 4) + 4;
+        if (hlit > 286 || hdist > 30) return -1;
+        static const uint8_t kOrder[19] = {16, 17, 18, 0, 8,  7, 9,  6, 10, 5,
+                                           11, 4,  12, 3, 13, 2, 14, 1, 15};
+        uint8_t cl_lens[19] = {0};
+        for (int i = 0; i < hclen; ++i) {
+          refill(b);
+          if (b.cnt < 3) return -1;
+          cl_lens[kOrder[i]] = (uint8_t)take_bits(b, 3);
+        }
+        // The code-length pre-table borrows dist_tab (rebuilt below).
+        if (!build_table(dist_tab, cl_lens, 19)) return -1;
+        uint8_t lens[288 + 30] = {0};
+        int i = 0;
+        while (i < hlit + hdist) {
+          refill(b);
+          uint32_t e = lookup(dist_tab, b.buf);
+          int L = (int)(e & 0xff);
+          if (!L || L > b.cnt) return -1;
+          take_bits(b, L);
+          int sym = (int)(e >> 8);
+          if (sym < 16) {
+            lens[i++] = (uint8_t)sym;
+          } else if (sym == 16) {
+            if (i == 0 || b.cnt < 2) return -1;
+            int rep = 3 + (int)take_bits(b, 2);
+            if (i + rep > hlit + hdist) return -1;
+            uint8_t prev = lens[i - 1];
+            while (rep--) lens[i++] = prev;
+          } else if (sym == 17) {
+            if (b.cnt < 3) return -1;
+            int rep = 3 + (int)take_bits(b, 3);
+            if (i + rep > hlit + hdist) return -1;
+            i += rep;  // lens[] pre-zeroed
+          } else {
+            if (b.cnt < 7) return -1;
+            int rep = 11 + (int)take_bits(b, 7);
+            if (i + rep > hlit + hdist) return -1;
+            i += rep;
+          }
+        }
+        if (lens[256] == 0) return -1;  // need an end-of-block code
+        if (!build_table(lit_tab, lens, hlit)) return -1;
+        if (!build_table(dist_tab, lens + hlit, hdist)) return -1;
+        lt = lit_tab;
+        dt = dist_tab;
+      }
+
+      // One refill per iteration suffices: a full match consumes at most
+      // 15 (litlen) + 5 (len extra) + 15 (dist) + 13 (dist extra) = 48
+      // bits and refill leaves >= 57 mid-stream; the L > cnt checks only
+      // fire at a (malformed) stream end.
+      for (;;) {
+        refill(b);
+        uint32_t e = lookup(lt, b.buf);
+        int L = (int)(e & 0xff);
+        if (!L || L > b.cnt) return -1;
+        b.buf >>= L;
+        b.cnt -= L;
+        uint32_t sym = e >> 8;
+        if (sym < 256) {
+          if (dst >= dst_end) return -1;
+          *dst++ = (uint8_t)sym;
+          // Literal run: keep decoding while the buffer holds a whole code.
+          while (b.cnt >= 15) {
+            e = lookup(lt, b.buf);
+            L = (int)(e & 0xff);
+            if (!L) return -1;
+            sym = e >> 8;
+            if (sym >= 256) break;
+            b.buf >>= L;
+            b.cnt -= L;
+            if (dst >= dst_end) return -1;
+            *dst++ = (uint8_t)sym;
+          }
+          continue;  // non-literal (bits unconsumed): outer loop re-decodes
+        }
+        if (sym == 256) break;
+        int li = (int)sym - 257;
+        if (li >= 29) return -1;
+        int eb = kLenExtra[li];
+        if (b.cnt < eb) return -1;
+        uint32_t len = (uint32_t)kLenBase[li] + take_bits(b, eb);
+        e = lookup(dt, b.buf);
+        L = (int)(e & 0xff);
+        if (!L || L > b.cnt) return -1;
+        b.buf >>= L;
+        b.cnt -= L;
+        uint32_t dsym = e >> 8;
+        if (dsym >= 30) return -1;
+        int deb = kDistExtra[dsym];
+        if (b.cnt < deb) return -1;
+        uint32_t dist = (uint32_t)kDistBase[dsym] + take_bits(b, deb);
+        if ((int64_t)dist > dst - out) return -1;  // BGZF: no prior history
+        if (dst + len > dst_end) return -1;
+        const uint8_t* src = dst - dist;
+        if (dist == 1) {
+          std::memset(dst, dst[-1], len);
+          dst += len;
+        } else if (dist >= 8 && dst + len + 8 <= hard_end) {
+          uint8_t* d = dst;
+          const uint8_t* s = src;
+          int64_t l = (int64_t)len;
+          do {
+            std::memcpy(d, s, 8);
+            d += 8;
+            s += 8;
+            l -= 8;
+          } while (l > 0);
+          dst += len;
+        } else {
+          for (uint32_t k = 0; k < len; ++k) dst[k] = src[k];
+          dst += len;
+        }
+      }
+    }
+    if (bfinal) return dst - out;
+  }
+}
+
+}  // namespace fastinf
+
+extern "C" {
+
+// Fast batched raw-DEFLATE inflate. Same contract as sbt_inflate_blocks
+// plus `out_capacity`: the total bytes allocated at `out`, which must
+// include >=8 bytes of slack beyond the last block's end (word-copy
+// overrun room). Returns 0, or the 1-based index of the first failing
+// block — the caller re-runs failures through zlib.
+long sbt_inflate_blocks_fast(
+    const uint8_t* comp,
+    const int64_t* offsets,
+    const int64_t* lengths,
+    int64_t count,
+    uint8_t* out,
+    const int64_t* out_offsets,
+    const int64_t* out_lengths,
+    int64_t out_capacity) {
+  uint8_t* hard_end = out + out_capacity;
+  for (int64_t i = 0; i < count; ++i) {
+    int64_t got = fastinf::inflate_one(
+        comp + offsets[i], lengths[i], out + out_offsets[i], out_lengths[i],
+        hard_end);
+    if (got != out_lengths[i]) return i + 1;
+  }
+  return 0;
+}
+
+}  // extern "C"

@@ -31,11 +31,13 @@ checker consumes identical flat windows from either producer.
 
 from __future__ import annotations
 
-import functools
+import logging
 from concurrent.futures import ThreadPoolExecutor
 from typing import Iterator
 
 import numpy as np
+
+log = logging.getLogger(__name__)
 
 import jax
 import jax.numpy as jnp
@@ -185,6 +187,7 @@ class InflatePipeline:
         window_uncompressed: int = 64 << 20,
         threads: int = 8,
         device_copy: bool = False,
+        depth: int = 2,
     ):
         from spark_bam_tpu.bgzf.index_blocks import blocks_metadata
 
@@ -194,10 +197,15 @@ class InflatePipeline:
         self.groups = window_plan(self.metas, window_uncompressed)
         self.threads = threads
         self.device_copy = device_copy
+        # Window groups in flight at once: >1 fans the produce stage out
+        # across groups (on top of each group's internal block-slice
+        # parallelism), keeping every host core busy while the device runs.
+        self.depth = max(1, depth)
+        self._warned_device_demote = False
 
     def __iter__(self) -> Iterator[FlatView]:
         ch = open_channel(self.path)
-        pool = ThreadPoolExecutor(max_workers=1)  # pipeline stage, not fan-out
+        pool = ThreadPoolExecutor(max_workers=self.depth)
 
         def produce(group):
             if self.device_copy:
@@ -207,8 +215,13 @@ class InflatePipeline:
                 try:
                     view = inflate_group_device(ch, group, file_total=self.total)
                 except Exception:
-                    # Any device-phase failure (bad stream, device OOM, …)
-                    # demotes the window, never kills the stream.
+                    if not self._warned_device_demote:
+                        self._warned_device_demote = True
+                        log.warning(
+                            "device inflate failed; demoting window(s) to "
+                            "host zlib (reported once per stream)",
+                            exc_info=True,
+                        )
                     view = None
                 if view is not None:
                     return view
@@ -217,14 +230,20 @@ class InflatePipeline:
             )
 
         try:
-            nxt = pool.submit(produce, self.groups[0]) if self.groups else None
-            for i, group in enumerate(self.groups):
-                view = nxt.result()
-                if i + 1 < len(self.groups):
-                    nxt = pool.submit(produce, self.groups[i + 1])
+            pending = [
+                pool.submit(produce, g) for g in self.groups[: self.depth]
+            ]
+            for i in range(len(self.groups)):
+                view = pending.pop(0).result()
+                nxt = i + self.depth
+                if nxt < len(self.groups):
+                    pending.append(pool.submit(produce, self.groups[nxt]))
                 if i == len(self.groups) - 1:
                     view.at_eof = True
                 yield view
         finally:
-            pool.shutdown(wait=False)
+            # Wait for in-flight produce calls: they hold zero-copy views of
+            # the mmap, and closing it under them raises BufferError (or
+            # worse). Queued-but-unstarted work is cancelled.
+            pool.shutdown(wait=True, cancel_futures=True)
             ch.close()
