@@ -15,12 +15,16 @@ import re
 _COUNT_FLAG = "--xla_force_host_platform_device_count"
 
 
-def force_cpu_devices(n_devices: int) -> None:
+def force_cpu_devices(n_devices: int, defer_init: bool = False) -> None:
     """Force jax onto ``n_devices`` virtual CPU devices.
 
     Must run before any jax backend is initialized (first ``jax.devices()`` /
     first traced computation); after that the host-device-count flag is
     latched and this has no effect.
+
+    ``defer_init=True`` only sets the flags without touching a backend —
+    required before ``jax.distributed.initialize()``, which must itself run
+    before any backend init (multi-host bring-up, parallel/multihost.py).
     """
     flags = os.environ.get("XLA_FLAGS", "")
     opt = f"{_COUNT_FLAG}={n_devices}"
@@ -42,6 +46,9 @@ def force_cpu_devices(n_devices: int) -> None:
         jax.config.update("jax_platforms", "cpu")
     except Exception:
         pass
+
+    if defer_init:
+        return
 
     # Initializing here (with our flags set) both latches the virtual-device
     # count and lets us fail loud instead of silently running on the real

@@ -31,21 +31,46 @@ def _build(src: Path, out: Path) -> bool:
     # can never leave a half-written .so that later loads would trip over.
     tmp = out.with_suffix(f".tmp{os.getpid()}")
     tail = [str(src), "-o", str(tmp), "-lz"]
-    # -march=native helps the bit-twiddling hot loops measurably; the .so is
-    # built lazily per machine (never shipped), so native tuning is safe.
-    # Retry generic in case the toolchain rejects it.
-    for flags in ([*base, "-march=native", *tail], [*base, *tail]):
-        try:
-            subprocess.run(flags, check=True, capture_output=True)
-            os.replace(tmp, out)
-            return True
-        except FileNotFoundError as e:
-            log.warning("native build failed (%s); using Python fallbacks", e)
-            return False
-        except subprocess.CalledProcessError:
-            continue
-    log.warning("native build failed; using Python fallbacks")
-    return False
+    # -march=native helps the bit-twiddling hot loops measurably; the cache
+    # key includes a host-CPU token, so a shared checkout never serves one
+    # machine's tuned binary to a different machine. Retry generic in case
+    # the toolchain rejects -march=native.
+    last_err = None
+    try:
+        for flags in ([*base, "-march=native", *tail], [*base, *tail]):
+            try:
+                subprocess.run(flags, check=True, capture_output=True)
+                os.replace(tmp, out)
+                return True
+            except FileNotFoundError as e:
+                log.warning("native build failed (%s); using Python fallbacks", e)
+                return False
+            except subprocess.CalledProcessError as e:
+                last_err = e
+        log.warning(
+            "native build failed (rc=%s): %s; using Python fallbacks",
+            last_err.returncode,
+            (last_err.stderr or b"").decode(errors="replace")[-500:],
+        )
+        return False
+    finally:
+        tmp.unlink(missing_ok=True)
+
+
+def _host_token() -> str:
+    """A short token identifying this host's CPU (for the .so cache key)."""
+    import platform
+
+    desc = platform.machine()
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features", "model name")):
+                    desc += line
+                    break
+    except OSError:
+        pass
+    return hashlib.sha256(desc.encode()).hexdigest()[:8]
 
 
 def load_native():
@@ -60,7 +85,7 @@ def load_native():
 
 def _load_native_locked():
     digest = hashlib.sha256(_SRC.read_bytes()).hexdigest()[:16]
-    out = _SRC.parent / f"_spark_bam_native_{digest}.so"
+    out = _SRC.parent / f"_spark_bam_native_{digest}_{_host_token()}.so"
     if not out.exists() and not _build(_SRC, out):
         _LIB_CACHE.append(None)
         return None

@@ -1,0 +1,186 @@
+"""Multi-host sharded checking: jax.distributed bring-up + runnable worker.
+
+The reference scales with a Spark cluster (driver + executors over the
+network, SURVEY.md §2.9); the TPU-native analog is one JAX process per host
+joined through ``jax.distributed``, with the sharded check step's stat
+reductions riding XLA collectives (``psum``) over ICI/DCN. Each host feeds
+its *own* windows (per-host file shards) — the workload needs no cross-host
+data motion beyond the ≤64 KiB halos stitched host-side at batch assembly.
+
+Launch recipe — run ONE of these per host (same command, distinct
+``--process-id``; process 0's host is the coordinator):
+
+    python -m spark_bam_tpu.parallel.multihost \
+        --coordinator HOST0:12321 --num-processes N --process-id K
+
+On TPU pods that's the whole recipe (each process grabs its local chips).
+For a CPU rehearsal on one machine add ``--local-devices 4`` to every
+process — 2 processes × 4 virtual devices = the same 8-way mesh the tests
+use; ``tests/test_multihost.py`` drives exactly this.
+
+The worker checks a deterministic synthetic batch (one window per global
+device, content varying per window) and process 0 prints the globally
+reduced confusion matrix as one JSON line — the smoke artifact proving the
+cross-process mesh + collectives actually executed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import struct
+
+import numpy as np
+
+RECORD_NOISE = 1024
+
+
+def example_window(w: int, n_records: int = 50, seed: int = 7):
+    """A tiny synthetic BAM-record stream in a flat window buffer.
+
+    Returns (padded, n, record_starts): ``n`` counts the records plus a
+    trailing burst of noise bytes (which breaks the final records' chains —
+    they become checker false *negatives* relative to raw record starts),
+    and ``record_starts`` is the ground truth for confusion-matrix tests.
+    """
+    from spark_bam_tpu.tpu.checker import PAD
+
+    rng = np.random.default_rng(seed)
+    buf = bytearray()
+    starts = []
+    for i in range(n_records):
+        starts.append(len(buf))
+        name = f"read{i}".encode() + b"\x00"
+        n_cigar = 1
+        seq_len = 8
+        body = (
+            struct.pack(
+                "<iiBBHHHiiii",
+                0,                      # refID
+                1000 + i,               # pos
+                len(name), 30, 0,       # l_read_name, mapq, bin
+                n_cigar, 0,             # n_cigar, flag
+                seq_len, 0, 1000 + i, 0,  # l_seq, next_refID, next_pos, tlen
+            )
+            + name
+            + struct.pack("<I", (seq_len << 4) | 0)
+            + bytes((seq_len + 1) // 2)
+            + bytes([30] * seq_len)
+        )
+        buf += struct.pack("<i", len(body)) + body
+    n = len(buf)
+    padded = np.zeros(w + PAD, dtype=np.uint8)
+    padded[:n] = np.frombuffer(bytes(buf), dtype=np.uint8)
+    # Noise after the records exercises the reject path.
+    padded[n: n + RECORD_NOISE] = rng.integers(0, 256, RECORD_NOISE, dtype=np.uint8)
+    return padded, np.int32(n + RECORD_NOISE), np.array(starts, dtype=np.int64)
+
+
+def run_worker(
+    coordinator: str | None,
+    num_processes: int,
+    process_id: int,
+    local_devices: int = 0,
+    window: int = 1 << 16,
+) -> dict:
+    """Join the cluster, run one sharded check step over a global batch
+    (one window per global device), return the reduced stats (process 0)."""
+    if local_devices:
+        from spark_bam_tpu.core.platform import force_cpu_devices
+
+        force_cpu_devices(local_devices, defer_init=num_processes > 1)
+    import jax
+
+    if num_processes > 1:
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from spark_bam_tpu.parallel.mesh import make_mesh, make_shard_map_check_step
+    from spark_bam_tpu.tpu.checker import PAD
+
+    devices = jax.devices()
+    n_global = len(devices)
+    n_local = jax.local_device_count()
+    mesh = make_mesh(devices)
+
+    # This host's rows of the global batch: window contents vary per global
+    # row (record count 40+row), so the reduction provably mixes every
+    # host's distinct contribution.
+    row0 = process_id * n_local
+    windows = np.zeros((n_local, window + PAD), dtype=np.uint8)
+    ns = np.zeros(n_local, dtype=np.int32)
+    truth = np.zeros((n_local, window), dtype=bool)
+    for j in range(n_local):
+        n_records = 40 + row0 + j
+        padded, n, starts = example_window(window, n_records)
+        windows[j] = padded
+        ns[j] = n
+        truth[j, starts] = True
+    at_eofs = np.ones(n_local, dtype=bool)
+    lengths = np.zeros(1024, dtype=np.int32)
+    lengths[0] = 249_250_621
+
+    shard = NamedSharding(mesh, P("data"))
+    repl = NamedSharding(mesh, P())
+    windows_d = jax.make_array_from_process_local_data(shard, windows)
+    ns_d = jax.make_array_from_process_local_data(shard, ns)
+    eofs_d = jax.make_array_from_process_local_data(shard, at_eofs)
+    truth_d = jax.make_array_from_process_local_data(shard, truth)
+    lengths_d = jax.device_put(lengths, repl)
+
+    step = make_shard_map_check_step(mesh)
+    verdicts, totals = step(
+        windows_d, ns_d, eofs_d, truth_d, lengths_d, jnp.int32(1)
+    )
+    verdicts.block_until_ready()
+    totals = np.asarray(totals)  # replicated: addressable on every process
+
+    # Expected: every row contributes its record count minus the 9 chains
+    # the trailing noise breaks (a boundary needs 10 consecutive records).
+    exp_tp = sum(40 + r - 9 for r in range(n_global))
+    exp_fn = 9 * n_global
+    stats = {
+        "processes": num_processes,
+        "process_id": process_id,
+        "global_devices": n_global,
+        "local_devices": n_local,
+        "true_positives": int(totals[0]),
+        "false_positives": int(totals[1]),
+        "false_negatives": int(totals[2]),
+        "true_negatives": int(totals[3]),
+        "positions": int(totals[4]),
+        "expected_tp": exp_tp,
+        "expected_fn": exp_fn,
+        "ok": int(totals[0]) == exp_tp
+        and int(totals[2]) == exp_fn
+        and int(totals[1]) == 0,
+    }
+    return stats
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--coordinator", default=None)
+    ap.add_argument("--num-processes", type=int, default=1)
+    ap.add_argument("--process-id", type=int, default=0)
+    ap.add_argument(
+        "--local-devices", type=int, default=0,
+        help="force N virtual CPU devices (rehearsal mode); 0 = real devices",
+    )
+    a = ap.parse_args(argv)
+    stats = run_worker(
+        a.coordinator, a.num_processes, a.process_id, a.local_devices
+    )
+    if stats["process_id"] == 0:
+        print(json.dumps(stats))
+    return 0 if stats["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
