@@ -43,6 +43,10 @@ class TpuLoadResult:
 def record_starts(
     path, config: Config = Config(), checker: TpuChecker | None = None
 ) -> TpuLoadResult:
+    """Whole-file record starts with the flat view retained (small files /
+    callers that need the bytes, e.g. columnar parsing). For inputs larger
+    than memory use ``record_starts_streaming`` / ``count_reads_tpu``, which
+    run in O(window) host memory."""
     header = read_header(path)
     view = flatten_file(path)
     if checker is None:
@@ -64,8 +68,22 @@ def record_starts(
     return TpuLoadResult(view, header, starts)
 
 
+def record_starts_streaming(path, config: Config = Config()):
+    """Absolute flat record-start offsets, streamed per window in O(window)
+    host memory (the WGS-scale path; reference CanLoadBam.scala:173-243 is
+    likewise streaming per split)."""
+    from spark_bam_tpu.tpu.stream_check import StreamChecker
+
+    yield from StreamChecker(path, config).record_starts()
+
+
 def count_reads_tpu(path, config: Config = Config()) -> int:
-    return len(record_starts(path, config).starts)
+    """count-reads via the streaming checker: O(window) host memory, device
+    windows double-buffered, per-window counts reduced on device. This is
+    the same code path bench.py measures."""
+    from spark_bam_tpu.tpu.stream_check import StreamChecker
+
+    return StreamChecker(path, config).count_reads()
 
 
 def load_reads_columnar(

@@ -34,8 +34,10 @@ from spark_bam_tpu.check.flags import BIT
 from spark_bam_tpu.check.vectorized import DEFINITIVE_MASK, ESCAPE_MASK
 
 # Padding beyond any index the flag pass can touch (36 fixed + 255 name +
-# 4*65535 cigar + slack), rounded to a multiple of 4 for the stride-4 scan.
-PAD = 36 + 255 + 4 * 65535 + 17  # = 262448, divisible by 4
+# 4*65535 cigar + slack), rounded up to a multiple of 1024 so it can double
+# as the Pallas slab halo (Mosaic DMA slices tile at 1024 elements) and of 4
+# for the stride-4 scan. 257*1024 = 263168 ≥ 262431.
+PAD = 257 * 1024
 
 _I32 = jnp.int32
 

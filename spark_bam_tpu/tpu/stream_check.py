@@ -1,27 +1,311 @@
 """Streaming whole-file checking: larger-than-memory BAMs.
 
-Stitches the InflatePipeline's block-aligned windows with a carried tail so
-every chain can complete, and runs the window kernel over each stitched
-buffer. Ownership tiles the uncompressed stream exactly; candidates whose
-chains outrun even the stitched buffer stay *pending* and resolve against
-later windows (the carry grows to keep every pending position in view), so
-results equal the in-memory whole-file run byte-for-byte.
+This is the production scale path of BASELINE.json's NA12878/WGS configs
+*and* the path bench.py measures — one code path, O(window) host memory.
 
-This is the scale path of BASELINE.json's NA12878/WGS configs: memory use
-is O(window + carry), not O(file).
+Design (the double-buffered halo-carry loop):
+
+- The ``InflatePipeline`` produces block-aligned uncompressed windows
+  (host-parallel inflate, two windows in flight). Each kernel buffer is
+  ``carry + window`` where ``carry`` is the previous buffer's trailing
+  ``halo`` bytes, so every owned position has ≥ ``halo`` bytes of
+  lookahead for its ``reads_to_check`` chain.
+- Ownership tiles the uncompressed stream exactly: a non-final buffer
+  owns everything but its halo tail; the halo positions are owned (and
+  re-evaluated with full lookahead) by the next buffer.
+- Two windows are in flight: window *k+1* is dispatched to the device
+  before window *k*'s results are materialized, so host inflate, H2D
+  transfer, and the kernel overlap.
+- Candidates whose chains outrun even the halo (ultra-long reads — the
+  reference bounds a boundary scan by ``maxReadSize`` = 10 MB,
+  check/.../package.scala:49-57) *escape*; escaped owned positions are
+  deferred into a side buffer of raw bytes that grows until their chains
+  can complete, then resolve through the NumPy engine. Deferred
+  positions are reported ``False`` in their covering span and re-emitted
+  as 1-position spans once resolved; every resolution is vectorized —
+  O(pending) per window, never O(pending²).
+
+The span contract: ``spans()`` yields ``(base, verdict)`` pairs whose
+``True`` positions are exactly the record starts of the file. Spans tile
+``[0, total)`` in order, plus rare trailing 1-position spans for deferred
+candidates (whose slot in the covering span is ``False``).
+
+``count_reads()`` never materializes verdict arrays on host: each
+window's boundary count reduces on device and only two scalars cross the
+wire (reference workload: count-reads, docs/benchmarks.md:53-59).
 """
 
 from __future__ import annotations
 
-from typing import Iterator
+from typing import Callable, Iterator
 
 import numpy as np
 
+import jax
+import jax.numpy as jnp
+
 from spark_bam_tpu.bam.header import read_header
+from spark_bam_tpu.check.vectorized import check_flat
 from spark_bam_tpu.core.config import Config
-from spark_bam_tpu.tpu.checker import TpuChecker
 from spark_bam_tpu.tpu.inflate import InflatePipeline
 
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(0, (max(n, 1) - 1).bit_length())
+
+
+@jax.jit
+def _reduce_span(verdict, escaped, lo, hi):
+    """Device-side reduction of one window's owned span → two scalars."""
+    i = jnp.arange(verdict.shape[0], dtype=jnp.int32)
+    m = (i >= lo) & (i < hi)
+    return jnp.sum(m & verdict), jnp.sum(m & escaped)
+
+
+class StreamChecker:
+    """Whole-file streaming checker over a fixed device kernel window.
+
+    Parameters mirror the ``spark.bam.*`` config surface: ``window``/``halo``
+    from ``Config`` unless overridden; ``use_device=False`` runs the NumPy
+    engine (the differential oracle) through the identical control flow.
+    ``progress(windows_done, positions_done, total_positions)`` is invoked
+    after each window resolves (the bench's per-window stage markers).
+    """
+
+    def __init__(
+        self,
+        path,
+        config: Config = Config(),
+        window_uncompressed: int | None = None,
+        halo: int | None = None,
+        use_device: bool = True,
+        progress: Callable[[int, int, int], None] | None = None,
+    ):
+        self.path = path
+        self.config = config
+        self.use_device = use_device
+        self.progress = progress
+        self.header = read_header(path)
+        self.lengths = np.array(
+            self.header.contig_lengths.lengths_list(), dtype=np.int32
+        )
+        fresh = window_uncompressed or config.window_size
+        halo = config.halo_size if halo is None else halo
+        # The halo must leave room to advance; chains needing more lookahead
+        # than the halo escape to the deferral path and still resolve exactly.
+        self.halo = min(halo, fresh // 2)
+        self.pipeline = InflatePipeline(path, window_uncompressed=fresh)
+        self.total = self.pipeline.total
+        # Kernel shape: one power of two covering carry + window, clamped to
+        # the file so small inputs compile a small kernel.
+        self.kernel_window = _next_pow2(
+            min(fresh + self.halo, max(self.total, 1 << 16))
+        )
+        # Absolute flat offset of the first record: the header's size in
+        # uncompressed bytes IS that offset (bam/header.py measures it by
+        # position after the contig dictionary).
+        self.header_end_abs = self.header.uncompressed_size
+
+    # ------------------------------------------------------------ the loop
+    def _windows(self):
+        """Yield ``(buf, base, own_end, at_eof, launched)`` one window behind
+        the device: window *k+1* is dispatched before *k* is yielded, so the
+        consumer's host work overlaps the device."""
+        launch = self._launcher()
+        carry = np.empty(0, dtype=np.uint8)
+        base_next = 0
+        prev = None
+        for view in self.pipeline:
+            base = base_next
+            buf = (
+                np.concatenate([carry, view.data]) if len(carry) else view.data
+            )
+            n = len(buf)
+            at_eof = view.at_eof
+            out = launch(buf, n, at_eof)
+            if prev is not None:
+                yield prev
+            own_end = n if at_eof else max(n - self.halo, 0)
+            prev = (buf, base, own_end, at_eof, out)
+            carry = buf[own_end:]
+            base_next = base + own_end
+        if prev is not None:
+            yield prev
+
+    def _launcher(self):
+        if not self.use_device:
+            return lambda buf, n, at_eof: None  # resolved lazily on host
+        import jax
+        import jax.numpy as jnp
+
+        from spark_bam_tpu.tpu.checker import PAD, make_check_window
+
+        kernel = make_check_window(self.kernel_window, self.config.reads_to_check)
+        lens = np.zeros(max(1024, len(self.lengths)), dtype=np.int32)
+        lens[: len(self.lengths)] = self.lengths
+        lens_dev = jax.device_put(jnp.asarray(lens))
+        nc = jnp.int32(len(self.lengths))
+        w = self.kernel_window
+
+        def launch(buf, n, at_eof):
+            padded = np.zeros(w + PAD, dtype=np.uint8)
+            padded[:n] = buf
+            # Fresh buffer per window (never mutated after dispatch): safe
+            # under async dispatch even when jnp.asarray aliases zero-copy
+            # on the CPU backend.
+            return kernel(
+                jnp.asarray(padded), lens_dev, nc, jnp.int32(n),
+                jnp.bool_(at_eof),
+            )
+
+        return launch
+
+    def _verdict_escaped(self, buf, at_eof, out):
+        """Materialize one window's (verdict, escaped) as host arrays."""
+        if out is None:
+            res = check_flat(
+                buf, self.lengths, at_eof=at_eof,
+                reads_to_check=self.config.reads_to_check,
+            )
+            return res.verdict, res.escaped
+        return np.asarray(out["verdict"]), np.asarray(out["escaped"])
+
+    # --------------------------------------------------- deferred candidates
+    class _Deferred:
+        """Escaped owned positions + the byte stream that will resolve them.
+
+        ``buf`` holds raw bytes from ``base`` (the earliest pending
+        position) through the newest window's end; it extends as windows
+        arrive and trims as pendings resolve. All operations are
+        vectorized over the pending set.
+        """
+
+        def __init__(self, lengths: np.ndarray, reads_to_check: int):
+            self.lengths = lengths
+            self.rtc = reads_to_check
+            self.pending = np.empty(0, dtype=np.int64)
+            self.base = 0
+            self.buf = np.empty(0, dtype=np.uint8)
+
+        def __len__(self):
+            return len(self.pending)
+
+        def extend(self, win_buf: np.ndarray, win_base: int):
+            """Grow the byte stream with a window's newly-seen bytes."""
+            if not len(self.pending):
+                return
+            tip = self.base + len(self.buf)
+            if win_base + len(win_buf) > tip:
+                self.buf = np.concatenate(
+                    [self.buf, win_buf[max(tip - win_base, 0):]]
+                )
+
+        def add(self, positions: np.ndarray, win_buf: np.ndarray, win_base: int):
+            if not len(positions):
+                return
+            if not len(self.pending):
+                self.base = int(positions.min())
+                self.buf = win_buf[self.base - win_base:].copy()
+            self.pending = np.concatenate([self.pending, positions])
+
+        def resolve(self, at_eof: bool) -> Iterator[tuple[int, np.ndarray]]:
+            """Re-check pendings against the grown stream; yield 1-position
+            spans for those whose chains now complete."""
+            if not len(self.pending):
+                return
+            res = check_flat(
+                self.buf, self.lengths,
+                candidates=self.pending - self.base,
+                at_eof=at_eof, reads_to_check=self.rtc,
+            )
+            done = ~res.escaped
+            for pos, v in zip(
+                self.pending[done].tolist(), res.verdict[done].tolist()
+            ):
+                yield int(pos), np.array([v], dtype=bool)
+            self.pending = self.pending[~done]
+            if not len(self.pending):
+                self.buf = np.empty(0, dtype=np.uint8)
+            else:
+                lo = int(self.pending.min())
+                self.buf = self.buf[lo - self.base:]
+                self.base = lo
+
+    # ------------------------------------------------------------- consumers
+    def spans(self) -> Iterator[tuple[int, np.ndarray]]:
+        """Yield ``(base, verdict)`` spans; see the module contract."""
+        deferred = self._Deferred(self.lengths, self.config.reads_to_check)
+        windows = 0
+        for buf, base, own_end, at_eof, out in self._windows():
+            verdict, escaped = self._verdict_escaped(buf, at_eof, out)
+            span = verdict[:own_end].copy()
+            deferred.extend(buf, base)
+            esc_idx = np.flatnonzero(escaped[:own_end])
+            if len(esc_idx):
+                span[esc_idx] = False  # re-emitted by the deferral path
+                deferred.add(base + esc_idx, buf, base)
+            yield base, span
+            yield from deferred.resolve(at_eof)
+            windows += 1
+            if self.progress is not None:
+                self.progress(windows, base + own_end, self.total)
+        assert not len(deferred), "pendings must resolve by EOF"
+
+    def count_reads(self) -> int:
+        """Record count (the count-reads workload). On device, each window
+        reduces to two scalars on-chip; verdict arrays never cross the wire."""
+        he = self.header_end_abs
+        if not self.use_device:
+            return sum(
+                int(v[max(he - b, 0):].sum()) for b, v in self.spans()
+            )
+        total = 0
+        deferred = self._Deferred(self.lengths, self.config.reads_to_check)
+        windows = 0
+        pending_scalars = None
+
+        def settle(scalars, buf, base, own_end, at_eof, out):
+            nonlocal total
+            cnt, esc = scalars
+            total += int(cnt)
+            deferred.extend(buf, base)
+            if int(esc):
+                escaped = np.asarray(out["escaped"])[:own_end]
+                esc_idx = np.flatnonzero(escaped)
+                esc_idx = esc_idx[base + esc_idx >= he]
+                deferred.add(base + esc_idx, buf, base)
+            for pos, v in deferred.resolve(at_eof):
+                total += int(v[0])
+
+        for buf, base, own_end, at_eof, out in self._windows():
+            lo = min(max(he - base, 0), own_end)
+            scalars = _reduce_span(
+                out["verdict"], out["escaped"], jnp.int32(lo),
+                jnp.int32(own_end),
+            )
+            if pending_scalars is not None:
+                settle(*pending_scalars)
+            pending_scalars = (scalars, buf, base, own_end, at_eof, out)
+            windows += 1
+            if self.progress is not None:
+                self.progress(windows, base + own_end, self.total)
+        if pending_scalars is not None:
+            settle(*pending_scalars)
+        assert not len(deferred), "pendings must resolve by EOF"
+        return total
+
+    def record_starts(self) -> Iterator[np.ndarray]:
+        """Absolute flat offsets of record starts, one array per span, in
+        stream order (deferred resolutions may append out of order)."""
+        he = self.header_end_abs
+        for base, verdict in self.spans():
+            idx = base + np.flatnonzero(verdict)
+            idx = idx[idx >= he]
+            if len(idx):
+                yield idx
+
+
+# ----------------------------------------------------------- module wrappers
 
 def stream_verdicts(
     path,
@@ -29,109 +313,23 @@ def stream_verdicts(
     window_uncompressed: int | None = None,
     halo: int | None = None,
     use_device: bool = True,
+    progress: Callable[[int, int, int], None] | None = None,
 ) -> Iterator[tuple[int, np.ndarray]]:
-    """Yield (absolute flat base, verdict array) spans tiling the file."""
-    header = read_header(path)
-    lengths = np.array(header.contig_lengths.lengths_list(), dtype=np.int32)
-    window_uncompressed = window_uncompressed or config.window_size
-    halo = halo or config.halo_size
-
-    pipeline = InflatePipeline(path, window_uncompressed=window_uncompressed)
-
-    checker: TpuChecker | None = None
-
-    def check(buf: np.ndarray, at_eof: bool):
-        nonlocal checker
-        if use_device:
-            want = max(len(buf), 1)
-            kernel_window = 1 << max(20, (want - 1).bit_length())
-            if checker is None or checker.window < kernel_window:
-                checker = TpuChecker(
-                    lengths,
-                    window=kernel_window,
-                    halo=min(halo, kernel_window // 4),
-                    reads_to_check=config.reads_to_check,
-                )
-            return checker.check_buffer(buf, at_eof=at_eof)
-        from spark_bam_tpu.check.vectorized import check_flat
-
-        return check_flat(buf, lengths, at_eof=at_eof,
-                          reads_to_check=config.reads_to_check)
-
-    carry = np.empty(0, dtype=np.uint8)
-    carry_abs = 0          # absolute flat offset of carry[0] (0 before start)
-    owned_until = 0        # absolute: spans emitted so far tile [0, owned_until)
-    pending_abs: list[int] = []  # owned positions still unresolved
-
-    for view in pipeline:
-        buf = np.concatenate([carry, view.data]) if len(carry) else view.data
-        base = carry_abs
-        at_eof = view.at_eof
-
-        res = check(buf, at_eof)
-
-        # Resolve pendings that now have more lookahead.
-        if pending_abs:
-            idxs = np.array(pending_abs, dtype=np.int64) - base
-            assert (idxs >= 0).all(), "carry must retain pending positions"
-            for abs_pos, rel in zip(list(pending_abs), idxs):
-                if at_eof or not res.escaped[rel]:
-                    yield abs_pos, res.verdict[rel: rel + 1]
-                    pending_abs.remove(abs_pos)
-
-        # This window's newly-owned span (the carry may reach back into
-        # territory earlier windows already emitted).
-        own_end = len(buf) if at_eof else max(len(buf) - halo, 0)
-        lo = owned_until - base
-        if own_end > lo:
-            verdict = res.verdict[lo:own_end].copy()
-            if not at_eof:
-                esc = np.flatnonzero(res.escaped[lo:own_end])
-                for i in esc:
-                    pending_abs.append(base + lo + int(i))
-                verdict[esc] = False  # reported via the pending path instead
-            yield base + lo, verdict
-            owned_until = base + own_end
-
-        if at_eof:
-            break
-        # Carry enough tail to keep halo AND all pending positions in view.
-        carry_from = own_end
-        if pending_abs:
-            carry_from = min(carry_from, min(pending_abs) - base)
-        carry = buf[carry_from:].copy()
-        carry_abs = base + carry_from
-
-    assert not pending_abs, "pendings must resolve by EOF"
+    """Yield (base, verdict) spans tiling the file (see ``StreamChecker``)."""
+    yield from StreamChecker(
+        path, config, window_uncompressed, halo, use_device, progress
+    ).spans()
 
 
 def count_reads_streaming(
-    path, config: Config = Config(), window_uncompressed: int | None = None,
-    halo: int | None = None, use_device: bool = True,
+    path,
+    config: Config = Config(),
+    window_uncompressed: int | None = None,
+    halo: int | None = None,
+    use_device: bool = True,
+    progress: Callable[[int, int, int], None] | None = None,
 ) -> int:
-    """Record count via streaming verdicts (the count-reads scale path)."""
-    header = read_header(path)
-    total = 0
-    # Header occupies the leading uncompressed bytes; its end in flat terms:
-    from spark_bam_tpu.bgzf.index_blocks import blocks_metadata
-
-    metas = list(blocks_metadata(path))
-    flat_of_block = {}
-    acc = 0
-    for m in metas:
-        flat_of_block[m.start] = acc
-        acc += m.uncompressed_size
-    header_end_abs = (
-        flat_of_block[header.end_pos.block_pos] + header.end_pos.offset
-    )
-
-    for base, verdict in stream_verdicts(
-        path, config, window_uncompressed, halo, use_device
-    ):
-        if len(verdict) == 1:  # a resolved pending position
-            if base >= header_end_abs:
-                total += int(verdict[0])
-            continue
-        lo = max(header_end_abs - base, 0)
-        total += int(verdict[lo:].sum())
-    return total
+    """Record count via the streaming checker (the count-reads scale path)."""
+    return StreamChecker(
+        path, config, window_uncompressed, halo, use_device, progress
+    ).count_reads()

@@ -15,9 +15,14 @@ sys.path.insert(0, str(REPO_ROOT))
 # tunnel; tests want the fast deterministic CPU backend with 8 virtual
 # devices so multi-chip sharding is exercised. Real-TPU runs go through
 # bench.py / __graft_entry__.py.
-from spark_bam_tpu.core.platform import force_cpu_devices  # noqa: E402
+from spark_bam_tpu.core.platform import (  # noqa: E402
+    enable_compile_cache,
+    force_cpu_devices,
+)
 
 force_cpu_devices(8)
+# Persistent XLA compile cache: repeat test sessions skip kernel recompiles.
+enable_compile_cache("/tmp/spark_bam_jaxcache_cpu")
 
 import pytest  # noqa: E402
 
