@@ -2,11 +2,14 @@
 
 Replaces the reference's L0 ``org.hammerlab.channel`` layer
 (``SeekableByteChannel``, ``CachingChannel`` — SURVEY.md §1 L0). Local files
-are served from ``mmap`` (zero-copy slices straight into NumPy); the class is
-the single IO seam, so remote backends (GCS/HTTP) plug in by subclassing
-``ByteChannel`` — only ``_read_at`` needs overriding, and ``CachingChannel``
-supplies the chunk cache that makes high-latency backends viable
-(SURVEY.md §7 "Remote storage IO").
+are served from ``mmap`` (zero-copy slices straight into NumPy). The class
+is the single IO seam: ``open_channel`` routes ``http(s)://`` URLs to the
+built-in range-GET backend (core/remote.py) behind a read-ahead
+``PrefetchChannel``, and other ``scheme://`` URLs to factories registered
+via ``register_scheme`` — only ``_read_at`` needs overriding in a backend,
+while ``CachingChannel``/``PrefetchChannel`` supply the reuse and
+latency-hiding that make high-latency stores viable (SURVEY.md §7 "Remote
+storage IO"; latency-injection proof in tests/test_remote.py).
 """
 
 from __future__ import annotations
@@ -14,6 +17,7 @@ from __future__ import annotations
 import io
 import mmap
 import os
+import re
 import struct
 import threading
 from collections import OrderedDict
@@ -193,7 +197,41 @@ class CachingChannel(ByteChannel):
         self.inner.close()
 
 
+# Custom URL schemes → channel factories (tests register latency-injected
+# fakes; deployments can register gs://, s3://, … backends).
+_SCHEMES: dict = {}
+
+_URL_RE = re.compile(r"^([a-z][a-z0-9+.-]*)://")
+
+
+def register_scheme(scheme: str, factory) -> None:
+    """Register ``factory(url) -> ByteChannel`` for ``scheme://`` paths."""
+    _SCHEMES[scheme] = factory
+
+
 def open_channel(path, cached: bool = False) -> ByteChannel:
-    """Open a channel for a path (local mmap today; the pluggable IO seam)."""
-    ch: ByteChannel = MMapChannel(path)
+    """Open a channel for a path — the single pluggable IO seam.
+
+    Local paths are mmap-backed. ``http(s)://`` URLs get an
+    ``HttpRangeChannel`` wrapped in a ``PrefetchChannel`` (read-ahead hides
+    the round-trips; SURVEY.md §7 hard-part 5). Other ``scheme://`` URLs
+    dispatch through ``register_scheme``.
+    """
+    s = str(path)
+    m = _URL_RE.match(s)
+    if m:
+        scheme = m.group(1)
+        if scheme in _SCHEMES:  # registrations override built-ins
+            ch: ByteChannel = _SCHEMES[scheme](s)
+        elif scheme in ("http", "https"):
+            from spark_bam_tpu.core.prefetch import PrefetchChannel
+            from spark_bam_tpu.core.remote import HttpRangeChannel
+
+            ch = PrefetchChannel(
+                HttpRangeChannel(s), chunk_size=1 << 20, depth=4, workers=8
+            )
+        else:
+            raise ValueError(f"no channel backend for scheme {scheme!r}: {s}")
+        return CachingChannel(ch) if cached else ch
+    ch = MMapChannel(path)
     return CachingChannel(ch) if cached else ch

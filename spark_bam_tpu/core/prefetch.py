@@ -14,6 +14,8 @@ supplies reuse.
 
 from __future__ import annotations
 
+import threading
+from collections import OrderedDict
 from concurrent.futures import Future, ThreadPoolExecutor
 
 from spark_bam_tpu.core.channel import ByteChannel
@@ -26,21 +28,32 @@ class PrefetchChannel(ByteChannel):
         chunk_size: int = 1 << 20,
         depth: int = 4,
         workers: int = 4,
+        max_chunks: int | None = None,
     ):
         super().__init__()
         self.inner = inner
         self.chunk_size = chunk_size
         self.depth = depth
+        # Retention is LRU over a bounded chunk set (not cursor-relative):
+        # multiple readers at different offsets (InflatePipeline keeps two
+        # windows in flight) must not evict each other's chunks mid-read.
+        self.max_chunks = max_chunks or max(4 * (depth + 1), 16)
         self._pool = ThreadPoolExecutor(max_workers=workers)
-        self._inflight: dict[int, Future] = {}
+        self._inflight: OrderedDict[int, Future] = OrderedDict()
+        self._lock = threading.Lock()
 
     def _fetch(self, idx: int) -> Future:
-        fut = self._inflight.get(idx)
-        if fut is None:
-            fut = self._pool.submit(
-                self.inner._read_at, idx * self.chunk_size, self.chunk_size
-            )
-            self._inflight[idx] = fut
+        # read_at callers fan out across threads (block inflater, bench
+        # pipelines); the in-flight map is the only shared state.
+        with self._lock:
+            fut = self._inflight.get(idx)
+            if fut is not None:
+                self._inflight.move_to_end(idx)
+            else:
+                fut = self._pool.submit(
+                    self.inner._read_at, idx * self.chunk_size, self.chunk_size
+                )
+                self._inflight[idx] = fut
         return fut
 
     def _read_at(self, pos: int, n: int) -> bytes:
@@ -63,10 +76,10 @@ class PrefetchChannel(ByteChannel):
             remaining -= len(piece)
             if remaining <= 0:
                 break
-        # Retire chunks far behind the cursor to bound memory.
-        horizon = first - 2
-        for idx in [i for i in self._inflight if i < horizon]:
-            self._inflight.pop(idx)
+        # Retire least-recently-used chunks to bound memory.
+        with self._lock:
+            while len(self._inflight) > self.max_chunks:
+                self._inflight.popitem(last=False)
         return b"".join(out)
 
     @property

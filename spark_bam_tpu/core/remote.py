@@ -1,0 +1,135 @@
+"""Remote byte channels: HTTP(S) range-GET.
+
+GCS latency is the reference's founding problem — every headline number is
+measured on GCS (reference docs/benchmarks.md:53-59), and SURVEY.md §7
+hard-part 5 names remote IO a first-class concern. ``HttpRangeChannel``
+is the remote ``ByteChannel``: one ranged GET per ``_read_at``, keep-alive
+connections per thread (the inflate/prefetch layers fan ``read_at`` out
+across threads), auth injectable via ``headers`` (e.g. a
+``Authorization: Bearer …`` token for GCS's JSON/XML APIs — the transport
+below is exactly what gcsfs/s3fs speak).
+
+Latency hiding is composed, not built in: ``open_channel`` wraps remote
+channels in ``PrefetchChannel`` (aligned read-ahead pipeline,
+core/prefetch.py) so sequential scans overlap round-trips, and the block
+inflater's ``read_at`` fan-out overlaps random ones. See
+tests/test_remote.py for the injected-latency proof.
+"""
+
+from __future__ import annotations
+
+import http.client
+import threading
+import urllib.parse
+
+from spark_bam_tpu.core.channel import ByteChannel
+
+
+class HttpRangeChannel(ByteChannel):
+    """Seekable reads over HTTP/1.1 ``Range: bytes=…`` requests.
+
+    Thread-safe: each thread gets its own keep-alive connection, so
+    concurrent ``read_at`` calls (prefetch depth, inflate fan-out) become
+    concurrent in-flight GETs.
+    """
+
+    def __init__(self, url: str, headers: dict | None = None,
+                 timeout: float = 30.0):
+        super().__init__()
+        self.url = url
+        u = urllib.parse.urlsplit(url)
+        if u.scheme not in ("http", "https"):
+            raise ValueError(f"not an http(s) url: {url}")
+        self._secure = u.scheme == "https"
+        self._host = u.hostname or ""
+        self._port = u.port
+        self._path = u.path or "/"
+        if u.query:
+            self._path += "?" + u.query
+        self._headers = dict(headers or {})
+        self._timeout = timeout
+        self._local = threading.local()
+        self._conns: list[http.client.HTTPConnection] = []
+        self._conns_lock = threading.Lock()
+        self._size: int | None = None
+        self._size_lock = threading.Lock()
+        self._closed = False
+
+    # ----------------------------------------------------------- transport
+    def _conn(self) -> http.client.HTTPConnection:
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            cls = (
+                http.client.HTTPSConnection if self._secure
+                else http.client.HTTPConnection
+            )
+            conn = cls(self._host, self._port, timeout=self._timeout)
+            self._local.conn = conn
+            with self._conns_lock:
+                self._conns.append(conn)
+        return conn
+
+    def _request(self, method: str, extra_headers: dict):
+        """One request with a single retry on a stale keep-alive socket."""
+        for attempt in (0, 1):
+            conn = self._conn()
+            try:
+                conn.request(
+                    method, self._path, headers={**self._headers, **extra_headers}
+                )
+                return conn.getresponse()
+            except (http.client.HTTPException, ConnectionError, OSError):
+                conn.close()
+                self._local.conn = None
+                if attempt:
+                    raise
+
+    def _read_at(self, pos: int, n: int) -> bytes:
+        if n <= 0 or self._closed:
+            return b""
+        resp = self._request(
+            "GET", {"Range": f"bytes={pos}-{pos + n - 1}"}
+        )
+        body = resp.read()
+        if resp.status == 206:
+            self._learn_size(resp.headers.get("Content-Range"))
+            return body
+        if resp.status == 200:
+            # Server ignored the Range header; slice the full body.
+            self._size = len(body)
+            return body[pos: pos + n]
+        if resp.status == 416:  # requested range past EOF
+            self._learn_size(resp.headers.get("Content-Range"))
+            return b""
+        raise IOError(f"GET {self.url} range {pos}+{n}: HTTP {resp.status}")
+
+    def _learn_size(self, content_range: str | None):
+        # "bytes 0-99/12345" or "bytes */12345"
+        if content_range and "/" in content_range:
+            total = content_range.rsplit("/", 1)[1]
+            if total.isdigit():
+                self._size = int(total)
+
+    @property
+    def size(self) -> int:
+        with self._size_lock:
+            if self._size is None:
+                resp = self._request("HEAD", {})
+                resp.read()
+                length = resp.headers.get("Content-Length")
+                if resp.status != 200 or length is None:
+                    raise IOError(
+                        f"HEAD {self.url}: HTTP {resp.status}, no length"
+                    )
+                self._size = int(length)
+        return self._size
+
+    def close(self) -> None:
+        self._closed = True
+        with self._conns_lock:
+            for conn in self._conns:
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+            self._conns.clear()
