@@ -149,7 +149,7 @@ def inflate_blocks(
 ) -> FlatView:
     """Inflate a run of blocks into one flat buffer.
 
-    Prefers the native table-driven decoder (~2x zlib, single call for the
+    Prefers the native table-driven decoder (~1.3-2x zlib, single call for the
     whole run); falls back to parallel host zlib when the native library is
     unavailable.
     """

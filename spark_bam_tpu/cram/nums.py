@@ -20,9 +20,19 @@ class Cursor:
         self.pos = pos
 
     def u8(self) -> int:
-        v = self.buf[self.pos]
+        try:
+            v = self.buf[self.pos]
+        except IndexError:
+            raise EOFError(f"truncated stream at byte {self.pos}") from None
         self.pos += 1
         return v
+
+    def peek_u8(self) -> int:
+        """Next byte without advancing; clean EOFError when truncated."""
+        try:
+            return self.buf[self.pos]
+        except IndexError:
+            raise EOFError(f"truncated stream at byte {self.pos}") from None
 
     def read(self, n: int) -> bytes:
         v = bytes(self.buf[self.pos: self.pos + n])

@@ -91,7 +91,7 @@ def _read_freqs(cur: Cursor) -> list[int]:
         if rle:
             rle -= 1
             sym += 1
-        elif sym + 1 == cur.buf[cur.pos]:
+        elif sym + 1 == cur.peek_u8():
             sym = cur.u8()
             rle = cur.u8()
         else:
@@ -252,7 +252,7 @@ def _decode_o1(cur: Cursor, out_sz: int) -> bytes:
         if rle:
             rle -= 1
             ctx += 1
-        elif ctx + 1 == cur.buf[cur.pos]:
+        elif ctx + 1 == cur.peek_u8():
             ctx = cur.u8()
             rle = cur.u8()
         else:

@@ -287,7 +287,7 @@ class CramReader:
             out.append(rec)
             links.append(nf)
 
-        self._resolve_mates(out, links)
+        self._resolve_mates(out, links, ch.read_names_included)
         return out
 
     def _decode_mapped(
@@ -434,7 +434,11 @@ class CramReader:
         )
 
     @staticmethod
-    def _resolve_mates(out: list[BamRecord], links: list[int | None]) -> None:
+    def _resolve_mates(
+        out: list[BamRecord],
+        links: list[int | None],
+        names_included: bool,
+    ) -> None:
         for i, nf in enumerate(links):
             if nf is None:
                 continue
@@ -442,6 +446,10 @@ class CramReader:
             if j >= len(out):
                 continue
             a, b = out[i], out[j]
+            if not names_included:
+                # Synthesized QNAMEs: NF-linked mates are one template and
+                # must share one name (htsjdk generates one name per pair).
+                b.read_name = a.read_name
             a.next_ref_id, a.next_pos = b.ref_id, b.pos
             b.next_ref_id, b.next_pos = a.ref_id, a.pos
             if b.flag & 0x10:

@@ -188,7 +188,11 @@ class _ContainerBuilder:
             tags=tag_enc,
         )
 
-    def serialize(self, record_counter: int, method: int) -> bytes:
+    def serialize(
+        self, record_counter: int, method: int
+    ) -> tuple[bytes, int, int]:
+        """Returns (container bytes, slice offset, slice size) — the offsets
+        feed the .crai index entries."""
         ch_block = Block(
             COMPRESSION_HEADER, 0, self.compression_header().serialize()
         ).serialize(GZIP if method != RAW else RAW)

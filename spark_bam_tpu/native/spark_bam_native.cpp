@@ -564,9 +564,10 @@ long sbt_tokenize_deflate(
 // word-wise LZ77 copies under an 8-byte-slack contract against the whole
 // output allocation. The host-inflate wall is THE end-to-end bottleneck on
 // small hosts (the reference's hot loop is the JVM zlib binding,
-// bgzf/.../block/Stream.scala:49-54); this decoder is ~2x zlib here. Any
-// block it rejects falls back to zlib (sbt_inflate_blocks) for identical
-// results — it never guesses.
+// bgzf/.../block/Stream.scala:49-54); this decoder measures ~1.3-2x zlib
+// depending on host/data (see bench history). Any block it rejects falls
+// back to zlib (sbt_inflate_blocks) for identical results — it never
+// guesses.
 
 namespace fastinf {
 
