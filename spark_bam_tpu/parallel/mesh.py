@@ -118,6 +118,24 @@ def shard_windows(
     return jax.device_put(windows, sharding)
 
 
+def _shard_map_compat():
+    """jax.shard_map across the 0.6/0.7 API rename (check_rep → check_vma)."""
+    try:
+        from jax import shard_map as _shard_map
+
+        def shard_map(f, *, mesh, in_specs, out_specs, check_rep):
+            return _shard_map(
+                f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                check_vma=check_rep,
+            )
+
+        return shard_map
+    except ImportError:  # jax < 0.7
+        from jax.experimental.shard_map import shard_map
+
+        return shard_map
+
+
 def make_shard_map_check_step(mesh: Mesh, reads_to_check: int = 10, axis: str = "data"):
     """Explicit-collective variant of the sharded step.
 
@@ -127,16 +145,7 @@ def make_shard_map_check_step(mesh: Mesh, reads_to_check: int = 10, axis: str = 
     over the mesh axis — the XLA collective riding ICI. Semantically
     identical; kept as the explicit form the multi-host deployment uses.
     """
-    try:
-        from jax import shard_map as _shard_map
-
-        def shard_map(f, *, mesh, in_specs, out_specs, check_rep):
-            return _shard_map(
-                f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                check_vma=check_rep,
-            )
-    except ImportError:  # jax < 0.7
-        from jax.experimental.shard_map import shard_map
+    shard_map = _shard_map_compat()
 
     def local_step(windows, ns, at_eofs, truth, lengths, num_contigs):
         def one(window, n, at_eof, tr):
@@ -169,6 +178,42 @@ def make_shard_map_check_step(mesh: Mesh, reads_to_check: int = 10, axis: str = 
             # The kernel's scan carries start from unvarying constants; skip
             # the replication check rather than thread pvary through shared
             # kernel code.
+            check_rep=False,
+        )
+    )
+
+
+def make_shard_map_count_step(mesh: Mesh, reads_to_check: int = 10, axis: str = "data"):
+    """Sharded count-reads step: each device checks its window rows and the
+    (boundary count, owned escapes) pair all-reduces with ``lax.psum`` —
+    the count-reads workload (reference docs/benchmarks.md:53-59) as one
+    mesh-partitioned unit. Rows carry per-row owned spans [lo, own) so
+    halo bytes and the BAM header are counted exactly once globally."""
+    shard_map = _shard_map_compat()
+
+    def local_step(windows, ns, at_eofs, los, owns, lengths, num_contigs):
+        def one(window, n, at_eof, lo, own):
+            res = check_window(
+                window, lengths, num_contigs, n, at_eof,
+                reads_to_check=reads_to_check,
+            )
+            w = window.shape[0] - PAD
+            i = jnp.arange(w, dtype=jnp.int32)
+            m = (i >= lo) & (i < own)
+            return jnp.stack([
+                jnp.sum((res["verdict"] & m).astype(jnp.int32)),
+                jnp.sum((res["escaped"] & m).astype(jnp.int32)),
+            ])
+
+        stats = jax.vmap(one)(windows, ns, at_eofs, los, owns)
+        return jax.lax.psum(jnp.sum(stats, axis=0), axis)  # ← ICI all-reduce
+
+    return jax.jit(
+        shard_map(
+            local_step,
+            mesh=mesh,
+            in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis), P(), P()),
+            out_specs=P(),
             check_rep=False,
         )
     )

@@ -164,6 +164,133 @@ def run_worker(
     return stats
 
 
+def run_worker_bam(
+    path: str,
+    coordinator: str | None,
+    num_processes: int,
+    process_id: int,
+    local_devices: int = 0,
+    row_bytes: int = 8 << 20,
+    halo: int = 4 << 20,
+) -> dict:
+    """Real-data multi-host count-reads: each process inflates only its own
+    block-range shard of ``path`` (seam halos stitched host-side from the
+    following blocks — SURVEY.md §2.9's halo-exchange plan), checks its rows
+    on its local devices, and the global count reduces with ``psum``.
+
+    The division of labor mirrors the reference's executor-per-split layout
+    (load/.../SplitRDD.scala:43-79): block ranges are the shards, no
+    cross-host byte motion beyond the halo overlap each host reads itself.
+    """
+    if local_devices:
+        from spark_bam_tpu.core.platform import force_cpu_devices
+
+        force_cpu_devices(local_devices, defer_init=num_processes > 1)
+    import jax
+
+    if num_processes > 1:
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from spark_bam_tpu.bam.header import read_header
+    from spark_bam_tpu.bgzf.flat import inflate_blocks
+    from spark_bam_tpu.bgzf.index_blocks import blocks_metadata
+    from spark_bam_tpu.core.channel import open_channel
+    from spark_bam_tpu.parallel.mesh import make_mesh, make_shard_map_count_step
+    from spark_bam_tpu.tpu.checker import PAD
+    from spark_bam_tpu.tpu.inflate import window_plan
+
+    header = read_header(path)
+    header_end = header.uncompressed_size
+    lens_list = header.contig_lengths.lengths_list()
+    # GRCh38+alt/decoy references exceed 1024 contigs; size to the input.
+    lengths = np.zeros(max(1024, len(lens_list)), dtype=np.int32)
+    lengths[: len(lens_list)] = lens_list
+
+    metas = list(blocks_metadata(path))
+    groups = window_plan(metas, row_bytes)
+    # Row r owns its group's uncompressed span; flat start offsets:
+    sizes = [sum(m.uncompressed_size for m in g) for g in groups]
+    flat_starts = np.concatenate([[0], np.cumsum(sizes)[:-1]]).astype(np.int64)
+
+    devices = jax.devices()
+    n_global = len(devices)
+    n_local = jax.local_device_count()
+    mesh = make_mesh(devices)
+
+    # Pad the global row count to a multiple of the device count; empty
+    # rows check nothing (n=0, own=0).
+    n_rows = -(-len(groups) // n_global) * n_global
+    per_proc = n_rows // num_processes
+    # A row holds ≤ row_bytes of owned data plus a halo that overshoots by
+    # at most one BGZF block (≤64 KiB); size the kernel window to cover it.
+    from spark_bam_tpu.bgzf.block import MAX_BLOCK_SIZE
+
+    w = 1 << max(16, (row_bytes + halo + MAX_BLOCK_SIZE - 1).bit_length())
+
+    # Groups partition ``metas`` consecutively; first block index per group:
+    first_block_of_group = np.concatenate(
+        [[0], np.cumsum([len(g) for g in groups])[:-1]]
+    ).astype(np.int64)
+
+    my_rows = range(process_id * per_proc, (process_id + 1) * per_proc)
+    windows = np.zeros((per_proc, w + PAD), dtype=np.uint8)
+    ns = np.zeros(per_proc, dtype=np.int32)
+    eofs = np.zeros(per_proc, dtype=bool)
+    los = np.zeros(per_proc, dtype=np.int32)
+    owns = np.zeros(per_proc, dtype=np.int32)
+    with open_channel(path) as ch:
+        for j, g in enumerate(my_rows):
+            if g >= len(groups):
+                continue  # padding row
+            b0 = int(first_block_of_group[g])
+            # Extend with following blocks until the halo is covered.
+            b1 = b0 + len(groups[g])
+            extra = 0
+            while b1 < len(metas) and extra < halo:
+                extra += metas[b1].uncompressed_size
+                b1 += 1
+            view = inflate_blocks(ch, metas[b0:b1])
+            n = view.size
+            windows[j, :n] = view.data
+            ns[j] = n
+            eofs[j] = b1 == len(metas)  # buffer end == file end
+            own = n if b1 == len(metas) and g == len(groups) - 1 else sizes[g]
+            owns[j] = own
+            los[j] = min(max(header_end - int(flat_starts[g]), 0), own)
+
+    shard = NamedSharding(mesh, P("data"))
+    repl = NamedSharding(mesh, P())
+    args = [
+        jax.make_array_from_process_local_data(shard, a)
+        for a in (windows, ns, eofs, los, owns)
+    ]
+    lengths_d = jax.device_put(lengths, repl)
+
+    step = make_shard_map_count_step(mesh)
+    totals = np.asarray(
+        step(*args, lengths_d, jnp.int32(len(lens_list)))
+    )
+    return {
+        "mode": "bam",
+        "path": str(path),
+        "processes": num_processes,
+        "process_id": process_id,
+        "global_devices": n_global,
+        "local_devices": n_local,
+        "rows": len(groups),
+        "count": int(totals[0]),
+        "escaped": int(totals[1]),
+        "ok": int(totals[1]) == 0,
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--coordinator", default=None)
@@ -173,10 +300,25 @@ def main(argv=None) -> int:
         "--local-devices", type=int, default=0,
         help="force N virtual CPU devices (rehearsal mode); 0 = real devices",
     )
-    a = ap.parse_args(argv)
-    stats = run_worker(
-        a.coordinator, a.num_processes, a.process_id, a.local_devices
+    ap.add_argument(
+        "--bam", default=None,
+        help="real-data mode: shard this BAM by block ranges and count reads",
     )
+    ap.add_argument("--row-bytes", type=int, default=8 << 20,
+                    help="uncompressed bytes owned per row (--bam mode)")
+    ap.add_argument("--halo", type=int, default=4 << 20,
+                    help="lookahead bytes per row; must exceed one "
+                         "reads-to-check chain's span (--bam mode)")
+    a = ap.parse_args(argv)
+    if a.bam:
+        stats = run_worker_bam(
+            a.bam, a.coordinator, a.num_processes, a.process_id,
+            a.local_devices, row_bytes=a.row_bytes, halo=a.halo,
+        )
+    else:
+        stats = run_worker(
+            a.coordinator, a.num_processes, a.process_id, a.local_devices
+        )
     if stats["process_id"] == 0:
         print(json.dumps(stats))
     return 0 if stats["ok"] else 1
