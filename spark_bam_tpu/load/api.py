@@ -122,6 +122,29 @@ def load_splits_and_reads(
     return splits, load_bam(path, split_size, config, parallel)
 
 
+def _scan_sam_header(path):
+    """One pass over a SAM text header → the @SQ contig dictionary
+    (the single parse shared by load_sam and the interval degrade path)."""
+    from spark_bam_tpu.bam.header import ContigLengths
+
+    entries: dict[int, tuple[str, int]] = {}
+    with open(path, "rt") as f:
+        for line in f:
+            if not line.startswith("@"):
+                break
+            if line.startswith("@SQ"):
+                fields = dict(
+                    kv.split(":", 1)
+                    for kv in line.rstrip("\n").split("\t")[1:]
+                    if ":" in kv
+                )
+                if "SN" in fields:
+                    entries[len(entries)] = (
+                        fields["SN"], int(fields.get("LN", "0"))
+                    )
+    return ContigLengths(entries)
+
+
 def load_sam(
     path,
     split_size=None,
@@ -134,19 +157,8 @@ def load_sam(
         if split_size
         else config.split_size_or(Config.LOAD_SPLIT_SIZE_DEFAULT)
     )
-    contigs_by_name: dict[str, int] = {}
-    n_header = 0
-    with open(path, "rt") as f:
-        for line in f:
-            if not line.startswith("@"):
-                break
-            n_header += 1
-            if line.startswith("@SQ"):
-                fields = dict(
-                    kv.split(":", 1) for kv in line.rstrip("\n").split("\t")[1:] if ":" in kv
-                )
-                if "SN" in fields:
-                    contigs_by_name[fields["SN"]] = len(contigs_by_name)
+    contigs = _scan_sam_header(path)
+    contigs_by_name = {name: idx for idx, (name, _) in contigs.items()}
     file_size = os.path.getsize(path)
     ranges = [(s, min(s + size, file_size)) for s in range(0, file_size, size)]
 
@@ -364,6 +376,35 @@ def pack_chunks(
     return groups
 
 
+def _load_sam_intervals(
+    path,
+    loci: LociSet | str,
+    split_size,
+    config: Config,
+    parallel: ParallelConfig,
+) -> Dataset:
+    """SAM degrade path for interval loads: SAM text has no index, so the
+    whole file is scanned and the interval-overlap filter alone narrows the
+    result (reference CanLoadBam.scala:59-76 — SAM paths degrade to a
+    full-scan filter inside loadBamIntervals)."""
+    contigs = _scan_sam_header(path)
+    if isinstance(loci, str):
+        loci = LociSet.parse(loci, contigs)
+
+    def overlaps(rec: BamRecord) -> bool:
+        if rec.ref_id < 0 or rec.is_unmapped:
+            return False
+        return loci.overlaps(contigs.name(rec.ref_id), rec.pos, rec.end_pos())
+
+    ds = load_sam(path, split_size, config, parallel)
+    compute = ds.compute
+    return Dataset(
+        ds.partitions,
+        lambda p: (rec for rec in compute(p) if overlaps(rec)),
+        parallel,
+    )
+
+
 def load_bam_intervals(
     path,
     loci: LociSet | str,
@@ -371,7 +412,12 @@ def load_bam_intervals(
     config: Config = Config(),
     parallel: ParallelConfig = ParallelConfig(),
 ) -> Dataset:
-    """Indexed random access: only records overlapping ``loci`` (ref :59-138)."""
+    """Indexed random access: only records overlapping ``loci`` (ref :59-138).
+
+    SAM paths degrade to a full scan + overlap filter, mirroring the
+    reference's behavior for unindexed text input."""
+    if str(path).endswith(".sam"):
+        return _load_sam_intervals(path, loci, split_size, config, parallel)
     header = read_header(path)
     if isinstance(loci, str):
         loci = LociSet.parse(loci, header.contig_lengths)

@@ -95,3 +95,19 @@ def test_load_bam_intervals_disjoint(bam2):
     ds2 = load_bam_intervals(bam2, loci, split_size=10_000)
     assert ds2.num_partitions == 2
     assert ds2.count() == 129
+
+
+def test_load_bam_intervals_sam_degrade(bam2, sam2):
+    """SAM input degrades to full-scan + overlap filter and must return the
+    same reads as the indexed BAM path (reference CanLoadBam.scala:59-76)."""
+    loci = "1:13000-17000,1:25000-30000"
+    bam_names = sorted(r.read_name for r in load_bam_intervals(bam2, loci).collect())
+    sam_names = sorted(r.read_name for r in load_bam_intervals(sam2, loci).collect())
+    assert bam_names and sam_names == bam_names
+
+    # Split-size invariance on the SAM scan path.
+    small = sorted(
+        r.read_name
+        for r in load_bam_intervals(sam2, loci, split_size=10_000).collect()
+    )
+    assert small == sam_names
