@@ -165,6 +165,72 @@ def _child_device_all(window_mb: int, platform: str, iters: int,
     except Exception as e:
         _emit_stage("cli_error:" + f"{type(e).__name__}: {e}"[:200])
 
+    # ---- Pallas on-TPU probe (last: compile risk must not cost the
+    # artifacts above; VERDICT r3 item 4's on-TPU timing) ------------------
+    if backend == "tpu":
+        try:
+            _run_pallas_probe(min(window_mb, 8), backend)
+        except Exception as e:
+            _emit_stage(
+                "pallas_error:" + f"{type(e).__name__}: {e}"[:300].replace("\n", " ")
+            )
+
+
+def _run_pallas_probe(window_mb: int, backend: str):
+    """Compile + time the full Pallas flag kernel on the real chip, vs the
+    XLA flag pass on the same window."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_bam_tpu.bam.header import contig_lengths
+    from spark_bam_tpu.bgzf.flat import flatten_file
+    from spark_bam_tpu.tpu import checker as tc
+    from spark_bam_tpu.tpu.pallas_kernels import full_check_flags
+
+    flat = flatten_file(FIXTURE)
+    lens_list = contig_lengths(FIXTURE).lengths_list()
+    lengths = np.zeros(1024, dtype=np.int32)
+    lengths[: len(lens_list)] = lens_list
+    w = window_mb << 20
+    reps = max(1, w // flat.size)
+    buf = np.concatenate([flat.data] * reps)[:w]
+    padded = np.zeros(w + tc.PAD, dtype=np.uint8)
+    padded[: len(buf)] = buf
+
+    pd = jax.device_put(jnp.asarray(padded))
+    ld = jax.device_put(jnp.asarray(lengths))
+    nc1 = jnp.asarray(np.array([len(lens_list)], dtype=np.int32))
+    n1 = jnp.asarray(np.array([w], dtype=np.int32))
+
+    _emit_stage("pallas_compile")
+    t0 = time.perf_counter()
+    out = full_check_flags(pd, ld, nc1, n1, interpret=False)
+    out.block_until_ready()
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(5):
+        out = full_check_flags(pd, ld, nc1, n1, interpret=False)
+    out.block_until_ready()
+    pallas_pps = 5 * w / (time.perf_counter() - t0)
+
+    xla_flags = jax.jit(tc._compute_flags)
+    xla_flags(pd, ld, jnp.int32(len(lens_list)), jnp.int32(w)).block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(5):
+        out2 = xla_flags(pd, ld, jnp.int32(len(lens_list)), jnp.int32(w))
+    out2.block_until_ready()
+    xla_pps = 5 * w / (time.perf_counter() - t0)
+
+    _emit_result("pallas", {
+        "compiled_on_tpu": True,
+        "compile_s": round(compile_s, 1),
+        "pallas_flags_pps": round(pallas_pps),
+        "xla_flags_pps": round(xla_pps),
+        "window_mb": window_mb,
+        "backend": backend,
+    })
+    _emit_stage("pallas_done")
+
 
 def _run_e2e_leg(window_mb: int, big_path: str, reads: int, backend: str):
     from spark_bam_tpu.core.config import Config
@@ -476,6 +542,15 @@ def _main_measure(record, warnings, errors):
     cli = results.get("cli_smoke")
     if cli is not None:
         record["cli_smoke_ok"] = cli["ok"]
+    pallas = results.get("pallas")
+    if pallas is not None:
+        record["pallas_compiled_on_tpu"] = pallas["compiled_on_tpu"]
+        record["pallas_flags_pps"] = pallas["pallas_flags_pps"]
+        record["pallas_vs_xla_flags"] = (
+            round(pallas["pallas_flags_pps"] / pallas["xla_flags_pps"], 3)
+            if pallas.get("xla_flags_pps")
+            else None
+        )
 
 
 if __name__ == "__main__":

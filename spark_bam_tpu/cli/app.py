@@ -134,6 +134,9 @@ class CheckerContext:
                 window=window,
                 halo=min(self.config.halo_size, window // 4),
                 reads_to_check=self.config.reads_to_check,
+                flags_impl=(
+                    "pallas" if self.config.backend == "pallas" else "xla"
+                ),
             )
             res = checker.check_buffer(self.view.data, at_eof=True)
             return ChainResult(
@@ -154,7 +157,7 @@ class CheckerContext:
     def _use_tpu_backend(self) -> bool:
         if self.config.backend == "numpy":
             return False
-        if self.config.backend == "tpu":
+        if self.config.backend in ("tpu", "pallas"):
             return True
         if self.config.backend == "auto":
             # Device pays off once the input outweighs kernel compile+launch;

@@ -68,7 +68,7 @@ class Config:
     estimated_compression_ratio: float = 3.0
     # --- backend selection: the Checker plugin surface ---
     checker: str = "eager"              # eager | full | indexed | seqdoop
-    backend: str = "auto"               # auto | tpu | numpy | python | native
+    backend: str = "auto"               # auto | tpu | pallas | numpy | python | native
     # --- TPU execution shape ---
     # Uncompressed bytes checked per device window. The streaming path
     # rounds (window + carry) up to a power of two for the kernel shape, so

@@ -68,7 +68,9 @@ def _ref_pos_bits(idx, pos, c, len_at, b_neg_idx, b_large_idx, b_neg_pos, b_larg
 
 
 def _compute_flags(p, lengths, num_contigs, n):
-    """Flag pass over a (W+PAD,)-byte padded buffer; returns F, remaining, body_end."""
+    """Flag pass over a (W+PAD,)-byte padded buffer; returns F (the 19-bit
+    mask per position). ``remaining``/``body_end`` live in ``_compute_misc``
+    — shared with the Pallas flag path; XLA CSEs the overlapping slices."""
     w = p.shape[0] - PAD
     u = _i32_at(p, w)
     i32 = lax.bitcast_convert_type(u, jnp.int32)
@@ -153,20 +155,38 @@ def _compute_flags(p, lengths, num_contigs, n):
 
     few_fixed = idx > n - 36
     F = jnp.where(few_fixed, _I32(BIT["tooFewFixedBlockBytes"]), F)
+    return F
 
+
+def _compute_misc(p, n):
+    """remaining + body_end only (the non-flag outputs of the flag pass) —
+    what the chain walk still needs when the Pallas kernel supplies F."""
+    w = p.shape[0] - PAD
+    u = _i32_at(p, w)
+    i32 = lax.bitcast_convert_type(u, jnp.int32)
+    remaining = i32[0:w]
+    name_len = p[12: w + 12].astype(_I32)
+    n_cigar = (u[16: w + 16] & 0xFFFF).astype(_I32)
+    idx = jnp.arange(w, dtype=_I32)
+    has_name = name_len >= 2
+    name_eof = has_name & (idx + 36 + name_len > n)
+    name_in = has_name & (~name_eof)
+    cig_start = idx + 36 + jnp.where(name_in, name_len, _I32(0))
+    few_fixed = idx > n - 36
     body_end = jnp.where(
         few_fixed,
         idx + 36,
-        cig_start + jnp.where(cig_considered, _I32(4) * n_cigar, _I32(0)),
+        cig_start + jnp.where(~name_eof, _I32(4) * n_cigar, _I32(0)),
     )
-    return F, remaining, body_end
+    return remaining, body_end
 
 
 # Sentinel bounds for the logical cursor: anything outside [0, n] behaves
 # identically (it can never equal the physical cursor at EOF), so clamping is
 # exact unless the cursor needs to *re-enter* range — tracked per lane.
 @functools.partial(
-    jax.jit, static_argnames=("reads_to_check", "window")
+    jax.jit,
+    static_argnames=("reads_to_check", "window", "flags_impl", "pallas_interpret"),
 )
 def check_window(
     padded: jnp.ndarray,       # (W+PAD,) uint8; zeros beyond n
@@ -176,6 +196,8 @@ def check_window(
     at_eof: jnp.ndarray,       # () bool: buffer end == file end
     reads_to_check: int = 10,
     window: int | None = None,
+    flags_impl: str = "xla",   # "xla" | "pallas" (spark.bam.backend=pallas)
+    pallas_interpret: bool = False,
 ):
     """Flag pass + chain walk over one window; verdicts for every offset.
 
@@ -190,7 +212,16 @@ def check_window(
     reads_before, exact, escaped.
     """
     w = padded.shape[0] - PAD
-    F, remaining, body_end = _compute_flags(padded, lengths, num_contigs, n)
+    if flags_impl == "pallas":
+        from spark_bam_tpu.tpu.pallas_kernels import full_check_flags
+
+        F = full_check_flags(
+            padded, lengths, num_contigs.reshape(1), n.reshape(1),
+            interpret=pallas_interpret,
+        )
+    else:
+        F = _compute_flags(padded, lengths, num_contigs, n)
+    remaining, body_end = _compute_misc(padded, n)
 
     in_range = jnp.arange(w, dtype=_I32) < n
     definitive0 = F & DEFINITIVE_MASK
@@ -319,13 +350,23 @@ def check_window(
     }
 
 
-def make_check_window(window: int, reads_to_check: int = 10):
-    """A jit-compiled window kernel for fixed ``window`` size."""
+def make_check_window(
+    window: int, reads_to_check: int = 10, flags_impl: str = "xla"
+):
+    """A jit-compiled window kernel for fixed ``window`` size.
+
+    ``flags_impl="pallas"`` swaps the flag pass for the Pallas full kernel
+    (tpu/pallas_kernels.py); on non-TPU backends it runs in interpret mode.
+    """
+    pallas_interpret = False
+    if flags_impl == "pallas":
+        pallas_interpret = jax.default_backend() != "tpu"
 
     def run(padded, lengths, num_contigs, n, at_eof):
         return check_window(
             padded, lengths, num_contigs, n, at_eof,
             reads_to_check=reads_to_check, window=window,
+            flags_impl=flags_impl, pallas_interpret=pallas_interpret,
         )
 
     return run
@@ -356,6 +397,7 @@ class TpuChecker:
         halo: int = 4 << 20,
         reads_to_check: int = 10,
         cmax: int = 1024,
+        flags_impl: str = "xla",
     ):
         self.window = window
         self.halo = halo
@@ -364,7 +406,7 @@ class TpuChecker:
         cmax = max(cmax, len(contig_lengths))
         self.lengths = np.zeros(cmax, dtype=np.int32)
         self.lengths[: len(contig_lengths)] = contig_lengths
-        self._kernel = make_check_window(window, reads_to_check)
+        self._kernel = make_check_window(window, reads_to_check, flags_impl)
 
     def check_buffer(self, buf: np.ndarray, at_eof: bool = True) -> WindowResult:
         """Check every position of ``buf``; exact everywhere except possibly
