@@ -107,3 +107,73 @@ def plan_blocks(
         partitions=partitions,
         bounds=[(i * split_size, (i + 1) * split_size) for i in split_idxs],
     )
+
+
+def align_indexed_records(
+    blocks: Blocks, records_path, strict: bool = True
+) -> "list[np.ndarray]":
+    """Partition-align the ``.records`` ground truth with a block plan.
+
+    The reference pairs its blocks RDD with the sorted record-position RDD
+    partition-by-partition so each task scores its own blocks against its
+    own slice of the truth (IndexedRecordPositions.scala:57-117 ``toSets`` +
+    BlocksAndIndexedRecords.scala:134-180). Here the sidecar positions
+    bucket by their block's partition with one global sort; the returned
+    list matches ``blocks.partitions`` index-for-index, each entry a sorted
+    ``(n, 2)`` int64 array of (block_pos, offset) rows.
+
+    ``strict`` (default): a truth position whose block is absent from the
+    plan raises — a stale sidecar or planner hole must not silently shrink
+    the ground truth. Pass ``strict=False`` when the plan was legitimately
+    filtered with ``ranges``.
+    """
+    import numpy as np
+
+    from spark_bam_tpu.bam.index_records import read_records_index
+
+    pos = np.array(
+        [(p.block_pos, p.offset) for p in read_records_index(records_path)],
+        dtype=np.int64,
+    ).reshape(-1, 2)
+
+    starts = []
+    part_of_block = []
+    for i, part in enumerate(blocks.partitions):
+        for m in part:
+            starts.append(m.start)
+            part_of_block.append(i)
+    starts = np.array(starts, dtype=np.int64)
+    part_of_block = np.array(part_of_block, dtype=np.int64)
+    order = np.argsort(starts)
+    starts, part_of_block = starts[order], part_of_block[order]
+
+    n_parts = len(blocks.partitions)
+    out: list[np.ndarray] = [
+        np.empty((0, 2), dtype=np.int64) for _ in range(n_parts)
+    ]
+    if not len(pos) or not len(starts):
+        if strict and len(pos):
+            raise ValueError(
+                f"{len(pos)} .records positions reference blocks missing "
+                "from the plan (stale sidecar?)"
+            )
+        return out
+    idx = np.searchsorted(starts, pos[:, 0])
+    known = (idx < len(starts)) & (
+        starts[np.clip(idx, 0, len(starts) - 1)] == pos[:, 0]
+    )
+    if strict and not known.all():
+        bad = pos[~known][:5, 0].tolist()
+        raise ValueError(
+            f"{int((~known).sum())} .records positions reference blocks "
+            f"missing from the plan (first: {bad}; stale sidecar?)"
+        )
+    pos, idx = pos[known], idx[known]
+    parts = part_of_block[idx]
+    # One global (partition, block, offset) sort, then split — O(N log N).
+    order = np.lexsort((pos[:, 1], pos[:, 0], parts))
+    pos, parts = pos[order], parts[order]
+    cuts = np.searchsorted(parts, np.arange(1, n_parts))
+    for i, rows in enumerate(np.split(pos, cuts)):
+        out[i] = rows
+    return out

@@ -140,7 +140,12 @@ class StreamChecker:
 
         from spark_bam_tpu.tpu.checker import PAD, make_check_window
 
-        kernel = make_check_window(self.kernel_window, self.config.reads_to_check)
+        kernel = make_check_window(
+            self.kernel_window, self.config.reads_to_check,
+            flags_impl=(
+                "pallas" if self.config.backend == "pallas" else "xla"
+            ),
+        )
         lens = np.zeros(max(1024, len(self.lengths)), dtype=np.int32)
         lens[: len(self.lengths)] = self.lengths
         lens_dev = jax.device_put(jnp.asarray(lens))

@@ -81,3 +81,51 @@ def test_unindexed_matches_indexed_plan(bam2, tmp_path):
     assert [m.start for p in searched.partitions for m in p] == [
         m.start for p in indexed.partitions for m in p
     ]
+
+
+def test_align_indexed_records_partitions(bam2):
+    """BlocksAndIndexedRecords analog: the .records truth buckets to the
+    same partitions as the block plan, losslessly and in order
+    (reference IndexedRecordPositions.toSets + BlocksAndIndexedRecords)."""
+    import numpy as np
+
+    from spark_bam_tpu.check.blocks import align_indexed_records, plan_blocks
+    from spark_bam_tpu.bam.index_records import read_records_index
+
+    blocks = plan_blocks(bam2)  # 2 MB default split (Blocks.scala:64)
+    aligned = align_indexed_records(blocks, str(bam2) + ".records")
+    assert len(aligned) == len(blocks.partitions)
+
+    # Each partition's positions live in that partition's blocks.
+    for part, rows in zip(blocks.partitions, aligned):
+        starts = {m.start for m in part}
+        assert set(rows[:, 0].tolist()) <= starts
+        # Sorted within the partition.
+        assert np.lexsort((rows[:, 1], rows[:, 0])).tolist() == list(range(len(rows)))
+
+    # Lossless: the union reassembles the full index exactly.
+    all_rows = np.concatenate([r for r in aligned])
+    want = np.array(
+        [(p.block_pos, p.offset) for p in read_records_index(str(bam2) + ".records")],
+        dtype=np.int64,
+    )
+    got = all_rows[np.lexsort((all_rows[:, 1], all_rows[:, 0]))]
+    want = want[np.lexsort((want[:, 1], want[:, 0]))]
+    np.testing.assert_array_equal(got, want)
+    assert len(got) == 2500
+
+
+def test_align_indexed_records_strict_on_stale_sidecar(bam2, tmp_path):
+    """A truth position pointing at an unplanned block must raise (stale
+    sidecar detection), unless strict=False for ranges-filtered plans."""
+    import pytest as _pytest
+
+    from spark_bam_tpu.check.blocks import align_indexed_records, plan_blocks
+
+    blocks = plan_blocks(bam2)
+    side = tmp_path / "stale.records"
+    side.write_text("999999999,0\n26169,100\n")
+    with _pytest.raises(ValueError, match="missing from the plan"):
+        align_indexed_records(blocks, side)
+    aligned = align_indexed_records(blocks, side, strict=False)
+    assert sum(len(r) for r in aligned) == 1
