@@ -149,6 +149,13 @@ def _child_device_all(window_mb: int, platform: str, iters: int,
     # ---- e2e count-reads through the production streaming path ----------
     if big_path:
         try:
+            _run_stage_probe(window_mb, big_path)
+        except Exception as e:
+            _emit_stage(
+                "probe_error:"
+                + f"{type(e).__name__}: {e}"[:200].replace("\n", " ")
+            )
+        try:
             _run_e2e_leg(window_mb, big_path, reads, backend)
         except Exception as e:
             import traceback
@@ -174,6 +181,87 @@ def _child_device_all(window_mb: int, platform: str, iters: int,
             _emit_stage(
                 "pallas_error:" + f"{type(e).__name__}: {e}"[:300].replace("\n", " ")
             )
+
+
+def _run_stage_probe(window_mb: int, big_path: str):
+    """Per-stage timing of 3 streaming windows, under two pipeline shapes.
+
+    Diagnoses where e2e wall-clock goes (r3/r4 observed ~10 s/window vs a
+    65 ms isolated transfer test): host inflate, padded assembly, H2D,
+    kernel, device reduce — once with the production pipeline shape
+    (depth=2, 8 inflate threads live in the background) and once with a
+    quiet pipeline (depth=1, 1 thread). A large gap between the two pins
+    the slowdown on host-thread/GIL contention with the tunnel client.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from spark_bam_tpu.bam.header import read_header
+    from spark_bam_tpu.tpu.checker import PAD, make_check_window
+    from spark_bam_tpu.tpu.inflate import InflatePipeline
+    from spark_bam_tpu.tpu.stream_check import _reduce_span
+
+    hdr = read_header(big_path)
+    lens_list = hdr.contig_lengths.lengths_list()
+    lengths = np.zeros(max(1024, len(lens_list)), dtype=np.int32)
+    lengths[: len(lens_list)] = lens_list
+    w = window_mb << 20
+    kernel = make_check_window(w, 10)
+    ld = jax.device_put(jnp.asarray(lengths))
+    nc = jnp.int32(len(lens_list))
+
+    # Warm the kernel + reduce compiles so row 0 measures the workload.
+    warm = np.zeros(w + PAD, dtype=np.uint8)
+    out = kernel(jnp.asarray(warm), ld, nc, jnp.int32(0), jnp.bool_(False))
+    c, e = _reduce_span(
+        out["verdict"], out["escaped"], jnp.int32(0), jnp.int32(0)
+    )
+    int(c)
+
+    from spark_bam_tpu.bgzf.index_blocks import blocks_metadata
+
+    metas = list(blocks_metadata(big_path))  # one scan for both shapes
+
+    def run_shape(threads: int, depth: int):
+        pipe = InflatePipeline(
+            big_path, window_uncompressed=w - E2E_HALO,
+            threads=threads, depth=depth, metas=metas,
+        )
+        it = iter(pipe)
+        rows = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            view = next(it)
+            t1 = time.perf_counter()
+            padded = np.zeros(w + PAD, dtype=np.uint8)
+            padded[: view.size] = view.data[: view.size]
+            t2 = time.perf_counter()
+            dev = jnp.asarray(padded)
+            dev.block_until_ready()
+            t3 = time.perf_counter()
+            out = kernel(dev, ld, nc, jnp.int32(view.size), jnp.bool_(False))
+            out["verdict"].block_until_ready()
+            t4 = time.perf_counter()
+            c, e = _reduce_span(
+                out["verdict"], out["escaped"], jnp.int32(0),
+                jnp.int32(view.size),
+            )
+            int(c)
+            t5 = time.perf_counter()
+            rows.append({
+                "inflate": round(t1 - t0, 3), "pad": round(t2 - t1, 3),
+                "h2d": round(t3 - t2, 3), "kernel": round(t4 - t3, 3),
+                "reduce": round(t5 - t4, 3),
+            })
+        return rows
+
+    run_shape(threads=1, depth=1)  # warm the page cache: un-confound the A/B
+    _emit_result("stage_probe", {
+        "production_shape": run_shape(threads=8, depth=2),
+        "quiet_shape": run_shape(threads=1, depth=1),
+        "window_mb": window_mb,
+    })
+    _emit_stage("probe_done")
 
 
 def _run_pallas_probe(window_mb: int, backend: str):

@@ -188,11 +188,14 @@ class InflatePipeline:
         threads: int = 8,
         device_copy: bool = False,
         depth: int = 2,
+        metas: list | None = None,
     ):
         from spark_bam_tpu.bgzf.index_blocks import blocks_metadata
 
         self.path = path
-        self.metas = list(blocks_metadata(path))
+        # ``metas``: reuse a prior metadata scan (whole-file header walk)
+        # when the caller already has one.
+        self.metas = list(blocks_metadata(path)) if metas is None else metas
         self.total = sum(m.uncompressed_size for m in self.metas)
         self.groups = window_plan(self.metas, window_uncompressed)
         self.threads = threads
