@@ -333,8 +333,24 @@ def _run_e2e_leg(window_mb: int, big_path: str, reads: int, backend: str):
             wall = time.perf_counter() - t0
             _emit_stage(f"e2e_win:{k}:{done}:{total}:{wall:.1f}s")
 
-    # window_uncompressed + halo == w ⇒ the SAME kernel shape as the steady
-    # leg: compiled once, reused here (and cached persistently).
+    # window_uncompressed + halo == w ⇒ the same kernel shape as the steady
+    # leg. The count path uses the *fused* count_window kernel, which no
+    # earlier leg compiles — warm it explicitly so wall_s measures the
+    # workload, not XLA.
+    import jax.numpy as jnp
+
+    from spark_bam_tpu.tpu.checker import PAD, make_count_window
+
+    warm_kernel = make_count_window(w, 10)
+    warm = np.zeros(w + PAD, dtype=np.uint8)
+    lens = np.zeros(1024, dtype=np.int32)
+    out = warm_kernel(
+        jnp.asarray(warm), jnp.asarray(lens), jnp.int32(1), jnp.int32(0),
+        jnp.bool_(False), jnp.int32(0), jnp.int32(0),
+    )
+    int(out["count"])
+    _emit_stage("e2e_warm")
+
     checker = StreamChecker(
         big_path, Config(), window_uncompressed=w - E2E_HALO, halo=E2E_HALO,
         progress=progress,

@@ -350,6 +350,60 @@ def check_window(
     }
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("reads_to_check", "window", "flags_impl", "pallas_interpret"),
+)
+def count_window(
+    padded, lengths, num_contigs, n, at_eof, lo, own,
+    reads_to_check: int = 10, window: int | None = None,
+    flags_impl: str = "xla", pallas_interpret: bool = False,
+):
+    """check_window fused with its owned-span count reduction.
+
+    One dispatch per streaming window instead of kernel + separate reduce
+    (dispatch round-trips dominate on remote-tunnel devices), and XLA
+    dead-code-eliminates the fail_mask/reads_* scatters the count path
+    never reads. ``escaped``/``verdict`` stay available device-side for the
+    rare deferral fallback.
+    """
+    res = check_window(
+        padded, lengths, num_contigs, n, at_eof,
+        reads_to_check=reads_to_check, window=window,
+        flags_impl=flags_impl, pallas_interpret=pallas_interpret,
+    )
+    w = padded.shape[0] - PAD
+    i = jnp.arange(w, dtype=_I32)
+    m = (i >= lo) & (i < own)
+    return {
+        "count": jnp.sum(m & res["verdict"]),
+        "esc_count": jnp.sum(m & res["escaped"]),
+        "escaped": res["escaped"],
+    }
+
+
+def _pallas_interpret_for(flags_impl: str) -> bool:
+    """Pallas kernels compile via Mosaic only on real TPUs; everywhere else
+    (tests' virtual CPU mesh) they run in interpret mode."""
+    return flags_impl == "pallas" and jax.default_backend() != "tpu"
+
+
+def make_count_window(
+    window: int, reads_to_check: int = 10, flags_impl: str = "xla"
+):
+    """A jit-compiled fused count kernel for fixed ``window`` size."""
+    pallas_interpret = _pallas_interpret_for(flags_impl)
+
+    def run(padded, lengths, num_contigs, n, at_eof, lo, own):
+        return count_window(
+            padded, lengths, num_contigs, n, at_eof, lo, own,
+            reads_to_check=reads_to_check, window=window,
+            flags_impl=flags_impl, pallas_interpret=pallas_interpret,
+        )
+
+    return run
+
+
 def make_check_window(
     window: int, reads_to_check: int = 10, flags_impl: str = "xla"
 ):
@@ -358,9 +412,7 @@ def make_check_window(
     ``flags_impl="pallas"`` swaps the flag pass for the Pallas full kernel
     (tpu/pallas_kernels.py); on non-TPU backends it runs in interpret mode.
     """
-    pallas_interpret = False
-    if flags_impl == "pallas":
-        pallas_interpret = jax.default_backend() != "tpu"
+    pallas_interpret = _pallas_interpret_for(flags_impl)
 
     def run(padded, lengths, num_contigs, n, at_eof):
         return check_window(

@@ -107,11 +107,10 @@ class StreamChecker:
         self.header_end_abs = self.header.uncompressed_size
 
     # ------------------------------------------------------------ the loop
-    def _windows(self):
+    def _windows(self, launch):
         """Yield ``(buf, base, own_end, at_eof, launched)`` one window behind
         the device: window *k+1* is dispatched before *k* is yielded, so the
         consumer's host work overlaps the device."""
-        launch = self._launcher()
         carry = np.empty(0, dtype=np.uint8)
         base_next = 0
         prev = None
@@ -122,37 +121,39 @@ class StreamChecker:
             )
             n = len(buf)
             at_eof = view.at_eof
-            out = launch(buf, n, at_eof)
+            own_end = n if at_eof else max(n - self.halo, 0)
+            out = launch(buf, n, at_eof, base, own_end)
             if prev is not None:
                 yield prev
-            own_end = n if at_eof else max(n - self.halo, 0)
             prev = (buf, base, own_end, at_eof, out)
             carry = buf[own_end:]
             base_next = base + own_end
         if prev is not None:
             yield prev
 
-    def _launcher(self):
-        if not self.use_device:
-            return lambda buf, n, at_eof: None  # resolved lazily on host
-        import jax
-        import jax.numpy as jnp
+    def _device_inputs(self):
+        lens = np.zeros(max(1024, len(self.lengths)), dtype=np.int32)
+        lens[: len(self.lengths)] = self.lengths
+        lens_dev = jax.device_put(jnp.asarray(lens))
+        return lens_dev, jnp.int32(len(self.lengths))
 
+    def _flags_impl(self) -> str:
+        return "pallas" if self.config.backend == "pallas" else "xla"
+
+    def _launcher(self):
+        """Full-output launch (the spans path)."""
+        if not self.use_device:
+            return lambda buf, n, at_eof, base, own_end: None  # host-lazy
         from spark_bam_tpu.tpu.checker import PAD, make_check_window
 
         kernel = make_check_window(
             self.kernel_window, self.config.reads_to_check,
-            flags_impl=(
-                "pallas" if self.config.backend == "pallas" else "xla"
-            ),
+            flags_impl=self._flags_impl(),
         )
-        lens = np.zeros(max(1024, len(self.lengths)), dtype=np.int32)
-        lens[: len(self.lengths)] = self.lengths
-        lens_dev = jax.device_put(jnp.asarray(lens))
-        nc = jnp.int32(len(self.lengths))
+        lens_dev, nc = self._device_inputs()
         w = self.kernel_window
 
-        def launch(buf, n, at_eof):
+        def launch(buf, n, at_eof, base, own_end):
             padded = np.zeros(w + PAD, dtype=np.uint8)
             padded[:n] = buf
             # Fresh buffer per window (never mutated after dispatch): safe
@@ -161,6 +162,29 @@ class StreamChecker:
             return kernel(
                 jnp.asarray(padded), lens_dev, nc, jnp.int32(n),
                 jnp.bool_(at_eof),
+            )
+
+        return launch
+
+    def _count_launcher(self):
+        """Fused count launch: one dispatch per window, scatters DCE'd."""
+        from spark_bam_tpu.tpu.checker import PAD, make_count_window
+
+        kernel = make_count_window(
+            self.kernel_window, self.config.reads_to_check,
+            flags_impl=self._flags_impl(),
+        )
+        lens_dev, nc = self._device_inputs()
+        w = self.kernel_window
+        he = self.header_end_abs
+
+        def launch(buf, n, at_eof, base, own_end):
+            padded = np.zeros(w + PAD, dtype=np.uint8)
+            padded[:n] = buf
+            lo = min(max(he - base, 0), own_end)
+            return kernel(
+                jnp.asarray(padded), lens_dev, nc, jnp.int32(n),
+                jnp.bool_(at_eof), jnp.int32(lo), jnp.int32(own_end),
             )
 
         return launch
@@ -241,7 +265,7 @@ class StreamChecker:
         """Yield ``(base, verdict)`` spans; see the module contract."""
         deferred = self._Deferred(self.lengths, self.config.reads_to_check)
         windows = 0
-        for buf, base, own_end, at_eof, out in self._windows():
+        for buf, base, own_end, at_eof, out in self._windows(self._launcher()):
             verdict, escaped = self._verdict_escaped(buf, at_eof, out)
             span = verdict[:own_end].copy()
             deferred.extend(buf, base)
@@ -258,7 +282,8 @@ class StreamChecker:
 
     def count_reads(self) -> int:
         """Record count (the count-reads workload). On device, each window
-        reduces to two scalars on-chip; verdict arrays never cross the wire."""
+        runs ONE fused kernel whose owned-span count reduces on-chip; only
+        two scalars cross the wire per window."""
         he = self.header_end_abs
         if not self.use_device:
             return sum(
@@ -267,14 +292,13 @@ class StreamChecker:
         total = 0
         deferred = self._Deferred(self.lengths, self.config.reads_to_check)
         windows = 0
-        pending_scalars = None
+        prev = None
 
-        def settle(scalars, buf, base, own_end, at_eof, out):
+        def settle(buf, base, own_end, at_eof, out):
             nonlocal total
-            cnt, esc = scalars
-            total += int(cnt)
+            total += int(out["count"])
             deferred.extend(buf, base)
-            if int(esc):
+            if int(out["esc_count"]):
                 escaped = np.asarray(out["escaped"])[:own_end]
                 esc_idx = np.flatnonzero(escaped)
                 esc_idx = esc_idx[base + esc_idx >= he]
@@ -282,20 +306,15 @@ class StreamChecker:
             for pos, v in deferred.resolve(at_eof):
                 total += int(v[0])
 
-        for buf, base, own_end, at_eof, out in self._windows():
-            lo = min(max(he - base, 0), own_end)
-            scalars = _reduce_span(
-                out["verdict"], out["escaped"], jnp.int32(lo),
-                jnp.int32(own_end),
-            )
-            if pending_scalars is not None:
-                settle(*pending_scalars)
-            pending_scalars = (scalars, buf, base, own_end, at_eof, out)
+        for item in self._windows(self._count_launcher()):
+            if prev is not None:
+                settle(*prev)
+            prev = item
             windows += 1
             if self.progress is not None:
-                self.progress(windows, base + own_end, self.total)
-        if pending_scalars is not None:
-            settle(*pending_scalars)
+                self.progress(windows, item[1] + item[2], self.total)
+        if prev is not None:
+            settle(*prev)
         assert not len(deferred), "pendings must resolve by EOF"
         return total
 
