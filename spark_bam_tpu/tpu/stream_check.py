@@ -281,42 +281,77 @@ class StreamChecker:
         assert not len(deferred), "pendings must resolve by EOF"
 
     def count_reads(self) -> int:
-        """Record count (the count-reads workload). On device, each window
-        runs ONE fused kernel whose owned-span count reduces on-chip; only
-        two scalars cross the wire per window."""
-        he = self.header_end_abs
+        """Record count (the count-reads workload).
+
+        On device, each window runs ONE fused kernel whose owned-span count
+        reduces on-chip, and the per-window scalars accumulate *on device* —
+        nothing crosses the wire until EOF (device→host round-trips per
+        window are the latency tax on remote/tunnelled devices). A pacing
+        sync on a two-windows-old scalar bounds in-flight windows (and HBM)
+        without a transfer. If any owned candidate escaped (chains beyond
+        the halo — ultra-long reads), the exact spans() path re-runs the
+        file with full deferral; on real data with the default halo this
+        never triggers.
+        """
         if not self.use_device:
-            return sum(
-                int(v[max(he - b, 0):].sum()) for b, v in self.spans()
-            )
+            return self._count_via_spans()
         total = 0
-        deferred = self._Deferred(self.lengths, self.config.reads_to_check)
+        dev_total = None
+        dev_esc = None
         windows = 0
-        prev = None
-
-        def settle(buf, base, own_end, at_eof, out):
-            nonlocal total
-            total += int(out["count"])
-            deferred.extend(buf, base)
-            if int(out["esc_count"]):
-                escaped = np.asarray(out["escaped"])[:own_end]
-                esc_idx = np.flatnonzero(escaped)
-                esc_idx = esc_idx[base + esc_idx >= he]
-                deferred.add(base + esc_idx, buf, base)
-            for pos, v in deferred.resolve(at_eof):
-                total += int(v[0])
-
-        for item in self._windows(self._count_launcher()):
-            if prev is not None:
-                settle(*prev)
-            prev = item
+        chunk = 0
+        # Flush the device accumulators to host ints often enough that the
+        # int32 sums cannot overflow: ≤ 2^30 positions per chunk.
+        flush_every = max(1, (1 << 30) // self.kernel_window)
+        escaped = False
+        ring: list = []  # pacing: keep ≤2 windows' scalars un-synced
+        for buf, base, own_end, at_eof, out in self._windows(
+            self._count_launcher()
+        ):
+            dev_total = (
+                out["count"] if dev_total is None else dev_total + out["count"]
+            )
+            dev_esc = (
+                out["esc_count"] if dev_esc is None
+                else dev_esc + out["esc_count"]
+            )
+            ring.append(out["count"])
+            if len(ring) > 2:
+                ring.pop(0).block_until_ready()
             windows += 1
+            chunk += 1
             if self.progress is not None:
-                self.progress(windows, item[1] + item[2], self.total)
-        if prev is not None:
-            settle(*prev)
-        assert not len(deferred), "pendings must resolve by EOF"
+                self.progress(windows, base + own_end, self.total)
+            if chunk >= flush_every:
+                # Escape checkpoint rides the flush: abort to the exact
+                # path early instead of finishing a doomed device pass.
+                if int(dev_esc):
+                    escaped = True
+                    break
+                total += int(dev_total)
+                dev_total = dev_esc = None
+                chunk = 0
+        if not escaped and dev_total is not None:
+            if int(dev_esc):
+                escaped = True
+            else:
+                total += int(dev_total)
+        if escaped:
+            # Rare exact path (chains outran the halo — ultra-long reads):
+            # the spans path resolves every deferral bit-exactly. Suppress
+            # progress so consumers don't see the counters restart.
+            saved, self.progress = self.progress, None
+            try:
+                return self._count_via_spans()
+            finally:
+                self.progress = saved
         return total
+
+    def _count_via_spans(self) -> int:
+        he = self.header_end_abs
+        return sum(
+            int(v[max(he - b, 0):].sum()) for b, v in self.spans()
+        )
 
     def record_starts(self) -> Iterator[np.ndarray]:
         """Absolute flat offsets of record starts, one array per span, in
