@@ -28,8 +28,13 @@ def parse_record_line(line: str) -> Pos:
 
 
 def read_records_index(path) -> list[Pos]:
-    with open(path) as f:
-        return [parse_record_line(line) for line in f if line.strip()]
+    from spark_bam_tpu.core.channel import read_text
+
+    return [
+        parse_record_line(line)
+        for line in read_text(path).splitlines()
+        if line.strip()
+    ]
 
 
 def index_records(

@@ -14,7 +14,7 @@ from typing import Iterable
 
 from spark_bam_tpu.bgzf.block import Metadata
 from spark_bam_tpu.bgzf.stream import MetadataStream
-from spark_bam_tpu.core.channel import open_channel
+from spark_bam_tpu.core.channel import open_channel, path_exists
 
 log = logging.getLogger(__name__)
 
@@ -31,8 +31,13 @@ def parse_block_line(line: str) -> Metadata:
 
 
 def read_blocks_index(path) -> list[Metadata]:
-    with open(path) as f:
-        return [parse_block_line(line) for line in f if line.strip()]
+    from spark_bam_tpu.core.channel import read_text
+
+    return [
+        parse_block_line(line)
+        for line in read_text(path).splitlines()
+        if line.strip()
+    ]
 
 
 def index_blocks(
@@ -55,10 +60,8 @@ def index_blocks(
 
 def blocks_metadata(bam_path) -> Iterable[Metadata]:
     """All block Metadata of a BAM: from the sidecar if present, else by scan."""
-    import os
-
     sidecar = str(bam_path) + ".blocks"
-    if os.path.exists(sidecar):
+    if path_exists(sidecar):
         return read_blocks_index(sidecar)
     with open_channel(bam_path) as ch:
         return list(MetadataStream(ch))

@@ -15,14 +15,13 @@ Default split size 2 MB (ref :64).
 from __future__ import annotations
 
 import math
-import os
 from dataclasses import dataclass
 
 from spark_bam_tpu.bgzf.block import Metadata
 from spark_bam_tpu.bgzf.find_block_start import find_block_start
 from spark_bam_tpu.bgzf.index_blocks import read_blocks_index
 from spark_bam_tpu.bgzf.stream import MetadataStream
-from spark_bam_tpu.core.channel import open_channel
+from spark_bam_tpu.core.channel import open_channel, path_exists, path_size
 from spark_bam_tpu.core.config import Config
 from spark_bam_tpu.core.ranges import RangeSet
 from spark_bam_tpu.parallel.executor import ParallelConfig, map_partitions
@@ -53,7 +52,7 @@ def plan_blocks(
     split_size = config.split_size_or(Config.CHECK_SPLIT_SIZE_DEFAULT)
     blocks_path = str(blocks_path) if blocks_path else str(path) + ".blocks"
 
-    if os.path.exists(blocks_path):
+    if path_exists(blocks_path):
         metas = [
             m
             for m in read_blocks_index(blocks_path)
@@ -79,7 +78,7 @@ def plan_blocks(
             ],
         )
 
-    size = os.path.getsize(path)
+    size = path_size(path)
     num_splits = math.ceil(size / split_size)
     split_idxs = [
         i

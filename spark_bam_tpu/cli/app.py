@@ -9,7 +9,6 @@ confusion matrix, annotated false positives).
 
 from __future__ import annotations
 
-import os
 from dataclasses import dataclass
 from functools import cached_property
 
@@ -23,6 +22,7 @@ from spark_bam_tpu.check.flags import Flags
 from spark_bam_tpu.check.seqdoop import seqdoop_check_flat
 from spark_bam_tpu.check.vectorized import ChainResult, check_flat
 from spark_bam_tpu.cli.output import Printer
+from spark_bam_tpu.core.channel import path_exists, path_size
 from spark_bam_tpu.core.config import Config
 from spark_bam_tpu.core.pos import Pos
 from spark_bam_tpu.core.stats import format_bytes_binary
@@ -106,7 +106,7 @@ class CheckerContext:
 
     @cached_property
     def compressed_size(self) -> int:
-        return os.path.getsize(self.path)
+        return path_size(self.path)
 
     @cached_property
     def selected_compressed_size(self) -> int:
@@ -193,7 +193,7 @@ class CheckerContext:
 
     @property
     def has_records_index(self) -> bool:
-        return os.path.exists(self.records_path)
+        return path_exists(self.records_path)
 
     def verdict_for(self, name: str) -> np.ndarray:
         if name == "eager":

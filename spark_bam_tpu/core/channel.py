@@ -209,6 +209,54 @@ def register_scheme(scheme: str, factory) -> None:
     _SCHEMES[scheme] = factory
 
 
+def is_url(path) -> bool:
+    return bool(_URL_RE.match(str(path)))
+
+
+def _raw_url_channel(url: str) -> ByteChannel:
+    """One-shot metadata channel for a URL: the bare backend, no prefetch
+    pool (a HEAD or single ranged GET doesn't want read-ahead)."""
+    scheme = _URL_RE.match(url).group(1)
+    if scheme in _SCHEMES:
+        return _SCHEMES[scheme](url)
+    if scheme in ("http", "https"):
+        from spark_bam_tpu.core.remote import HttpRangeChannel
+
+        return HttpRangeChannel(url)
+    raise ValueError(f"no channel backend for scheme {scheme!r}: {url}")
+
+
+def path_size(path) -> int:
+    """Byte size of a path or URL (URLs via the channel backend)."""
+    if is_url(path):
+        with _raw_url_channel(str(path)) as ch:
+            return ch.size
+    return os.path.getsize(str(path))
+
+
+def read_text(path) -> str:
+    """Full text of a path or URL (sidecar files: ``.blocks``/``.records``)."""
+    if is_url(path):
+        with _raw_url_channel(str(path)) as ch:
+            return bytes(ch.read_at(0, ch.size)).decode()
+    with open(str(path), "rt") as f:
+        return f.read()
+
+
+def path_exists(path) -> bool:
+    """Existence of a path or URL. URLs: a size probe — only a definitive
+    "missing" (FileNotFoundError, e.g. HTTP 404) reads as absent; transient
+    network/auth failures propagate rather than silently degrading sidecar
+    lookups (``.blocks``/``.records``/``.crai``) to full scans."""
+    if is_url(path):
+        try:
+            with _raw_url_channel(str(path)) as ch:
+                return ch.size >= 0
+        except FileNotFoundError:
+            return False
+    return os.path.exists(str(path))
+
+
 def open_channel(path, cached: bool = False) -> ByteChannel:
     """Open a channel for a path — the single pluggable IO seam.
 

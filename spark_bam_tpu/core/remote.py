@@ -117,6 +117,10 @@ class HttpRangeChannel(ByteChannel):
                 resp = self._request("HEAD", {})
                 resp.read()
                 length = resp.headers.get("Content-Length")
+                if resp.status == 404:
+                    # Distinguishable "missing" (sidecar probes rely on it);
+                    # other statuses are real errors and must propagate.
+                    raise FileNotFoundError(f"HEAD {self.url}: HTTP 404")
                 if resp.status != 200 or length is None:
                     raise IOError(
                         f"HEAD {self.url}: HTTP {resp.status}, no length"

@@ -25,7 +25,7 @@ from spark_bam_tpu.bam.record import BamRecord, parse_sam_line
 from spark_bam_tpu.bgzf.find_block_start import find_block_start
 from spark_bam_tpu.bgzf.stream import SeekableBlockStream, SeekableUncompressedBytes
 from spark_bam_tpu.check.eager import EagerChecker
-from spark_bam_tpu.core.channel import open_channel
+from spark_bam_tpu.core.channel import open_channel, path_exists, path_size
 from spark_bam_tpu.core.config import Config
 from spark_bam_tpu.core.pos import Pos
 from spark_bam_tpu.load.dataset import Dataset
@@ -114,7 +114,7 @@ def load_splits_and_reads(
     ds = load_reads_and_positions(path, split_size, config, parallel)
     firsts = ds.first_per_partition()
     starts = [pos for item in firsts if item is not None for pos in [item[0]]]
-    eof = Pos(os.path.getsize(path), 0)
+    eof = Pos(path_size(path), 0)
     splits = [
         Split(start, starts[i + 1] if i + 1 < len(starts) else eof)
         for i, start in enumerate(starts)
@@ -265,7 +265,7 @@ def load_cram_intervals(
     }
     crai_path = str(path) + ".crai"
     selected = infos
-    if os.path.exists(crai_path):
+    if path_exists(crai_path):
         # ref id → 0-based intervals, whole-contig expanded, computed once.
         by_ref = {
             name_to_idx[contig]: ivs or [(0, header.contig_lengths[name_to_idx[contig]][1])]

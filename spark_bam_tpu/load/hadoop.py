@@ -11,7 +11,6 @@ class of failure the reference observes from hadoop-bam (CountReadsTest:
 
 from __future__ import annotations
 
-import os
 
 import numpy as np
 
@@ -35,9 +34,9 @@ def hadoop_bam_splits(
     """Splits the way hadoop-bam computes them: sequentially on the driver,
     one seqdoop guess per raw split boundary; ends are (rawEnd, 0xffff)."""
     checker = checker or SeqdoopChecker.open(path)
-    size = os.path.getsize(path)
     splits: list[Split] = []
     with open_channel(path) as ch:
+        size = ch.size
         for s in range(0, size, split_size):
             e = min(s + split_size, size)
             block = find_block_start(ch, s, config.bgzf_blocks_to_check, path=str(path))

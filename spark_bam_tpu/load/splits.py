@@ -7,9 +7,9 @@ Reference: hadoop ``FileSplits`` → ``SplitRDD`` byte ranges
 
 from __future__ import annotations
 
-import os
 from dataclasses import dataclass
 
+from spark_bam_tpu.core.channel import path_size
 from spark_bam_tpu.core.pos import Pos
 
 
@@ -39,7 +39,7 @@ class Split:
 
 
 def file_splits(path, split_size: int) -> list[FileSplit]:
-    size = os.path.getsize(path)
+    size = path_size(path)
     return [
         FileSplit(str(path), start, min(start + split_size, size))
         for start in range(0, size, split_size)

@@ -363,9 +363,10 @@ def count_window(
 
     One dispatch per streaming window instead of kernel + separate reduce
     (dispatch round-trips dominate on remote-tunnel devices), and XLA
-    dead-code-eliminates the fail_mask/reads_* scatters the count path
-    never reads. ``escaped``/``verdict`` stay available device-side for the
-    rare deferral fallback.
+    dead-code-eliminates everything the two scalars don't need — the
+    fail_mask/reads_* scatters and the per-position arrays themselves.
+    (Escapes are rare; the caller falls back to the exact spans path when
+    ``esc_count`` is ever nonzero.)
     """
     res = check_window(
         padded, lengths, num_contigs, n, at_eof,
@@ -378,7 +379,6 @@ def count_window(
     return {
         "count": jnp.sum(m & res["verdict"]),
         "esc_count": jnp.sum(m & res["escaped"]),
-        "escaped": res["escaped"],
     }
 
 

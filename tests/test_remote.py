@@ -61,12 +61,14 @@ def test_prefetch_hides_latency_in_count_reads(synth):
     path, manifest = synth
     data = path.read_bytes()
 
-    register_scheme(
-        "slow",
-        lambda url: PrefetchChannel(
+    def slow_factory(url):
+        if not url.endswith("/synth.bam"):
+            raise FileNotFoundError(url)  # sidecar probes must miss
+        return PrefetchChannel(
             LatencyChannel(data), chunk_size=1 << 20, depth=8, workers=8
-        ),
-    )
+        )
+
+    register_scheme("slow", slow_factory)
 
     # Warm once so kernel compiles don't skew either timing.
     assert count_reads_streaming(path, CFG) == manifest["reads"]
@@ -76,7 +78,7 @@ def test_prefetch_hides_latency_in_count_reads(synth):
     local_wall = time.perf_counter() - t0
 
     t0 = time.perf_counter()
-    remote = count_reads_streaming("slow://synth.bam", CFG)
+    remote = count_reads_streaming("slow://host/synth.bam", CFG)
     remote_wall = time.perf_counter() - t0
 
     assert remote == local == manifest["reads"]
@@ -117,13 +119,25 @@ class _RangeHandler(BaseHTTPRequestHandler):
 
     def do_HEAD(self):
         self._common()
+        if not self._known():
+            return
         self.send_response(200)
         self.send_header("Content-Length", str(len(self.payload)))
         self.send_header("Accept-Ranges", "bytes")
         self.end_headers()
 
+    def _known(self) -> bool:
+        if self.path == "/synth.bam":
+            return True
+        self.send_response(404)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+        return False
+
     def do_GET(self):
         self._common()
+        if not self._known():
+            return
         rng = self.headers.get("Range")
         total = len(self.payload)
         if rng and rng.startswith("bytes="):
@@ -184,3 +198,17 @@ def test_http_header_parse(http_server):
     url, _ = http_server
     hdr = read_header(url)
     assert hdr.num_contigs == 84
+
+
+def test_http_load_bam_and_plan(http_server):
+    """The load path and block planner must work on URLs end-to-end:
+    file_splits sizes via the channel, block search over ranged GETs."""
+    from spark_bam_tpu.check.blocks import plan_blocks
+    from spark_bam_tpu.load.api import load_bam
+
+    url, manifest = http_server
+    assert load_bam(url, split_size="1MB").count() == manifest["reads"]
+
+    blocks = plan_blocks(url)  # no .blocks sidecar on the server → search path
+    total = sum(m.uncompressed_size for p in blocks.partitions for m in p)
+    assert total == manifest["uncompressed_bytes"]
