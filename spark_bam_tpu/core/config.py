@@ -76,6 +76,12 @@ class Config:
     # fits a 16 GB-HBM chip (64 MB windows OOM at compile time).
     window_size: int = 24 << 20
     halo_size: int = 4 << 20            # extra trailing bytes so chains can complete
+    # Two-phase device inflate (host entropy decode + on-device LZ77
+    # resolution, tpu/inflate.py). Off by default: tokens cost ~5x the
+    # uncompressed bytes in transfer, so host inflate wins whenever
+    # host↔device bandwidth is the constraint; the capability stays one
+    # knob away (and demotes to host zlib per window on any failure).
+    device_inflate: bool = False
     # --- misc ---
     warn: bool = False                  # root log-level toggle (args/LogArgs.scala:30-33)
     post_partition_size: int = 100_000  # PostPartitionArgs default (args/PostPartitionArgs.scala:38-43)

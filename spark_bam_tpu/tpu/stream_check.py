@@ -94,7 +94,10 @@ class StreamChecker:
         # The halo must leave room to advance; chains needing more lookahead
         # than the halo escape to the deferral path and still resolve exactly.
         self.halo = min(halo, fresh // 2)
-        self.pipeline = InflatePipeline(path, window_uncompressed=fresh)
+        self.pipeline = InflatePipeline(
+            path, window_uncompressed=fresh,
+            device_copy=config.device_inflate,
+        )
         self.total = self.pipeline.total
         # Kernel shape: one power of two covering carry + window, clamped to
         # the file so small inputs compile a small kernel.
@@ -105,6 +108,9 @@ class StreamChecker:
         # uncompressed bytes IS that offset (bam/header.py measures it by
         # position after the contig dictionary).
         self.header_end_abs = self.header.uncompressed_size
+        # Flush the device count accumulators to host ints often enough
+        # that the int32 sums cannot overflow: ≤ 2^30 positions per chunk.
+        self.flush_every = max(1, (1 << 30) // self.kernel_window)
 
     # ------------------------------------------------------------ the loop
     def _windows(self, launch):
@@ -300,9 +306,7 @@ class StreamChecker:
         dev_esc = None
         windows = 0
         chunk = 0
-        # Flush the device accumulators to host ints often enough that the
-        # int32 sums cannot overflow: ≤ 2^30 positions per chunk.
-        flush_every = max(1, (1 << 30) // self.kernel_window)
+        flush_every = self.flush_every
         escaped = False
         ring: list = []  # pacing: keep ≤2 windows' scalars un-synced
         for buf, base, own_end, at_eof, out in self._windows(

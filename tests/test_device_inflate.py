@@ -200,3 +200,14 @@ def test_whole_bam_matches_host_inflate(bam2):
     assert np.array_equal(dev.block_starts, host.block_starts)
     assert np.array_equal(dev.block_flat, host.block_flat)
     assert dev.at_eof
+
+
+def test_count_reads_with_device_inflate_config(bam1):
+    """spark.bam.device.inflate=true must flow through the config surface
+    into the streaming pipeline and still count exactly."""
+    from spark_bam_tpu.core.config import Config
+    from spark_bam_tpu.load.tpu_load import count_reads_tpu
+
+    cfg = Config.from_dict({"spark.bam.device.inflate": True})
+    assert cfg.device_inflate is True
+    assert count_reads_tpu(bam1, cfg) == 4917

@@ -142,3 +142,16 @@ def test_count_reads_device_escape_fallback(tmp_path):
     finally:
         StreamChecker._count_via_spans = orig
     assert calls, "escape fallback was not exercised"
+
+
+def test_count_reads_flush_chunks(bam1):
+    """The chunked device-accumulator flush (int32-overflow guard) must
+    partition the stream without losing or double-counting windows."""
+    from spark_bam_tpu.core.config import Config
+    from spark_bam_tpu.tpu.stream_check import StreamChecker
+
+    checker = StreamChecker(
+        bam1, Config(), window_uncompressed=128 << 10, halo=32 << 10
+    )
+    checker.flush_every = 2  # force many flush boundaries (incl. mid-chunk EOF)
+    assert checker.count_reads() == 4917
