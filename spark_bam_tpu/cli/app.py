@@ -164,12 +164,12 @@ class CheckerContext:
             # small files resolve faster in the NumPy engine.
             if self.view.size < (32 << 20):
                 return False
-            try:
-                import jax
+            # Probed in a subprocess with a timeout: in-process backend init
+            # hangs indefinitely when a TPU tunnel is down, and an auto
+            # decision must never hang the CLI with it.
+            from spark_bam_tpu.core.platform import probe_default_backend
 
-                return jax.devices()[0].platform in ("tpu", "axon")
-            except Exception:
-                return False
+            return probe_default_backend() in ("tpu", "axon")
         return False
 
     @cached_property

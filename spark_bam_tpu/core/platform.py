@@ -37,6 +37,65 @@ def enable_compile_cache(cache_dir: str | None = None) -> None:
         pass  # cache is an optimization; correctness unaffected
 
 
+_PROBED_BACKEND: dict = {}
+
+
+def probe_default_backend(timeout_s: float = 45.0) -> str | None:
+    """The default jax backend's platform, probed in a SUBPROCESS with a
+    hard timeout.
+
+    On tunnelled-TPU machines, in-process backend init can hang
+    indefinitely when the tunnel is down (observed: hours); an ``auto``
+    backend decision must never hang with it. Returns the platform string
+    (``"tpu"``/``"cpu"``/…) or None when the probe fails or times out —
+    callers fall back to CPU paths. Cached per process.
+    """
+    if "platform" not in _PROBED_BACKEND:
+        import subprocess
+        import sys
+
+        # If this process already initialized a backend, the in-process
+        # answer is instant and cannot hang — skip the subprocess.
+        xb = sys.modules.get("jax._src.xla_bridge")
+        if xb is not None and getattr(xb, "_backends", None):
+            try:
+                import jax
+
+                _PROBED_BACKEND["platform"] = jax.devices()[0].platform
+                return _PROBED_BACKEND["platform"]
+            except Exception:
+                pass
+
+        # The probe must see the caller's platform choice even though
+        # sitecustomize re-pins JAX_PLATFORMS at subprocess startup: pass
+        # it out-of-band and re-assert via the config API (the same trick
+        # force_cpu_devices uses).
+        code = (
+            "import os, jax\n"
+            "p = os.environ.get('SB_PROBE_JAX_PLATFORMS')\n"
+            "if p:\n"
+            "    jax.config.update('jax_platforms', p)\n"
+            "print(jax.devices()[0].platform)\n"
+        )
+        env = {
+            **os.environ,
+            "SB_PROBE_JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", ""),
+        }
+        platform = None
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", code],
+                capture_output=True, text=True, timeout=timeout_s, env=env,
+            )
+            lines = out.stdout.strip().splitlines()
+            if out.returncode == 0 and lines:
+                platform = lines[-1].strip()
+        except Exception:
+            platform = None
+        _PROBED_BACKEND["platform"] = platform
+    return _PROBED_BACKEND["platform"]
+
+
 def force_cpu_devices(n_devices: int, defer_init: bool = False) -> None:
     """Force jax onto ``n_devices`` virtual CPU devices.
 

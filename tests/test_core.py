@@ -54,3 +54,20 @@ def test_config_surface():
     assert c2.split_size == 4 << 20
     c3 = Config.from_env({"SPARK_BAM_CHECKER": "full"})
     assert c3.checker == "full"
+
+
+def test_probe_default_backend_never_hangs():
+    """auto-backend decisions probe the platform in a timed subprocess (a
+    dead TPU tunnel hangs in-process backend init indefinitely)."""
+    from spark_bam_tpu.core.platform import _PROBED_BACKEND, probe_default_backend
+
+    try:
+        _PROBED_BACKEND.clear()
+        plat = probe_default_backend(timeout_s=120)
+        # Test env pins the cpu platform (conftest); the probe must see it.
+        assert plat == "cpu"
+        # Cached: a second call must not spawn again (mutate to prove reuse).
+        _PROBED_BACKEND["platform"] = "sentinel"
+        assert probe_default_backend() == "sentinel"
+    finally:
+        _PROBED_BACKEND.clear()
