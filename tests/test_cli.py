@@ -241,3 +241,25 @@ def test_check_blocks_1bam_default_and_spark(bam1, tmp_path):
     assert got_s.splitlines()[0] == (
         "First read-position matched in 25 BGZF blocks totaling 583KB (compressed)"
     )
+
+
+def test_main_help_lists_all_commands(capsys):
+    """Reference MainTest analog: the usage text names every subcommand and
+    exits cleanly (exit trapped, not raised into the caller)."""
+    with pytest.raises(SystemExit) as e:
+        main(["--help"])
+    assert e.value.code == 0
+    out = capsys.readouterr().out
+    for cmd in (
+        "check-bam", "check-blocks", "full-check", "compute-splits",
+        "compare-splits", "count-reads", "time-load", "index-blocks",
+        "index-records", "htsjdk-rewrite",
+    ):
+        assert cmd in out, f"{cmd} missing from usage"
+
+
+def test_main_unknown_command_fails(capsys):
+    with pytest.raises(SystemExit) as e:
+        main(["frobnicate"])
+    assert e.value.code != 0
+    assert "invalid choice" in capsys.readouterr().err
