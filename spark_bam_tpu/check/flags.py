@@ -36,6 +36,26 @@ FLAG_NAMES = (
 BIT = {name: 1 << i for i, name in enumerate(FLAG_NAMES)}
 
 
+def considered_mask(fail_mask, reads_before):
+    """FullCheck's "considered" rule: failing positions minus the bare
+    at-EOF marker (reference FullCheck.scala:144-147). Vectorized over
+    numpy arrays; the single shared definition for the CLI report and the
+    streaming summary."""
+    bit0 = BIT["tooFewFixedBlockBytes"]
+    return (fail_mask != 0) & ~((fail_mask == bit0) & (reads_before == 0))
+
+
+def num_failing_fields(fail_mask, reads_before):
+    """Failing-field count per position: flag popcount plus the
+    chained-reads field when reads succeeded before the failure."""
+    import numpy as np
+
+    popcount = np.zeros(len(fail_mask), dtype=np.int32)
+    for i in range(len(FLAG_NAMES)):
+        popcount += (fail_mask >> i) & 1
+    return popcount + (reads_before > 0)
+
+
 @dataclass(frozen=True)
 class Success:
     """A position that chained ``reads_parsed`` valid records (or hit EOF)."""

@@ -6,10 +6,13 @@ from __future__ import annotations
 
 import numpy as np
 
-from spark_bam_tpu.check.flags import BIT, FLAG_NAMES
+from spark_bam_tpu.check.flags import (
+    FLAG_NAMES,
+    considered_mask,
+    num_failing_fields,
+)
 from spark_bam_tpu.cli.app import CheckerContext
 
-_BIT0 = BIT["tooFewFixedBlockBytes"]
 
 
 def _counts_lines(
@@ -56,14 +59,10 @@ def run(ctx: CheckerContext) -> None:
 
     masks = res.fail_mask
     rb = res.reads_before
-    # Exclude successes and the bare at-EOF marker (FullCheck.scala:144-147).
-    considered = (masks != 0) & ~((masks == _BIT0) & (rb == 0))
+    considered = considered_mask(masks, rb)
     if ctx.position_mask is not None:
         considered &= ctx.position_mask
-    popcount = np.zeros(len(masks), dtype=np.int32)
-    for i in range(len(FLAG_NAMES)):
-        popcount += (masks >> i) & 1
-    num_fields = popcount + (rb > 0)
+    num_fields = num_failing_fields(masks, rb)
 
     def bucket(k: int) -> np.ndarray:
         return np.flatnonzero(considered & (num_fields == k))

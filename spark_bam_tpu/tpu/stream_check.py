@@ -195,15 +195,19 @@ class StreamChecker:
 
         return launch
 
-    def _verdict_escaped(self, buf, at_eof, out):
-        """Materialize one window's (verdict, escaped) as host arrays."""
+    def _materialize(self, buf, at_eof, out) -> dict:
+        """One window's per-position results as host arrays."""
         if out is None:
             res = check_flat(
                 buf, self.lengths, at_eof=at_eof,
                 reads_to_check=self.config.reads_to_check,
             )
-            return res.verdict, res.escaped
-        return np.asarray(out["verdict"]), np.asarray(out["escaped"])
+            return {
+                "verdict": res.verdict, "escaped": res.escaped,
+                "exact": res.exact, "fail_mask": res.fail_mask,
+                "reads_before": res.reads_before,
+            }
+        return {k: np.asarray(v) for k, v in out.items()}
 
     # --------------------------------------------------- deferred candidates
     class _Deferred:
@@ -243,21 +247,22 @@ class StreamChecker:
                 self.buf = win_buf[self.base - win_base:].copy()
             self.pending = np.concatenate([self.pending, positions])
 
-        def resolve(self, at_eof: bool) -> Iterator[tuple[int, np.ndarray]]:
-            """Re-check pendings against the grown stream; yield 1-position
-            spans for those whose chains now complete."""
-            if not len(self.pending):
-                return
+        def _resolve_chains(self, at_eof: bool):
+            """One sequential-exact pass over pendings; returns (positions
+            resolved, their ChainResult rows) and retires them.
+
+            Retirement requires full exactness (``~escaped & exact``) — an
+            inexact lane's flags may still change once the buffer grows past
+            its chain, so it stays pending (it always converges: with the
+            chain span fully in-buffer the re-check is exact, and at EOF
+            everything is definitive)."""
             res = check_flat(
                 self.buf, self.lengths,
                 candidates=self.pending - self.base,
                 at_eof=at_eof, reads_to_check=self.rtc,
             )
-            done = ~res.escaped
-            for pos, v in zip(
-                self.pending[done].tolist(), res.verdict[done].tolist()
-            ):
-                yield int(pos), np.array([v], dtype=bool)
+            done = (~res.escaped) & res.exact
+            positions = self.pending[done]
             self.pending = self.pending[~done]
             if not len(self.pending):
                 self.buf = np.empty(0, dtype=np.uint8)
@@ -265,26 +270,57 @@ class StreamChecker:
                 lo = int(self.pending.min())
                 self.buf = self.buf[lo - self.base:]
                 self.base = lo
+            return positions, res, done
+
+        def resolve(self, at_eof: bool):
+            """Re-check pendings against the grown stream; yield
+            ``(pos, chain_result, row)`` for each one now fully resolved —
+            callers project whichever ChainResult fields they stream."""
+            if not len(self.pending):
+                return
+            positions, res, done = self._resolve_chains(at_eof)
+            for pos, k in zip(
+                positions.tolist(), np.flatnonzero(done).tolist()
+            ):
+                yield int(pos), res, int(k)
 
     # ------------------------------------------------------------- consumers
-    def spans(self) -> Iterator[tuple[int, np.ndarray]]:
-        """Yield ``(base, verdict)`` spans; see the module contract."""
+    def _stream(self, fields: tuple[str, ...], defer_inexact: bool):
+        """The shared window loop behind ``spans``/``full_spans``: project
+        ``fields`` from each window's results, defer unresolved owned lanes
+        (escaped chains; plus inexact ones when the projection includes
+        flags), and re-emit them as 1-position spans once exact."""
         deferred = self._Deferred(self.lengths, self.config.reads_to_check)
         windows = 0
         for buf, base, own_end, at_eof, out in self._windows(self._launcher()):
-            verdict, escaped = self._verdict_escaped(buf, at_eof, out)
-            span = verdict[:own_end].copy()
+            res = self._materialize(buf, at_eof, out)
+            spans = [res[f][:own_end].copy() for f in fields]
+            bad = res["escaped"][:own_end]
+            if defer_inexact:
+                bad = bad | ~res["exact"][:own_end]
             deferred.extend(buf, base)
-            esc_idx = np.flatnonzero(escaped[:own_end])
-            if len(esc_idx):
-                span[esc_idx] = False  # re-emitted by the deferral path
-                deferred.add(base + esc_idx, buf, base)
-            yield base, span
-            yield from deferred.resolve(at_eof)
+            bad_idx = np.flatnonzero(bad)
+            if len(bad_idx):
+                for s in spans:
+                    s[bad_idx] = 0  # re-emitted by the deferral path
+                deferred.add(base + bad_idx, buf, base)
+            yield (base, *spans)
+            for pos, chain_res, k in deferred.resolve(at_eof):
+                yield (
+                    pos,
+                    *(
+                        np.asarray(getattr(chain_res, f))[k: k + 1]
+                        for f in fields
+                    ),
+                )
             windows += 1
             if self.progress is not None:
                 self.progress(windows, base + own_end, self.total)
         assert not len(deferred), "pendings must resolve by EOF"
+
+    def spans(self) -> Iterator[tuple[int, np.ndarray]]:
+        """Yield ``(base, verdict)`` spans; see the module contract."""
+        yield from self._stream(("verdict",), defer_inexact=False)
 
     def count_reads(self) -> int:
         """Record count (the count-reads workload).
@@ -357,6 +393,21 @@ class StreamChecker:
             int(v[max(he - b, 0):].sum()) for b, v in self.spans()
         )
 
+    def full_spans(self) -> Iterator[tuple[int, "np.ndarray", "np.ndarray"]]:
+        """Yield ``(base, fail_mask, reads_before)`` spans tiling the file —
+        the streaming face of the *full* checker (all 19 flags per position;
+        reference full/Checker.scala:17-198) in O(window) memory.
+
+        Exactness discipline: owned lanes whose masks may be incomplete
+        (escaped chains or buffer-edge-inexact failures) defer through the
+        same side buffer as ``spans()`` — and stay deferred until a re-check
+        is fully *exact* — then re-emit as 1-position spans (their slot in
+        the covering span carries mask 0 / reads_before 0).
+        """
+        yield from self._stream(
+            ("fail_mask", "reads_before"), defer_inexact=True
+        )
+
     def record_starts(self) -> Iterator[np.ndarray]:
         """Absolute flat offsets of record starts, one array per span, in
         stream order (deferred resolutions may append out of order)."""
@@ -366,6 +417,71 @@ class StreamChecker:
             idx = idx[idx >= he]
             if len(idx):
                 yield idx
+
+
+def full_check_summary_streaming(
+    path,
+    config: Config = Config(),
+    window_uncompressed: int | None = None,
+    halo: int | None = None,
+    use_device: bool = True,
+    progress: Callable[[int, int, int], None] | None = None,
+) -> dict:
+    """The full-check workload's aggregations at arbitrary scale: per-flag
+    totals, considered-position count, and the critical (exactly one check
+    failed) / two-check positions with their masks — computed from
+    ``full_spans`` in O(window) memory (reference FullCheck.scala:112-417;
+    BASELINE.json config "full-check split-point scan … all candidate
+    offsets"). The CLI's in-memory path keeps the golden-output report for
+    fixture-sized files; this is the WGS-scale library face.
+    """
+    from spark_bam_tpu.check.flags import (
+        FLAG_NAMES,
+        considered_mask,
+        num_failing_fields,
+    )
+
+    checker = StreamChecker(
+        path, config, window_uncompressed, halo, use_device, progress
+    )
+    per_flag = np.zeros(len(FLAG_NAMES), dtype=np.int64)
+    considered_total = 0
+    crit_pos: list[np.ndarray] = []
+    crit_mask: list[np.ndarray] = []
+    two_pos: list[np.ndarray] = []
+    two_mask: list[np.ndarray] = []
+    for base, fm, rb in checker.full_spans():
+        considered = considered_mask(fm, rb)
+        considered_total += int(considered.sum())
+        masked = fm[considered]
+        for i in range(len(FLAG_NAMES)):
+            per_flag[i] += int(((masked >> i) & 1).sum())
+        nf = num_failing_fields(fm, rb)
+        ones = np.flatnonzero(considered & (nf == 1))
+        twos = np.flatnonzero(considered & (nf == 2))
+        if len(ones):
+            crit_pos.append(base + ones)
+            crit_mask.append(fm[ones])
+        if len(twos):
+            two_pos.append(base + twos)
+            two_mask.append(fm[twos])
+
+    def cat(parts, dtype):
+        return (
+            np.concatenate(parts) if parts else np.empty(0, dtype=dtype)
+        )
+
+    return {
+        "per_flag": {
+            name: int(per_flag[i]) for i, name in enumerate(FLAG_NAMES)
+        },
+        "considered": considered_total,
+        "critical_positions": cat(crit_pos, np.int64),
+        "critical_masks": cat(crit_mask, np.int32),
+        "two_check_positions": cat(two_pos, np.int64),
+        "two_check_masks": cat(two_mask, np.int32),
+        "positions": checker.total,
+    }
 
 
 # ----------------------------------------------------------- module wrappers
