@@ -45,12 +45,31 @@ class FlatView:
         return int(self.block_flat[i]) + offset
 
     def pos_of_flat(self, flat: int) -> tuple[int, int]:
-        i = int(np.searchsorted(self.block_flat, flat, side="right")) - 1
-        return int(self.block_starts[i]), int(flat - self.block_flat[i])
+        return pos_of_flat_tables(self.block_starts, self.block_flat, flat)
 
     def pos_of_flat_many(self, flat: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
         idx = np.searchsorted(self.block_flat, flat, side="right") - 1
         return self.block_starts[idx], flat - self.block_flat[idx]
+
+
+def metas_block_table(metas) -> tuple[np.ndarray, np.ndarray]:
+    """(block_starts, block_flat) arrays for a Metadata list — the same
+    tables a FlatView carries, without inflating any payloads."""
+    block_starts = np.array([m.start for m in metas], dtype=np.int64)
+    usizes = np.array([m.uncompressed_size for m in metas], dtype=np.int64)
+    block_flat = np.zeros(len(metas), dtype=np.int64)
+    if len(metas):
+        np.cumsum(usizes[:-1], out=block_flat[1:])
+    return block_starts, block_flat
+
+
+def pos_of_flat_tables(
+    block_starts: np.ndarray, block_flat: np.ndarray, flat: int
+) -> tuple[int, int]:
+    """Flat offset → (block_pos, intra-block offset); the single source of
+    truth for the boundary convention (shared with FlatView.pos_of_flat)."""
+    i = int(np.searchsorted(block_flat, flat, side="right")) - 1
+    return int(block_starts[i]), int(flat - block_flat[i])
 
 
 def read_block_payload(ch: ByteChannel, meta: Metadata):

@@ -77,6 +77,16 @@ def record_starts_streaming(path, config: Config = Config()):
     yield from StreamChecker(path, config).record_starts()
 
 
+def stream_read_batches(path, config: Config = Config()):
+    """Columnar ``ReadBatch``es per streaming window: the load path in
+    O(window) host memory (WGS scale). Yields ``(abs_base, batch)``; a
+    final ``(-1, batch)`` carries records longer than the window lookahead,
+    decoded exactly from the seekable stream."""
+    from spark_bam_tpu.tpu.stream_check import StreamChecker
+
+    yield from StreamChecker(path, config).read_batches()
+
+
 def count_reads_tpu(path, config: Config = Config()) -> int:
     """count-reads via the streaming checker: O(window) host memory, device
     windows double-buffered, per-window counts reduced on device. This is
