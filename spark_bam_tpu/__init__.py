@@ -35,6 +35,9 @@ __all__ = [
     "load_reads_and_positions",
     "count_reads_tpu",
     "load_reads_columnar",
+    "record_starts_streaming",
+    "stream_read_batches",
+    "full_check_summary_streaming",
 ]
 
 _LOAD_API = {
@@ -45,7 +48,14 @@ _LOAD_API = {
     "load_splits_and_reads",
     "load_reads_and_positions",
 }
-_TPU_API = {"count_reads_tpu", "load_reads_columnar", "record_starts"}
+_TPU_API = {
+    "count_reads_tpu",
+    "load_reads_columnar",
+    "record_starts",
+    "record_starts_streaming",
+    "stream_read_batches",
+}
+_STREAM_API = {"full_check_summary_streaming"}
 
 
 def __getattr__(name):
@@ -58,4 +68,8 @@ def __getattr__(name):
         from spark_bam_tpu.load import tpu_load
 
         return getattr(tpu_load, name)
+    if name in _STREAM_API:
+        from spark_bam_tpu.tpu import stream_check
+
+        return getattr(stream_check, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
