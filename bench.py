@@ -62,6 +62,10 @@ JAX_CACHE_DIR = os.environ.get("SB_JAX_CACHE", "/tmp/spark_bam_jaxcache")
 # driver always gets its JSON line.
 CHILD_TIMEOUT_S = int(os.environ.get("SB_BENCH_CHILD_S", "900"))
 DEVICE_BUDGET_S = int(os.environ.get("SB_BENCH_BUDGET_S", "1800"))
+# A child that hasn't reached backend_ok by this point is stuck in tunnel
+# init (observed hanging for hours); kill it early instead of burning the
+# whole child budget.
+INIT_TIMEOUT_S = int(os.environ.get("SB_BENCH_INIT_S", "300"))
 E2E_TARGET_BYTES = int(os.environ.get("SB_BENCH_E2E_BYTES", str(1 << 30)))
 # CPU e2e baseline is measured on a capped prefix and reported as a rate
 # (the full file at CPU rates would dominate the bench's wall-clock).
@@ -392,20 +396,38 @@ def _run_cli_smoke(backend: str):
 # -------------------------------------------------------------------- parent
 
 def _run_child(args: list[str], timeout_s: int):
-    """Run a bench child; returns (results_by_leg, stages, err_str|None)."""
-    with tempfile.TemporaryFile(mode="w+") as out:
+    """Run a bench child; returns (results_by_leg, stages, err_str|None).
+
+    Kills the child early when backend init never completes (no
+    ``backend_ok`` stage within INIT_TIMEOUT_S) — a dead tunnel hangs
+    indefinitely and must not consume the whole budget.
+    """
+    with tempfile.NamedTemporaryFile(mode="w+") as out:
         proc = subprocess.Popen(
             [sys.executable, __file__, *args],
             stdout=out, stderr=subprocess.STDOUT,
             cwd=str(Path(__file__).resolve().parent),
         )
-        try:
-            rc = proc.wait(timeout=timeout_s)
-            timed_out = False
-        except subprocess.TimeoutExpired:
-            proc.kill()
-            proc.wait()
-            rc, timed_out = -9, True
+        deadline = time.monotonic() + timeout_s
+        init_deadline = time.monotonic() + min(INIT_TIMEOUT_S, timeout_s)
+        timed_out = False
+        backend_ok = False
+        while True:
+            try:
+                rc = proc.wait(timeout=5)
+                break
+            except subprocess.TimeoutExpired:
+                pass
+            now = time.monotonic()
+            if not backend_ok and now < init_deadline + 10:
+                backend_ok = (STAGE + "backend_ok") in Path(
+                    out.name
+                ).read_text(errors="replace")
+            if now >= deadline or (not backend_ok and now >= init_deadline):
+                proc.kill()
+                proc.wait()
+                rc, timed_out = -9, True
+                break
         out.seek(0)
         text = out.read()
     stages = [

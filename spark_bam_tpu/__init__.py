@@ -40,36 +40,31 @@ __all__ = [
     "full_check_summary_streaming",
 ]
 
-_LOAD_API = {
-    "load_bam",
-    "load_reads",
-    "load_sam",
-    "load_bam_intervals",
-    "load_splits_and_reads",
-    "load_reads_and_positions",
+# Lazy exports: the load API pulls in numpy/jax; keep `import spark_bam_tpu`
+# cheap. One name → providing-module table serves every lazily-bound symbol.
+_LAZY = {
+    **{
+        name: "spark_bam_tpu.load.api"
+        for name in (
+            "load_bam", "load_reads", "load_sam", "load_bam_intervals",
+            "load_splits_and_reads", "load_reads_and_positions",
+        )
+    },
+    **{
+        name: "spark_bam_tpu.load.tpu_load"
+        for name in (
+            "count_reads_tpu", "load_reads_columnar", "record_starts",
+            "record_starts_streaming", "stream_read_batches",
+        )
+    },
+    "full_check_summary_streaming": "spark_bam_tpu.tpu.stream_check",
 }
-_TPU_API = {
-    "count_reads_tpu",
-    "load_reads_columnar",
-    "record_starts",
-    "record_starts_streaming",
-    "stream_read_batches",
-}
-_STREAM_API = {"full_check_summary_streaming"}
 
 
 def __getattr__(name):
-    # Lazy: the load API pulls in numpy/jax; keep `import spark_bam_tpu` cheap.
-    if name in _LOAD_API:
-        from spark_bam_tpu.load import api
+    module = _LAZY.get(name)
+    if module is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
 
-        return getattr(api, name)
-    if name in _TPU_API:
-        from spark_bam_tpu.load import tpu_load
-
-        return getattr(tpu_load, name)
-    if name in _STREAM_API:
-        from spark_bam_tpu.tpu import stream_check
-
-        return getattr(stream_check, name)
-    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    return getattr(importlib.import_module(module), name)
