@@ -77,14 +77,83 @@ def record_starts_streaming(path, config: Config = Config()):
     yield from StreamChecker(path, config).record_starts()
 
 
-def stream_read_batches(path, config: Config = Config()):
+def _interval_table(header, loci: LociSet | str) -> np.ndarray:
+    """(R, 3) int32 rows of (ref_id, start, end) for the device filter."""
+    if isinstance(loci, str):
+        loci = LociSet.parse(loci, header.contig_lengths)
+    name_to_idx = {
+        name: idx for idx, (name, _) in header.contig_lengths.items()
+    }
+    rows = []
+    for contig, ivs in loci.intervals.items():
+        if contig not in name_to_idx:
+            continue
+        ref = name_to_idx[contig]
+        if not ivs:
+            ivs = [(0, header.contig_lengths[ref][1])]
+        rows.extend((ref, s, e) for s, e in ivs)
+    return np.array(rows or [(-2, 0, 0)], dtype=np.int32)
+
+
+def _apply_filter(
+    batch: ReadBatch,
+    header,
+    loci: LociSet | str | None,
+    flags_required: int,
+    flags_forbidden: int,
+) -> ReadBatch:
+    """Narrow a batch's ``valid`` mask by loci/flags (shared by the whole-
+    file and streaming loads). Flag-only filtering is a pure flag predicate
+    — unmapped reads pass unless a flag excludes them; only a loci filter
+    imposes the reference's unmapped-reads-never-overlap rule
+    (CanLoadBam.scala:109-133)."""
+    if loci is None:
+        flag = batch.columns["flag"]
+        ok = ((flag & flags_required) == flags_required) & (
+            (flag & flags_forbidden) == 0
+        )
+        batch.columns["valid"] = batch.columns["valid"] & ok
+        return batch
+    import jax.numpy as jnp
+
+    # Only the columns the device filter reads make the trip.
+    cols = {
+        k: jnp.asarray(batch.columns[k])
+        for k in ("pos", "ref_span", "ref_id", "flag", "valid")
+    }
+    mask = np.asarray(
+        interval_flag_filter(
+            cols, jnp.asarray(_interval_table(header, loci)),
+            jnp.int32(flags_required), jnp.int32(flags_forbidden),
+        )
+    )
+    batch.columns["valid"] = batch.columns["valid"] & mask
+    return batch
+
+
+def stream_read_batches(
+    path,
+    config: Config = Config(),
+    loci: LociSet | str | None = None,
+    flags_required: int = 0,
+    flags_forbidden: int = 0,
+):
     """Columnar ``ReadBatch``es per streaming window: the load path in
-    O(window) host memory (WGS scale). Yields ``(abs_base, batch)``; a
-    final ``(-1, batch)`` carries records longer than the window lookahead,
-    decoded exactly from the seekable stream."""
+    O(window) host memory (WGS scale), with interval/flag filters applied
+    on device per window. Yields ``(abs_base, batch)``; ``(-1, batch)``
+    entries carry records longer than the window lookahead, decoded exactly
+    from the seekable stream."""
     from spark_bam_tpu.tpu.stream_check import StreamChecker
 
-    yield from StreamChecker(path, config).read_batches()
+    checker = StreamChecker(path, config)
+    gen = checker.read_batches()
+    if loci is None and not flags_required and not flags_forbidden:
+        yield from gen
+        return
+    for base, batch in gen:
+        yield base, _apply_filter(
+            batch, checker.header, loci, flags_required, flags_forbidden
+        )
 
 
 def count_reads_tpu(path, config: Config = Config()) -> int:
@@ -104,46 +173,10 @@ def load_reads_columnar(
     config: Config = Config(),
 ) -> ReadBatch:
     """All records of a BAM as columnar arrays; filters applied on device."""
-    import jax.numpy as jnp
-
     result = record_starts(path, config)
     batch = parse_flat_records(result.view.data, result.starts)
     if loci is None and not flags_required and not flags_forbidden:
         return batch
-
-    header = result.header
-    if isinstance(loci, str):
-        loci = LociSet.parse(loci, header.contig_lengths)
-    rows = []
-    if loci is not None:
-        name_to_idx = {
-            name: idx for idx, (name, _) in header.contig_lengths.items()
-        }
-        for contig, ivs in loci.intervals.items():
-            if contig not in name_to_idx:
-                continue
-            ref = name_to_idx[contig]
-            if not ivs:
-                ivs = [(0, header.contig_lengths[ref][1])]
-            rows.extend((ref, s, e) for s, e in ivs)
-    else:
-        rows = [(-2, 0, 0)]  # loci unrestricted: match-all handled below
-    intervals = np.array(rows or [(-2, 0, 0)], dtype=np.int32)
-
-    cols = {k: jnp.asarray(v) for k, v in batch.columns.items()}
-    if loci is None:
-        # Flag-only filtering: run the interval test against a universal row.
-        intervals = np.array(
-            [[r, 0, 2**31 - 1] for r in range(len(header.contig_lengths))],
-            dtype=np.int32,
-        )
-    mask = np.asarray(
-        interval_flag_filter(
-            cols,
-            jnp.asarray(intervals),
-            jnp.int32(flags_required),
-            jnp.int32(flags_forbidden),
-        )
+    return _apply_filter(
+        batch, result.header, loci, flags_required, flags_forbidden
     )
-    batch.columns["valid"] = batch.columns["valid"] & mask
-    return batch

@@ -99,3 +99,60 @@ def test_stream_read_batches_longread_spills(tmp_path):
         all_pos.extend(batch["pos"].tolist())
     assert spilled > 0, "scenario must force spills (records > halo)"
     assert sorted(all_pos) == want_pos
+
+
+def test_stream_read_batches_interval_flag_filter(bam2):
+    """Per-window on-device interval filtering must agree with the
+    whole-file columnar load for the same loci."""
+    import numpy as np
+
+    from spark_bam_tpu.core.config import Config
+    from spark_bam_tpu.load.tpu_load import load_reads_columnar, stream_read_batches
+
+    loci = "1:13000-17000"
+    whole = load_reads_columnar(bam2, loci=loci)
+    cfg = Config(window_size=256 << 10, halo_size=64 << 10)
+    got_pos = []
+    for base, batch in stream_read_batches(bam2, cfg, loci=loci):
+        got_pos.extend(batch["pos"].tolist())
+    assert len(got_pos) == len(whole) > 0
+    np.testing.assert_array_equal(np.sort(got_pos), np.sort(whole["pos"]))
+
+
+def test_flag_only_filter_keeps_unmapped(tmp_path):
+    """Flag-only filtering is a pure flag predicate: unmapped reads must
+    pass unless a flag bit excludes them (no hidden interval semantics)."""
+    import numpy as np
+
+    from spark_bam_tpu.bam.header import BamHeader, ContigLengths
+    from spark_bam_tpu.bam.record import BamRecord
+    from spark_bam_tpu.bam.writer import write_bam
+    from spark_bam_tpu.core.pos import Pos
+    from spark_bam_tpu.load.tpu_load import load_reads_columnar
+
+    path = tmp_path / "mix.bam"
+    header = BamHeader(
+        ContigLengths({0: ("chr1", 1_000_000)}), Pos(0, 0), 0,
+        "@HD\tVN:1.6\n@SQ\tSN:chr1\tLN:1000000\n",
+    )
+
+    def records():
+        for i in range(20):
+            mapped = i % 2 == 0
+            dup = i % 4 == 1  # only unmapped reads get the dup bit here
+            flag = (0 if mapped else 4) | (0x400 if dup else 0)
+            yield BamRecord(
+                ref_id=0 if mapped else -1, pos=100 + i if mapped else -1,
+                mapq=60 if mapped else 0, bin=0, flag=flag,
+                next_ref_id=-1, next_pos=-1, tlen=0,
+                read_name=f"m{i}", cigar=[(20, 0)] if mapped else [],
+                seq="A" * 20, qual=bytes([30]) * 20,
+            )
+
+    write_bam(path, header, records())
+
+    batch = load_reads_columnar(path, flags_forbidden=0x400)
+    flags = batch["flag"]
+    # 20 reads − 5 duplicates (i % 4 == 1) = 15 survivors, incl. unmapped.
+    assert len(batch) == 15
+    assert int(((flags & 4) != 0).sum()) == 5  # unmapped non-dups retained
