@@ -152,15 +152,16 @@ def _child_device_all(window_mb: int, platform: str, iters: int,
 
     # ---- e2e count-reads through the production streaming path ----------
     if big_path:
+        quiet_pipeline = False
         try:
-            _run_stage_probe(window_mb, big_path)
+            quiet_pipeline = _run_stage_probe(window_mb, big_path)
         except Exception as e:
             _emit_stage(
                 "probe_error:"
                 + f"{type(e).__name__}: {e}"[:200].replace("\n", " ")
             )
         try:
-            _run_e2e_leg(window_mb, big_path, reads, backend)
+            _run_e2e_leg(window_mb, big_path, reads, backend, quiet_pipeline)
         except Exception as e:
             import traceback
 
@@ -260,12 +261,22 @@ def _run_stage_probe(window_mb: int, big_path: str):
         return rows
 
     run_shape(threads=1, depth=1)  # warm the page cache: un-confound the A/B
+    prod = run_shape(threads=8, depth=2)
+    quiet = run_shape(threads=1, depth=1)
     _emit_result("stage_probe", {
-        "production_shape": run_shape(threads=8, depth=2),
-        "quiet_shape": run_shape(threads=1, depth=1),
+        "production_shape": prod,
+        "quiet_shape": quiet,
         "window_mb": window_mb,
     })
     _emit_stage("probe_done")
+
+    def total(rows):
+        return sum(sum(r.values()) for r in rows)
+
+    # Host-thread contention verdict: if the quiet pipeline is ≥3× faster
+    # per window, run the e2e leg with it (the per-window inflate then
+    # serializes, which still beats a contended dispatch by a wide margin).
+    return total(quiet) * 3 < total(prod)
 
 
 def _run_pallas_probe(window_mb: int, backend: str):
@@ -324,7 +335,10 @@ def _run_pallas_probe(window_mb: int, backend: str):
     _emit_stage("pallas_done")
 
 
-def _run_e2e_leg(window_mb: int, big_path: str, reads: int, backend: str):
+def _run_e2e_leg(
+    window_mb: int, big_path: str, reads: int, backend: str,
+    quiet_pipeline: bool = False,
+):
     from spark_bam_tpu.core.config import Config
     from spark_bam_tpu.tpu.stream_check import StreamChecker
 
@@ -355,9 +369,13 @@ def _run_e2e_leg(window_mb: int, big_path: str, reads: int, backend: str):
     int(out["count"])
     _emit_stage("e2e_warm")
 
+    pipe_kw = {}
+    if quiet_pipeline:
+        _emit_stage("e2e_shape:quiet")
+        pipe_kw = {"pipeline_threads": 1, "pipeline_depth": 1}
     checker = StreamChecker(
         big_path, Config(), window_uncompressed=w - E2E_HALO, halo=E2E_HALO,
-        progress=progress,
+        progress=progress, **pipe_kw,
     )
     t0 = time.perf_counter()
     count = checker.count_reads()

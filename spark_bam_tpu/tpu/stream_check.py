@@ -80,6 +80,8 @@ class StreamChecker:
         halo: int | None = None,
         use_device: bool = True,
         progress: Callable[[int, int, int], None] | None = None,
+        pipeline_threads: int | None = None,
+        pipeline_depth: int | None = None,
     ):
         self.path = path
         self.config = config
@@ -94,9 +96,14 @@ class StreamChecker:
         # The halo must leave room to advance; chains needing more lookahead
         # than the halo escape to the deferral path and still resolve exactly.
         self.halo = min(halo, fresh // 2)
+        pipe_kw = {}
+        if pipeline_threads is not None:
+            pipe_kw["threads"] = pipeline_threads
+        if pipeline_depth is not None:
+            pipe_kw["depth"] = pipeline_depth
         self.pipeline = InflatePipeline(
             path, window_uncompressed=fresh,
-            device_copy=config.device_inflate,
+            device_copy=config.device_inflate, **pipe_kw,
         )
         self.total = self.pipeline.total
         # Kernel shape: one power of two covering carry + window, clamped to

@@ -1,0 +1,58 @@
+"""Shared randomized-BAM generator for the fuzz suites."""
+
+import numpy as np
+
+from spark_bam_tpu.bam.header import BamHeader, ContigLengths
+from spark_bam_tpu.bam.index_records import index_records
+from spark_bam_tpu.bam.record import BamRecord
+from spark_bam_tpu.bam.writer import write_bam
+from spark_bam_tpu.core.pos import Pos
+
+
+def random_bam(
+    path,
+    seed: int,
+    contigs=(("chr1", 10_000_000), ("chr2", 5_000_000)),
+    n_records=(150, 400),
+    read_len=(10, 3000),
+    mapped_rate: float = 0.8,
+    dup_rate: float = 0.0,
+    pos_step=(1, 900),
+    block_payload=(2000, 40000),
+    index: bool = False,
+):
+    """Write a randomized (but structurally valid) BAM; returns the header
+    SAM text's contig count for convenience."""
+    rng = np.random.default_rng(seed)
+    sam = "@HD\tVN:1.6\n" + "".join(
+        f"@SQ\tSN:{name}\tLN:{ln}\n" for name, ln in contigs
+    )
+    header = BamHeader(
+        ContigLengths({i: c for i, c in enumerate(contigs)}), Pos(0, 0), 0, sam
+    )
+
+    def records():
+        pos = 5
+        for i in range(int(rng.integers(*n_records))):
+            n = int(rng.integers(*read_len))
+            mapped = rng.random() < mapped_rate
+            flag = (0 if mapped else 4) | (
+                0x400 if rng.random() < dup_rate else 0
+            )
+            yield BamRecord(
+                ref_id=int(rng.integers(0, len(contigs))) if mapped else -1,
+                pos=pos if mapped else -1,
+                mapq=int(rng.integers(0, 61)), bin=0, flag=flag,
+                next_ref_id=-1, next_pos=-1, tlen=0,
+                read_name=f"f{seed}_{i}",
+                cigar=[(n, 0)] if mapped else [],
+                seq="".join(rng.choice(list("ACGT"), n)),
+                qual=bytes(rng.integers(5, 40, n, dtype=np.uint8)),
+            )
+            pos += int(rng.integers(*pos_step))
+
+    write_bam(
+        path, header, records(), block_payload=int(rng.integers(*block_payload))
+    )
+    if index:
+        index_records(path)

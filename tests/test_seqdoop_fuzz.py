@@ -35,39 +35,14 @@ PAYLOADS_2BAM = (50_021, 17_389, 4_999)
 
 
 def _random_bam(path, seed: int, n_records: int = 400):
-    from spark_bam_tpu.bam.header import BamHeader, ContigLengths
-    from spark_bam_tpu.bam.record import BamRecord
-    from spark_bam_tpu.bam.writer import write_bam
-    from spark_bam_tpu.core.pos import Pos
+    from tests.bam_factories import random_bam
 
-    rng = np.random.default_rng(seed)
-    contigs = ContigLengths({0: ("chr1", 10_000_000), 1: ("chr2", 5_000_000)})
-    header = BamHeader(
-        contigs, Pos(0, 0), 0,
-        "@HD\tVN:1.6\n@SQ\tSN:chr1\tLN:10000000\n@SQ\tSN:chr2\tLN:5000000\n",
+    random_bam(
+        path, seed,
+        n_records=(n_records, n_records + 1),
+        read_len=(20, 400), mapped_rate=0.9, pos_step=(1, 500),
+        block_payload=(3000, 60000), index=True,
     )
-
-    def records():
-        pos = 10
-        for i in range(n_records):
-            n = int(rng.integers(20, 400))
-            ref = int(rng.integers(0, 2))
-            mapped = rng.random() < 0.9
-            flag = 0 if mapped else 4
-            yield BamRecord(
-                ref_id=ref if mapped else -1,
-                pos=pos if mapped else -1,
-                mapq=int(rng.integers(0, 61)), bin=0, flag=flag,
-                next_ref_id=-1, next_pos=-1, tlen=0,
-                read_name=f"r{seed}_{i}",
-                cigar=[(n, 0)] if mapped else [],
-                seq="".join(rng.choice(list("ACGT"), n)),
-                qual=bytes(rng.integers(10, 40, n, dtype=np.uint8)),
-            )
-            pos += int(rng.integers(1, 500))
-
-    write_bam(path, header, records(), block_payload=int(rng.integers(3000, 60000)))
-    index_records(path)
 
 
 def _generate(tmp_path, bam1, bam2):

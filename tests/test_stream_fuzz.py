@@ -10,53 +10,21 @@ bit-for-bit.
 import numpy as np
 import pytest
 
-from spark_bam_tpu.bam.header import BamHeader, ContigLengths, read_header
-from spark_bam_tpu.bam.record import BamRecord
-from spark_bam_tpu.bam.writer import write_bam
+from spark_bam_tpu.bam.header import read_header
 from spark_bam_tpu.bgzf.flat import flatten_file
 from spark_bam_tpu.check.vectorized import check_flat
 from spark_bam_tpu.core.config import Config
-from spark_bam_tpu.core.pos import Pos
 from spark_bam_tpu.tpu.stream_check import StreamChecker
 
+from tests.bam_factories import random_bam
+
 CFG = dict(window_uncompressed=128 << 10, halo=32 << 10)
-
-
-def _random_bam(path, seed: int):
-    rng = np.random.default_rng(seed)
-    header = BamHeader(
-        ContigLengths({0: ("chr1", 5_000_000), 1: ("chr2", 3_000_000)}),
-        Pos(0, 0), 0,
-        "@HD\tVN:1.6\n@SQ\tSN:chr1\tLN:5000000\n@SQ\tSN:chr2\tLN:3000000\n",
-    )
-
-    def records():
-        pos = 5
-        for i in range(int(rng.integers(150, 400))):
-            n = int(rng.integers(10, 3000))
-            mapped = rng.random() < 0.8
-            flag = (0 if mapped else 4) | (0x400 if rng.random() < 0.1 else 0)
-            yield BamRecord(
-                ref_id=int(rng.integers(0, 2)) if mapped else -1,
-                pos=pos if mapped else -1,
-                mapq=int(rng.integers(0, 61)), bin=0, flag=flag,
-                next_ref_id=-1, next_pos=-1, tlen=0,
-                read_name=f"f{seed}_{i}",
-                cigar=[(n, 0)] if mapped else [],
-                seq="".join(rng.choice(list("ACGT"), n)),
-                qual=bytes(rng.integers(5, 40, n, dtype=np.uint8)),
-            )
-            pos += int(rng.integers(1, 900))
-
-    write_bam(
-        path, header, records(), block_payload=int(rng.integers(2000, 40000))
-    )
 
 
 @pytest.mark.parametrize("seed", range(5))
 def test_streaming_projections_match_whole_file(tmp_path, seed):
     path = tmp_path / f"fuzz{seed}.bam"
-    _random_bam(path, seed)
+    random_bam(path, seed, contigs=(("chr1", 5_000_000), ("chr2", 3_000_000)), dup_rate=0.1)
 
     flat = flatten_file(path)
     hdr = read_header(path)
