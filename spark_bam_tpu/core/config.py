@@ -84,7 +84,13 @@ class Config:
     device_inflate: bool = False
     # --- misc ---
     warn: bool = False                  # root log-level toggle (args/LogArgs.scala:30-33)
-    post_partition_size: int = 100_000  # PostPartitionArgs default (args/PostPartitionArgs.scala:38-43)
+    # Accepted for config-surface parity (PostPartitionArgs -p, default
+    # 100000, args/PostPartitionArgs.scala:38-43) but intentionally inert:
+    # the reference repartitions its filtered-calls RDD so annotation work
+    # balances across executors; here disagreement positions are a host
+    # array and annotation is vectorized, so there is no partition count to
+    # tune. Kept so reference invocations parse unchanged.
+    post_partition_size: int = 100_000
 
     CHECK_SPLIT_SIZE_DEFAULT = 2 << 20  # Blocks.scala:64
     LOAD_SPLIT_SIZE_DEFAULT = 32 << 20  # hadoop FileSplits default in the load path
