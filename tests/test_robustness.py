@@ -79,3 +79,35 @@ def test_dataset_map_filter(bam2):
     assert mapped.count() == 2500
     unmapped_only = ds.filter(lambda r: r.is_unmapped)
     assert unmapped_only.count() == 50  # 2500 reads, 50 unmapped
+
+
+def test_streaming_count_truncated_mid_block_errors_cleanly(bam2, tmp_path):
+    """A BAM cut mid-block must raise a clean EOFError from the streaming
+    path (reference HeaderParseException/EOF semantics), never hang or
+    return a partial count as if complete."""
+    import pytest as _pytest
+
+    from spark_bam_tpu.tpu.stream_check import count_reads_streaming
+
+    data = bam2.read_bytes()
+    t = tmp_path / "mid.bam"
+    t.write_bytes(data[: len(data) // 2 + 137])
+    with _pytest.raises(EOFError):
+        count_reads_streaming(t)
+
+
+def test_streaming_count_truncated_at_block_boundary_counts_prefix(
+    bam2, tmp_path
+):
+    """Truncation exactly at a block boundary (no EOF sentinel) behaves as
+    a shorter file: the records present are counted (the reference's
+    tolerant stream-end semantics)."""
+    from spark_bam_tpu.bgzf.index_blocks import blocks_metadata
+    from spark_bam_tpu.tpu.stream_check import count_reads_streaming
+
+    data = bam2.read_bytes()
+    metas = list(blocks_metadata(bam2))
+    t = tmp_path / "edge.bam"
+    t.write_bytes(data[: metas[15].start])
+    n = count_reads_streaming(t)
+    assert 0 < n < 2500  # a strict prefix of the 2500 reads
