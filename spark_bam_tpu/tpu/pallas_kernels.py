@@ -1,12 +1,6 @@
 """Pallas TPU kernels for the checker hot path.
 
-Two kernels, both verified bit-exact against the engines they mirror:
-
-``field_check_kernel`` — the fusion seed: per-position field extraction +
-the 9 bounded-neighborhood checks in one VMEM-tiled kernel (each grid step
-DMAs a (TILE + halo) byte slab, derives the little-endian i32 views
-in-register, and emits the partial flag bitmask with no HBM round-trips in
-between).
+One kernel, verified bit-exact against the engines it mirrors:
 
 ``full_flags_kernel`` — ALL 19 flag bits of the checker error model
 (check/flags.py; reference full/Checker.scala:17-198) computed in-kernel,
@@ -47,24 +41,8 @@ from jax.experimental.pallas import tpu as pltpu
 from spark_bam_tpu.check.flags import BIT
 
 TILE = 32 * 1024
-# Lookahead for the 36-byte fixed fields; 1024 (not 40) because Mosaic
-# requires 1-D uint8 DMA slice sizes aligned to its 1024-element tiling.
-HALO = 1024
 
 _I32 = jnp.int32
-
-# Bits this kernel produces (the 36-byte-neighborhood checks).
-# (tooLargeReadPos/tooLargeNextReadPos need a contig-length gather, which
-# Mosaic only supports in 2D — those two bits stay in the XLA flag pass.)
-FIELD_CHECK_BITS = (
-    BIT["negativeReadIdx"] | BIT["tooLargeReadIdx"]
-    | BIT["negativeReadPos"]
-    | BIT["negativeNextReadIdx"] | BIT["tooLargeNextReadIdx"]
-    | BIT["negativeNextReadPos"]
-    | BIT["tooFewRemainingBytesImplied"]
-    | BIT["noReadName"] | BIT["emptyReadName"]
-)
-
 
 def _i32_at(tile: jnp.ndarray, off: int, n: int) -> jnp.ndarray:
     u = (
@@ -74,62 +52,6 @@ def _i32_at(tile: jnp.ndarray, off: int, n: int) -> jnp.ndarray:
         | (tile[off + 3: off + n + 3].astype(jnp.uint32) << 24)
     )
     return lax.bitcast_convert_type(u, jnp.int32)
-
-
-def _field_check_kernel(p_hbm, lengths_ref, nc_ref, out_ref, slab, sem):
-    # Manually DMA an overlapping (TILE + HALO) slab: BlockSpec tiling can't
-    # express overlap, so the byte buffer stays unblocked and each grid step
-    # fetches its slab into VMEM scratch.
-    i = pl.program_id(0)
-    copy = pltpu.make_async_copy(
-        p_hbm.at[pl.ds(i * TILE, TILE + HALO)], slab, sem
-    )
-    copy.start()
-    copy.wait()
-    tile = slab[...]
-    n = TILE
-    remaining = _i32_at(tile, 0, n)
-    ref_idx = _i32_at(tile, 4, n)
-    ref_pos = _i32_at(tile, 8, n)
-    name_len = tile[12: n + 12].astype(_I32)
-    fnc = _i32_at(tile, 16, n)
-    n_cigar = fnc & 0xFFFF
-    seq_len = _i32_at(tile, 20, n)
-    next_ref_idx = _i32_at(tile, 24, n)
-    next_ref_pos = _i32_at(tile, 28, n)
-
-    c = nc_ref[0]
-
-    def ref_bits(idx, pos, b_neg_idx, b_large_idx, b_neg_pos):
-        neg_idx = idx < -1
-        large_idx = (~neg_idx) & (idx >= c)
-        neg_pos = pos < -1
-        return (
-            jnp.where(neg_idx, _I32(b_neg_idx), _I32(0))
-            | jnp.where(large_idx, _I32(b_large_idx), _I32(0))
-            | jnp.where(neg_pos, _I32(b_neg_pos), _I32(0))
-        )
-
-    F = ref_bits(
-        ref_idx, ref_pos,
-        BIT["negativeReadIdx"], BIT["tooLargeReadIdx"], BIT["negativeReadPos"],
-    )
-    F = F | ref_bits(
-        next_ref_idx, next_ref_pos,
-        BIT["negativeNextReadIdx"], BIT["tooLargeNextReadIdx"],
-        BIT["negativeNextReadPos"],
-    )
-
-    t = seq_len + _I32(1)
-    half = lax.div(t, _I32(2))
-    rhs = _I32(32) + name_len + _I32(4) * n_cigar + half + seq_len
-    F = F | jnp.where(
-        remaining < rhs, _I32(BIT["tooFewRemainingBytesImplied"]), _I32(0)
-    )
-    F = F | jnp.where(name_len == 0, _I32(BIT["noReadName"]), _I32(0))
-    F = F | jnp.where(name_len == 1, _I32(BIT["emptyReadName"]), _I32(0))
-
-    out_ref[...] = F
 
 
 # ----------------------------------------------------- full 19-bit kernel
@@ -304,31 +226,3 @@ def full_check_flags(
         ],
         interpret=interpret,
     )(padded, lengths, num_contigs, n)
-
-
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def field_check_flags(
-    padded: jnp.ndarray,   # (W + HALO,) uint8, W a multiple of TILE
-    lengths: jnp.ndarray,  # (Cmax,) int32
-    num_contigs: jnp.ndarray,  # (1,) int32
-    interpret: bool = False,
-):
-    w = padded.shape[0] - HALO
-    assert w % TILE == 0, "window must be a multiple of the tile size"
-    grid = (w // TILE,)
-    return pl.pallas_call(
-        _field_check_kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec(memory_space=pl.ANY),     # bytes stay in HBM; DMA'd
-            pl.BlockSpec(memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-        ],
-        out_specs=pl.BlockSpec((TILE,), lambda i: (i,)),
-        out_shape=jax.ShapeDtypeStruct((w,), jnp.int32),
-        scratch_shapes=[
-            pltpu.VMEM((TILE + HALO,), jnp.uint8),
-            pltpu.SemaphoreType.DMA,
-        ],
-        interpret=interpret,
-    )(padded, lengths, num_contigs)

@@ -1,45 +1,12 @@
-"""Pallas field-check kernel vs the NumPy engine (interpret mode on CPU)."""
+"""Pallas full flag kernel: parity, wiring, CLI reachability."""
 
 import numpy as np
-import pytest
 
 import jax.numpy as jnp
 
 from spark_bam_tpu.bam.header import contig_lengths
 from spark_bam_tpu.bgzf.flat import flatten_file
-from spark_bam_tpu.check.vectorized import compute_flags
-from spark_bam_tpu.tpu.pallas_kernels import (
-    FIELD_CHECK_BITS,
-    HALO,
-    TILE,
-    field_check_flags,
-)
-
-
-def test_field_check_kernel_matches_numpy(bam2):
-    flat = flatten_file(bam2)
-    lens_list = contig_lengths(bam2).lengths_list()
-    lengths = np.zeros(128, dtype=np.int32)
-    lengths[: len(lens_list)] = lens_list
-
-    w = 4 * TILE
-    padded = np.zeros(w + HALO, dtype=np.uint8)
-    padded[:w] = flat.data[:w]
-
-    got = np.asarray(
-        field_check_flags(
-            jnp.asarray(padded),
-            jnp.asarray(lengths),
-            jnp.asarray(np.array([len(lens_list)], dtype=np.int32)),
-            interpret=True,
-        )
-    )
-
-    # The NumPy engine on the *same* padded buffer (identical zero halo),
-    # restricted to the kernel's neighborhood-check bits.
-    ref = compute_flags(padded, np.array(lens_list, np.int32))
-    want = ref.F[:w] & FIELD_CHECK_BITS
-    np.testing.assert_array_equal(got & FIELD_CHECK_BITS, want)
+from spark_bam_tpu.tpu.pallas_kernels import TILE
 
 
 def test_full_flags_kernel_matches_xla_flag_pass(bam2):

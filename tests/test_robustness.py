@@ -85,14 +85,12 @@ def test_streaming_count_truncated_mid_block_errors_cleanly(bam2, tmp_path):
     """A BAM cut mid-block must raise a clean EOFError from the streaming
     path (reference HeaderParseException/EOF semantics), never hang or
     return a partial count as if complete."""
-    import pytest as _pytest
-
     from spark_bam_tpu.tpu.stream_check import count_reads_streaming
 
     data = bam2.read_bytes()
     t = tmp_path / "mid.bam"
     t.write_bytes(data[: len(data) // 2 + 137])
-    with _pytest.raises(EOFError):
+    with pytest.raises(EOFError):
         count_reads_streaming(t)
 
 
