@@ -1,0 +1,45 @@
+"""Observability helpers (SURVEY §5 tracing/heartbeat subsystem)."""
+
+import logging
+import time
+
+from spark_bam_tpu.utils.timer import Timer, heartbeat, profile_trace
+
+
+def test_timer_measures_and_echoes():
+    lines = []
+    with Timer("stage", echo=lines.append) as t:
+        time.sleep(0.02)
+    assert t.ms >= 15
+    assert lines == [f"stage: {t.ms}ms"]
+
+    # No name ⇒ silent even with an echo sink.
+    lines.clear()
+    with Timer(echo=lines.append):
+        pass
+    assert lines == []
+
+
+def test_heartbeat_rate_limits(caplog):
+    with caplog.at_level(logging.INFO, logger="spark_bam_tpu.utils.timer"):
+        with heartbeat("indexing", interval_seconds=0.05) as beat:
+            beat("p0")          # within the first interval: suppressed
+            time.sleep(0.06)
+            beat("p1")          # logged
+            beat("p2")          # suppressed again
+    messages = [r.getMessage() for r in caplog.records]
+    assert messages == ["indexing: p1"]
+
+
+def test_profile_trace_noop_and_enabled(tmp_path, monkeypatch):
+    monkeypatch.delenv("SPARK_BAM_PROFILE_DIR", raising=False)
+    with profile_trace("t"):
+        pass  # no-op path
+
+    monkeypatch.setenv("SPARK_BAM_PROFILE_DIR", str(tmp_path))
+    import jax.numpy as jnp
+
+    with profile_trace("t"):
+        jnp.zeros(8).block_until_ready()
+    # A trace directory with profiler artifacts must exist.
+    assert any((tmp_path / "t").rglob("*")), "no profiler artifacts written"
