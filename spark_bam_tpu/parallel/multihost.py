@@ -172,6 +172,7 @@ def run_worker_bam(
     local_devices: int = 0,
     row_bytes: int = 8 << 20,
     halo: int = 4 << 20,
+    chunk_bytes: int = 192 << 20,
 ) -> dict:
     """Real-data multi-host count-reads: each process inflates only its own
     block-range shard of ``path`` (seam halos stitched host-side from the
@@ -239,44 +240,63 @@ def run_worker_bam(
         [[0], np.cumsum([len(g) for g in groups])[:-1]]
     ).astype(np.int64)
 
-    my_rows = range(process_id * per_proc, (process_id + 1) * per_proc)
-    windows = np.zeros((per_proc, w + PAD), dtype=np.uint8)
-    ns = np.zeros(per_proc, dtype=np.int32)
-    eofs = np.zeros(per_proc, dtype=bool)
-    los = np.zeros(per_proc, dtype=np.int32)
-    owns = np.zeros(per_proc, dtype=np.int32)
-    with open_channel(path) as ch:
-        for j, g in enumerate(my_rows):
-            if g >= len(groups):
-                continue  # padding row
-            b0 = int(first_block_of_group[g])
-            # Extend with following blocks until the halo is covered.
-            b1 = b0 + len(groups[g])
-            extra = 0
-            while b1 < len(metas) and extra < halo:
-                extra += metas[b1].uncompressed_size
-                b1 += 1
-            view = inflate_blocks(ch, metas[b0:b1])
-            n = view.size
-            windows[j, :n] = view.data
-            ns[j] = n
-            eofs[j] = b1 == len(metas)  # buffer end == file end
-            own = n if b1 == len(metas) and g == len(groups) - 1 else sizes[g]
-            owns[j] = own
-            los[j] = min(max(header_end - int(flat_starts[g]), 0), own)
-
+    # Rows are processed in fixed-size chunks so host memory stays
+    # O(chunk), not O(shard): every process loops the same chunk count
+    # (per_proc is identical across processes), inflating lazily per chunk
+    # and accumulating the psum'd chunk totals host-side.
+    rows_per_chunk = n_local * max(
+        1, chunk_bytes // ((w + PAD) * max(n_local, 1))
+    )
+    if per_proc:
+        # Never allocate more padding rows than the shard has (per_proc is
+        # a multiple of n_local and identical across processes).
+        rows_per_chunk = min(rows_per_chunk, per_proc)
     shard = NamedSharding(mesh, P("data"))
     repl = NamedSharding(mesh, P())
-    args = [
-        jax.make_array_from_process_local_data(shard, a)
-        for a in (windows, ns, eofs, los, owns)
-    ]
     lengths_d = jax.device_put(lengths, repl)
-
     step = make_shard_map_count_step(mesh)
-    totals = np.asarray(
-        step(*args, lengths_d, jnp.int32(len(lens_list)))
-    )
+
+    totals = np.zeros(2, dtype=np.int64)
+    with open_channel(path) as ch:
+        for c0 in range(0, per_proc, rows_per_chunk):
+            # The final chunk keeps the full shape (trailing padding rows):
+            # every process must present identical shapes to the collective.
+            windows = np.zeros((rows_per_chunk, w + PAD), dtype=np.uint8)
+            ns = np.zeros(rows_per_chunk, dtype=np.int32)
+            eofs = np.zeros(rows_per_chunk, dtype=bool)
+            los = np.zeros(rows_per_chunk, dtype=np.int32)
+            owns = np.zeros(rows_per_chunk, dtype=np.int32)
+            for j in range(rows_per_chunk):
+                g = process_id * per_proc + c0 + j
+                if c0 + j >= per_proc or g >= len(groups):
+                    continue  # padding row (n=0, own=0 counts nothing)
+                b0 = int(first_block_of_group[g])
+                # Extend with following blocks until the halo is covered.
+                b1 = b0 + len(groups[g])
+                extra = 0
+                while b1 < len(metas) and extra < halo:
+                    extra += metas[b1].uncompressed_size
+                    b1 += 1
+                view = inflate_blocks(ch, metas[b0:b1])
+                n = view.size
+                windows[j, :n] = view.data
+                ns[j] = n
+                eofs[j] = b1 == len(metas)  # buffer end == file end
+                own = (
+                    n
+                    if b1 == len(metas) and g == len(groups) - 1
+                    else sizes[g]
+                )
+                owns[j] = own
+                los[j] = min(max(header_end - int(flat_starts[g]), 0), own)
+
+            args = [
+                jax.make_array_from_process_local_data(shard, a)
+                for a in (windows, ns, eofs, los, owns)
+            ]
+            totals += np.asarray(
+                step(*args, lengths_d, jnp.int32(len(lens_list)))
+            ).astype(np.int64)
     return {
         "mode": "bam",
         "path": str(path),
@@ -285,6 +305,7 @@ def run_worker_bam(
         "global_devices": n_global,
         "local_devices": n_local,
         "rows": len(groups),
+        "chunks": -(-per_proc // rows_per_chunk) if per_proc else 0,
         "count": int(totals[0]),
         "escaped": int(totals[1]),
         "ok": int(totals[1]) == 0,
@@ -309,11 +330,15 @@ def main(argv=None) -> int:
     ap.add_argument("--halo", type=int, default=4 << 20,
                     help="lookahead bytes per row; must exceed one "
                          "reads-to-check chain's span (--bam mode)")
+    ap.add_argument("--chunk-bytes", type=int, default=192 << 20,
+                    help="host window-buffer budget per step call "
+                         "(--bam mode; bounds host memory per chunk)")
     a = ap.parse_args(argv)
     if a.bam:
         stats = run_worker_bam(
             a.bam, a.coordinator, a.num_processes, a.process_id,
             a.local_devices, row_bytes=a.row_bytes, halo=a.halo,
+            chunk_bytes=a.chunk_bytes,
         )
     else:
         stats = run_worker(

@@ -74,6 +74,10 @@ def test_two_process_bam_count(tmp_path):
         "--coordinator", f"localhost:{port}",
         "--num-processes", "2", "--local-devices", "4",
         "--bam", str(bam),
+        # A tiny chunk budget forces several accumulate-psum chunks per
+        # process (the O(chunk) host-memory discipline under test).
+        "--row-bytes", str(1 << 20), "--halo", str(256 << 10),
+        "--chunk-bytes", str(8 << 20),
     ]
     p1_log = (tmp_path / "p1.log").open("w+")
     p1 = subprocess.Popen(
@@ -99,3 +103,6 @@ def test_two_process_bam_count(tmp_path):
     assert stats["global_devices"] == 8
     assert stats["escaped"] == 0
     assert stats["count"] == manifest["reads"]
+    # The tiny chunk budget must actually exercise the multi-chunk
+    # accumulate-psum loop, not collapse to one chunk.
+    assert stats["chunks"] >= 2, stats
