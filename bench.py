@@ -143,8 +143,29 @@ def _child_device_all(window_mb: int, platform: str, iters: int,
     out["verdict"].block_until_ready()
     transfer_pps = w / (time.perf_counter() - t0)
 
+    # The fused count kernel (what count-reads actually runs): same checks,
+    # scatter outputs DCE'd, owned-span count reduced on-chip. Guarded: a
+    # compile/OOM failure here must not discard the steady numbers above.
+    fused_pps = None
+    try:
+        from spark_bam_tpu.tpu.checker import make_count_window
+
+        fused = make_count_window(w, 10)
+        fo = fused(pd, ld, nc, jnp.int32(w), jnp.bool_(False), jnp.int32(0),
+                   jnp.int32(w))
+        int(fo["count"])
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            fo = fused(pd, ld, nc, jnp.int32(w), jnp.bool_(False),
+                       jnp.int32(0), jnp.int32(w))
+        int(fo["count"])
+        fused_pps = iters * w / (time.perf_counter() - t0)
+    except Exception as e:
+        _emit_stage("fused_error:" + f"{type(e).__name__}: {e}"[:200])
+
     _emit_result("steady", {
         "steady_pps": steady_pps,
+        "steady_fused_pps": fused_pps,
         "transfer_pps": transfer_pps,
         "backend": backend,
         "window_mb": window_mb,
@@ -655,6 +676,11 @@ def _main_measure(record, warnings, errors):
         record.update({
             "value": round(steady["steady_pps"]),
             "vs_baseline": round(steady["steady_pps"] / base, 2),
+            "steady_fused_count_pps": (
+                round(steady["steady_fused_pps"])
+                if steady["steady_fused_pps"] is not None
+                else None
+            ),
             "device_e2e_with_transfer_pps": round(steady["transfer_pps"]),
             "backend": steady["backend"],
             "window_mb": steady["window_mb"],
