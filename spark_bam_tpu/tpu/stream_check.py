@@ -28,11 +28,16 @@ Design (the double-buffered halo-carry loop):
 The span contract: ``spans()`` yields ``(base, verdict)`` pairs whose
 ``True`` positions are exactly the record starts of the file. Spans tile
 ``[0, total)`` in order, plus rare trailing 1-position spans for deferred
-candidates (whose slot in the covering span is ``False``).
+candidates (whose slot in the covering span is ``False``). The same
+window loop also projects ``full_spans()`` (all-19-flag masks — the
+full-check workload) and ``read_batches()`` (columnar parses with exact
+spill decode — the load workload).
 
-``count_reads()`` never materializes verdict arrays on host: each
-window's boundary count reduces on device and only two scalars cross the
-wire (reference workload: count-reads, docs/benchmarks.md:53-59).
+``count_reads()`` never materializes per-position arrays on host: each
+window runs one fused kernel whose owned-span count reduces on-chip, the
+scalars accumulate on device, and a handful of integers cross the wire
+per ~2^30 positions (reference workload: count-reads,
+docs/benchmarks.md:53-59).
 """
 
 from __future__ import annotations
