@@ -4,7 +4,6 @@
 from __future__ import annotations
 
 import time
-import traceback
 
 from spark_bam_tpu.cli.output import Printer
 from spark_bam_tpu.core.config import Config

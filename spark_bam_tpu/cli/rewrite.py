@@ -4,7 +4,6 @@ testing (reference cli/.../rewrite/HTSJDKRewrite.scala:347-418)."""
 
 from __future__ import annotations
 
-from spark_bam_tpu.bam.header import read_header
 from spark_bam_tpu.bam.index_records import index_records
 from spark_bam_tpu.bam.iterators import RecordStream
 from spark_bam_tpu.bam.writer import write_bam

@@ -8,7 +8,6 @@ cli/.../spark/LoadReads.scala:164-174, CanLoadBam.scala:262-274).
 
 from __future__ import annotations
 
-import os
 
 import numpy as np
 

@@ -12,7 +12,6 @@ class of failure the reference observes from hadoop-bam (CountReadsTest:
 from __future__ import annotations
 
 
-import numpy as np
 
 from spark_bam_tpu.bam.record import BamRecord
 from spark_bam_tpu.bgzf.find_block_start import find_block_start
