@@ -132,16 +132,35 @@ def _child_device_all(window_mb: int, platform: str, iters: int,
     out["verdict"].block_until_ready()
     _emit_stage("compiled")
 
+    # Probe one synced iteration first. A healthy chip runs 32 MB in ~300 µs;
+    # a congested tunnel has been observed at ≥45 s/dispatch — at that rate
+    # the full loop outlives the child budget with zero markers (the r4
+    # failure mode). Scale the loop to fit ~60 s and mark every iteration
+    # block so a stall is attributable.
     t0 = time.perf_counter()
-    for _ in range(iters):
-        out = kernel(pd, ld, nc, jnp.int32(w), jnp.bool_(False))
+    out = kernel(pd, ld, nc, jnp.int32(w), jnp.bool_(False))
     out["verdict"].block_until_ready()
-    steady_pps = iters * w / (time.perf_counter() - t0)
+    probe_s = time.perf_counter() - t0
+    _emit_stage(f"steady_probe:{probe_s:.3f}s")
+    iters_eff = max(1, min(iters, int(60.0 / max(probe_s, 1e-9))))
+    mark_every = max(1, iters_eff // 4)
+
+    t0 = time.perf_counter()
+    done = 0
+    while done < iters_eff:
+        n_it = min(mark_every, iters_eff - done)
+        for _ in range(n_it):
+            out = kernel(pd, ld, nc, jnp.int32(w), jnp.bool_(False))
+        out["verdict"].block_until_ready()
+        done += n_it
+        _emit_stage(f"steady_it:{done}/{iters_eff}")
+    steady_pps = done * w / (time.perf_counter() - t0)
 
     t0 = time.perf_counter()
     out = kernel(jnp.asarray(padded), ld, nc, jnp.int32(w), jnp.bool_(False))
     out["verdict"].block_until_ready()
     transfer_pps = w / (time.perf_counter() - t0)
+    _emit_stage("transfer_done")
 
     # The fused count kernel (what count-reads actually runs): same checks,
     # scatter outputs DCE'd, owned-span count reduced on-chip. Guarded: a
@@ -154,12 +173,13 @@ def _child_device_all(window_mb: int, platform: str, iters: int,
         fo = fused(pd, ld, nc, jnp.int32(w), jnp.bool_(False), jnp.int32(0),
                    jnp.int32(w))
         int(fo["count"])
+        _emit_stage("fused_compiled")
         t0 = time.perf_counter()
-        for _ in range(iters):
+        for _ in range(iters_eff):
             fo = fused(pd, ld, nc, jnp.int32(w), jnp.bool_(False),
                        jnp.int32(0), jnp.int32(w))
         int(fo["count"])
-        fused_pps = iters * w / (time.perf_counter() - t0)
+        fused_pps = iters_eff * w / (time.perf_counter() - t0)
     except Exception as e:
         _emit_stage("fused_error:" + f"{type(e).__name__}: {e}"[:200])
 
@@ -248,6 +268,14 @@ def _run_stage_probe(window_mb: int, big_path: str):
 
     metas = list(blocks_metadata(big_path))  # one scan for both shapes
 
+    # A degraded tunnel can take ~45 s per dispatch; six probe windows at
+    # that rate would consume the child budget before the e2e leg starts.
+    # Bound the whole probe and let the caller fall back to the default
+    # pipeline shape (the e2e projection guard handles a slow device).
+    probe_deadline = time.monotonic() + float(
+        os.environ.get("SB_BENCH_PROBE_S", "120")
+    )
+
     def run_shape(threads: int, depth: int):
         pipe = InflatePipeline(
             big_path, window_uncompressed=w - E2E_HALO,
@@ -256,6 +284,8 @@ def _run_stage_probe(window_mb: int, big_path: str):
         it = iter(pipe)
         rows = []
         for _ in range(3):
+            if time.monotonic() > probe_deadline:
+                raise TimeoutError("stage probe over budget")
             t0 = time.perf_counter()
             view = next(it)
             t1 = time.perf_counter()
