@@ -192,15 +192,27 @@ def _child_device_all(window_mb: int, platform: str, iters: int,
     })
 
     # ---- e2e count-reads through the production streaming path ----------
+    big_metas = None
     if big_path:
-        quiet_pipeline = False
         try:
-            quiet_pipeline = _run_stage_probe(window_mb, big_path)
+            from spark_bam_tpu.bgzf.index_blocks import blocks_metadata
+
+            big_metas = list(blocks_metadata(big_path))  # one scan, all probes
         except Exception as e:
+            # A failed scan must degrade like any probe failure — the e2e
+            # leg, CLI smoke, and Pallas probe still produce artifacts.
             _emit_stage(
-                "probe_error:"
-                + f"{type(e).__name__}: {e}"[:200].replace("\n", " ")
+                "metas_error:" + f"{type(e).__name__}: {e}"[:200].replace("\n", " ")
             )
+        quiet_pipeline = False
+        if big_metas is not None:
+            try:
+                quiet_pipeline = _run_stage_probe(window_mb, big_path, big_metas)
+            except Exception as e:
+                _emit_stage(
+                    "probe_error:"
+                    + f"{type(e).__name__}: {e}"[:200].replace("\n", " ")
+                )
         try:
             _run_e2e_leg(window_mb, big_path, reads, backend, quiet_pipeline)
         except Exception as e:
@@ -218,6 +230,18 @@ def _child_device_all(window_mb: int, platform: str, iters: int,
     except Exception as e:
         _emit_stage("cli_error:" + f"{type(e).__name__}: {e}"[:200])
 
+    # ---- device-inflate probe: the §7 device-DEFLATE deliverable's
+    # measurement — two-phase (host tokenize + device LZ77) vs host zlib on
+    # real windows of the big BAM. Evidence for the device_inflate config
+    # default, whichever way it lands. ------------------------------------
+    if backend == "tpu" and big_metas is not None:
+        try:
+            _run_inflate_probe(window_mb, big_path, big_metas)
+        except Exception as e:
+            _emit_stage(
+                "inflate_error:" + f"{type(e).__name__}: {e}"[:300].replace("\n", " ")
+            )
+
     # ---- Pallas on-TPU probe (last: compile risk must not cost the
     # artifacts above; VERDICT r3 item 4's on-TPU timing) ------------------
     if backend == "tpu":
@@ -229,7 +253,7 @@ def _child_device_all(window_mb: int, platform: str, iters: int,
             )
 
 
-def _run_stage_probe(window_mb: int, big_path: str):
+def _run_stage_probe(window_mb: int, big_path: str, metas: list):
     """Per-stage timing of 3 streaming windows, under two pipeline shapes.
 
     Diagnoses where e2e wall-clock goes (r3/r4 observed ~10 s/window vs a
@@ -263,10 +287,6 @@ def _run_stage_probe(window_mb: int, big_path: str):
         out["verdict"], out["escaped"], jnp.int32(0), jnp.int32(0)
     )
     int(c)
-
-    from spark_bam_tpu.bgzf.index_blocks import blocks_metadata
-
-    metas = list(blocks_metadata(big_path))  # one scan for both shapes
 
     # A degraded tunnel can take ~45 s per dispatch; six probe windows at
     # that rate would consume the child budget before the e2e leg starts.
@@ -328,6 +348,67 @@ def _run_stage_probe(window_mb: int, big_path: str):
     # per window, run the e2e leg with it (the per-window inflate then
     # serializes, which still beats a contended dispatch by a wide margin).
     return total(quiet) * 3 < total(prod)
+
+
+def _run_inflate_probe(window_mb: int, big_path: str, metas: list):
+    """Time two-phase device inflate (host entropy tokenize → device LZ77
+    pointer-doubling, tpu/inflate.py) against host-parallel zlib on the same
+    windows, asserting byte equality. Budgeted: a degraded tunnel aborts the
+    probe rather than eating the e2e/CLI artifacts' child budget."""
+    from spark_bam_tpu.bgzf.flat import inflate_blocks
+    from spark_bam_tpu.core.channel import open_channel
+    from spark_bam_tpu.tpu.inflate import inflate_group_device, window_plan
+
+    deadline = time.monotonic() + float(
+        os.environ.get("SB_BENCH_INFLATE_S", "120")
+    )
+    groups = window_plan(metas, window_mb << 20)[:3]
+    host_bytes = dev_bytes = measured = 0
+    host_s = dev_s = 0.0
+    equal = True
+    _emit_stage("inflate_probe")
+    with open_channel(big_path) as ch:
+        # Warm one group per distinct pow2 batch bucket: page cache, the
+        # native tokenizer, and the resolve_lz77 jit at every padded batch
+        # shape the timed windows will use (inflate_blocks_device pads the
+        # batch dim to the next power of two — a bucket not warmed here
+        # would pay a fresh XLA compile inside dev_s).
+        def bucket(g):
+            return max(len(g) - 1, 0).bit_length()
+
+        for b in sorted({bucket(g) for g in groups}):
+            g = next(g for g in groups if bucket(g) == b)
+            if inflate_group_device(ch, g) is None:
+                _emit_stage("inflate_skip:native tokenizer unavailable")
+                return
+        for g in groups:
+            if time.monotonic() > deadline:
+                break
+            t0 = time.perf_counter()
+            hv = inflate_blocks(ch, g, threads=8)
+            host_s += time.perf_counter() - t0
+            host_bytes += hv.size
+            t0 = time.perf_counter()
+            dv = inflate_group_device(ch, g)
+            dev_s += time.perf_counter() - t0
+            if dv is None:
+                _emit_stage("inflate_skip:device path demoted")
+                return
+            dev_bytes += dv.size
+            measured += 1
+            equal = equal and np.array_equal(hv.data, dv.data)
+    if not (host_bytes and dev_bytes):
+        _emit_stage("inflate_skip:over budget before first window")
+        return
+    _emit_result("device_inflate", {
+        "host_zlib_Bps": round(host_bytes / host_s),
+        "device_two_phase_Bps": round(dev_bytes / dev_s),
+        "device_vs_host": round((dev_bytes / dev_s) / (host_bytes / host_s), 3),
+        "windows": measured,
+        "window_mb": window_mb,
+        "equal": equal,
+    })
+    _emit_stage("inflate_done")
 
 
 def _run_pallas_probe(window_mb: int, backend: str):
@@ -812,6 +893,11 @@ def _main_measure(record, warnings, errors):
     cli = results.get("cli_smoke")
     if cli is not None:
         record["cli_smoke_ok"] = cli["ok"]
+    dinf = results.get("device_inflate")
+    if dinf is not None:
+        record["device_inflate_Bps"] = dinf["device_two_phase_Bps"]
+        record["device_inflate_vs_host_zlib"] = dinf["device_vs_host"]
+        record["device_inflate_equal"] = dinf["equal"]
     pallas = results.get("pallas")
     if pallas is not None:
         record["pallas_compiled_on_tpu"] = pallas["compiled_on_tpu"]
