@@ -91,6 +91,39 @@ def enable_compile_cache():
     _enable(JAX_CACHE_DIR)
 
 
+def _tiled_padded(flat, w: int) -> np.ndarray:
+    """The fixture tiled to fill a w-byte window, PAD-extended.
+
+    Keeps the historical fill rule (floor-division reps, zero tail when
+    flat.size does not divide w) so steady numbers stay comparable across
+    rounds."""
+    from spark_bam_tpu.tpu.checker import PAD
+
+    reps = max(1, w // flat.size)
+    buf = np.concatenate([flat.data] * reps)[:w]
+    padded = np.zeros(w + PAD, dtype=np.uint8)
+    padded[: len(buf)] = buf
+    return padded
+
+
+def _timed_fused_count(w: int, iters: int, pd, ld, nc, stage: str) -> float:
+    """Warm + time the fused count kernel at window ``w``; returns pps."""
+    import jax.numpy as jnp
+
+    from spark_bam_tpu.tpu.checker import make_count_window
+
+    fused = make_count_window(w, 10)
+    args = (pd, ld, nc, jnp.int32(w), jnp.bool_(False), jnp.int32(0),
+            jnp.int32(w))
+    int(fused(*args)["count"])
+    _emit_stage(stage)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        fo = fused(*args)
+    int(fo["count"])
+    return iters * w / (time.perf_counter() - t0)
+
+
 def _child_device_all(window_mb: int, platform: str, iters: int,
                       big_path: str, reads: int):
     """Steady + e2e + CLI smoke on one device, in ONE process."""
@@ -109,17 +142,14 @@ def _child_device_all(window_mb: int, platform: str, iters: int,
 
     from spark_bam_tpu.bam.header import contig_lengths
     from spark_bam_tpu.bgzf.flat import flatten_file
-    from spark_bam_tpu.tpu.checker import PAD, make_check_window
+    from spark_bam_tpu.tpu.checker import make_check_window
 
     # ---- steady-state + single-transfer kernel numbers ------------------
     flat = flatten_file(FIXTURE)
     lengths = np.array(contig_lengths(FIXTURE).lengths_list(), dtype=np.int32)
 
     w = window_mb << 20
-    reps = max(1, w // flat.size)
-    buf = np.concatenate([flat.data] * reps)[:w]
-    padded = np.zeros(w + PAD, dtype=np.uint8)
-    padded[: len(buf)] = buf
+    padded = _tiled_padded(flat, w)
 
     lens = np.zeros(1024, dtype=np.int32)
     lens[: len(lengths)] = lengths
@@ -167,19 +197,9 @@ def _child_device_all(window_mb: int, platform: str, iters: int,
     # compile/OOM failure here must not discard the steady numbers above.
     fused_pps = None
     try:
-        from spark_bam_tpu.tpu.checker import make_count_window
-
-        fused = make_count_window(w, 10)
-        fo = fused(pd, ld, nc, jnp.int32(w), jnp.bool_(False), jnp.int32(0),
-                   jnp.int32(w))
-        int(fo["count"])
-        _emit_stage("fused_compiled")
-        t0 = time.perf_counter()
-        for _ in range(iters_eff):
-            fo = fused(pd, ld, nc, jnp.int32(w), jnp.bool_(False),
-                       jnp.int32(0), jnp.int32(w))
-        int(fo["count"])
-        fused_pps = iters_eff * w / (time.perf_counter() - t0)
+        fused_pps = _timed_fused_count(
+            w, iters_eff, pd, ld, nc, stage="fused_compiled"
+        )
     except Exception as e:
         _emit_stage("fused_error:" + f"{type(e).__name__}: {e}"[:200])
 
@@ -214,7 +234,10 @@ def _child_device_all(window_mb: int, platform: str, iters: int,
                     + f"{type(e).__name__}: {e}"[:200].replace("\n", " ")
                 )
         try:
-            _run_e2e_leg(window_mb, big_path, reads, backend, quiet_pipeline)
+            _run_e2e_leg(
+                window_mb, big_path, reads, backend, quiet_pipeline,
+                metas=big_metas,
+            )
         except Exception as e:
             import traceback
 
@@ -251,6 +274,24 @@ def _child_device_all(window_mb: int, platform: str, iters: int,
             _emit_stage(
                 "pallas_error:" + f"{type(e).__name__}: {e}"[:300].replace("\n", " ")
             )
+
+    # ---- 64 MB fused-count viability probe (very last: the full kernel's
+    # 64 MB rung OOMs v5e HBM, but the count path DCEs the scatters — if
+    # it fits, the e2e leg can halve its dispatch count per byte on a
+    # tunnelled device). Compile risk and hang risk cost nothing here: all
+    # primary artifacts are already emitted. -----------------------------
+    if backend == "tpu" and window_mb < 64 and probe_s < 2.0:
+        try:
+            pd64 = jax.device_put(jnp.asarray(_tiled_padded(flat, 64 << 20)))
+            _emit_result("fused64", {
+                "fused64_pps": _timed_fused_count(
+                    64 << 20, 3, pd64, ld, nc, stage="fused64_compiled"
+                ),
+                "backend": backend,
+            })
+            del pd64
+        except Exception as e:
+            _emit_stage("fused64_error:" + f"{type(e).__name__}: {e}"[:200])
 
 
 def _run_stage_probe(window_mb: int, big_path: str, metas: list):
@@ -352,9 +393,11 @@ def _run_stage_probe(window_mb: int, big_path: str, metas: list):
 
 def _run_inflate_probe(window_mb: int, big_path: str, metas: list):
     """Time two-phase device inflate (host entropy tokenize → device LZ77
-    pointer-doubling, tpu/inflate.py) against host-parallel zlib on the same
-    windows, asserting byte equality. Budgeted: a degraded tunnel aborts the
-    probe rather than eating the e2e/CLI artifacts' child budget."""
+    pointer-doubling, tpu/inflate.py) against the production host inflate
+    path (``inflate_blocks`` — the native table-driven decoder when built,
+    zlib otherwise) on the same windows, asserting byte equality. Budgeted:
+    a degraded tunnel aborts the probe — including mid-warm-up — rather
+    than eating the e2e/CLI artifacts' child budget."""
     from spark_bam_tpu.bgzf.flat import inflate_blocks
     from spark_bam_tpu.core.channel import open_channel
     from spark_bam_tpu.tpu.inflate import inflate_group_device, window_plan
@@ -368,15 +411,18 @@ def _run_inflate_probe(window_mb: int, big_path: str, metas: list):
     equal = True
     _emit_stage("inflate_probe")
     with open_channel(big_path) as ch:
-        # Warm one group per distinct pow2 batch bucket: page cache, the
-        # native tokenizer, and the resolve_lz77 jit at every padded batch
-        # shape the timed windows will use (inflate_blocks_device pads the
-        # batch dim to the next power of two — a bucket not warmed here
-        # would pay a fresh XLA compile inside dev_s).
+        # Warm one group per distinct pow2 batch bucket: the native
+        # tokenizer and the resolve_lz77 jit at every padded batch shape
+        # the timed windows will use (inflate_blocks_device pads the batch
+        # dim to the next power of two — a bucket not warmed here would pay
+        # a fresh XLA compile inside dev_s).
         def bucket(g):
             return max(len(g) - 1, 0).bit_length()
 
         for b in sorted({bucket(g) for g in groups}):
+            if time.monotonic() > deadline:
+                _emit_stage("inflate_skip:over budget during warm-up")
+                return
             g = next(g for g in groups if bucket(g) == b)
             if inflate_group_device(ch, g) is None:
                 _emit_stage("inflate_skip:native tokenizer unavailable")
@@ -384,6 +430,11 @@ def _run_inflate_probe(window_mb: int, big_path: str, metas: list):
         for g in groups:
             if time.monotonic() > deadline:
                 break
+            # Pre-read the group's compressed span so both timed paths see
+            # a warm page cache (else the first path pays the disk I/O).
+            ch.read_at(
+                g[0].start, g[-1].start + g[-1].compressed_size - g[0].start
+            )
             t0 = time.perf_counter()
             hv = inflate_blocks(ch, g, threads=8)
             host_s += time.perf_counter() - t0
@@ -401,7 +452,7 @@ def _run_inflate_probe(window_mb: int, big_path: str, metas: list):
         _emit_stage("inflate_skip:over budget before first window")
         return
     _emit_result("device_inflate", {
-        "host_zlib_Bps": round(host_bytes / host_s),
+        "host_Bps": round(host_bytes / host_s),
         "device_two_phase_Bps": round(dev_bytes / dev_s),
         "device_vs_host": round((dev_bytes / dev_s) / (host_bytes / host_s), 3),
         "windows": measured,
@@ -473,7 +524,7 @@ class _ProjectedTimeout(Exception):
 
 def _run_e2e_leg(
     window_mb: int, big_path: str, reads: int, backend: str,
-    quiet_pipeline: bool = False,
+    quiet_pipeline: bool = False, metas: list | None = None,
 ):
     """The e2e leg with a projection guard: if, 16 windows in, the full
     file projects past the leg budget (slow-tunnel regime), abort and land
@@ -481,7 +532,9 @@ def _run_e2e_leg(
     nothing. The smaller file is still a complete whole-file count-reads
     with an exact manifest; ``e2e_file_bytes`` records what actually ran."""
     try:
-        _run_e2e_once(window_mb, big_path, reads, backend, quiet_pipeline)
+        _run_e2e_once(
+            window_mb, big_path, reads, backend, quiet_pipeline, metas=metas
+        )
         return
     except _ProjectedTimeout as e:
         _emit_stage(f"e2e_projection:{e.args[0]}")
@@ -507,7 +560,7 @@ def _run_e2e_leg(
 def _run_e2e_once(
     window_mb: int, big_path: str, reads: int, backend: str,
     quiet_pipeline: bool = False, scaled_from: str | None = None,
-    no_projection: bool = False,
+    no_projection: bool = False, metas: list | None = None,
 ):
     from spark_bam_tpu.core.config import Config
     from spark_bam_tpu.tpu.stream_check import StreamChecker
@@ -556,7 +609,7 @@ def _run_e2e_once(
         pipe_kw = {"pipeline_threads": 1, "pipeline_depth": 1}
     checker = StreamChecker(
         big_path, Config(), window_uncompressed=w - E2E_HALO, halo=E2E_HALO,
-        progress=progress, **pipe_kw,
+        progress=progress, metas=metas, **pipe_kw,
     )
     t0 = time.perf_counter()
     count = checker.count_reads()
@@ -794,6 +847,14 @@ def main():
     record["error"] = "; ".join(errors) if errors else None
     record["warnings"] = "; ".join(warnings) if warnings else None
     print(json.dumps(record))
+    # Every run (driver or opportunistic) appends to the in-repo history so
+    # captures from brief tunnel-attach windows accumulate automatically.
+    try:
+        hist = Path(__file__).resolve().parent / "BENCH_HISTORY.jsonl"
+        with open(hist, "a") as f:
+            f.write(json.dumps({"ts": time.time(), **record}) + "\n")
+    except OSError:
+        pass
 
 
 def _main_measure(record, warnings, errors):
@@ -893,10 +954,13 @@ def _main_measure(record, warnings, errors):
     cli = results.get("cli_smoke")
     if cli is not None:
         record["cli_smoke_ok"] = cli["ok"]
+    f64 = results.get("fused64")
+    if f64 is not None:
+        record["steady_fused64_count_pps"] = round(f64["fused64_pps"])
     dinf = results.get("device_inflate")
     if dinf is not None:
         record["device_inflate_Bps"] = dinf["device_two_phase_Bps"]
-        record["device_inflate_vs_host_zlib"] = dinf["device_vs_host"]
+        record["device_inflate_vs_host"] = dinf["device_vs_host"]
         record["device_inflate_equal"] = dinf["equal"]
     pallas = results.get("pallas")
     if pallas is not None:

@@ -87,6 +87,7 @@ class StreamChecker:
         progress: Callable[[int, int, int], None] | None = None,
         pipeline_threads: int | None = None,
         pipeline_depth: int | None = None,
+        metas: list | None = None,
     ):
         self.path = path
         self.config = config
@@ -106,9 +107,11 @@ class StreamChecker:
             pipe_kw["threads"] = pipeline_threads
         if pipeline_depth is not None:
             pipe_kw["depth"] = pipeline_depth
+        # ``metas``: reuse a caller's whole-file block-metadata scan (a
+        # header walk over every BGZF block — seconds on multi-GB files).
         self.pipeline = InflatePipeline(
             path, window_uncompressed=fresh,
-            device_copy=config.device_inflate, **pipe_kw,
+            device_copy=config.device_inflate, metas=metas, **pipe_kw,
         )
         self.total = self.pipeline.total
         # Kernel shape: one power of two covering carry + window, clamped to

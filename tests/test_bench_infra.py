@@ -1,0 +1,54 @@
+"""bench.py's parent-side plumbing: the pieces whose misbehavior has cost
+whole benchmark rounds (stage forensics, history append). Pure-host tests —
+no device work, no child processes."""
+
+import json
+
+import bench
+
+
+def test_forensics_no_windows():
+    assert bench._e2e_forensics(["start", "backend_ok:tpu", "compiled"]) == (
+        "no e2e window completed"
+    )
+
+
+def test_forensics_last_window():
+    stages = [
+        "e2e_plan",
+        "e2e_win:8:268435456:2883176122:41.2s",
+        "e2e_win:16:536870912:2883176122:83.9s",
+    ]
+    assert bench._e2e_forensics(stages) == (
+        "stalled after window 16, 536870912/2883176122 positions in 83.9s"
+    )
+
+
+def test_forensics_projection_abort():
+    stages = [
+        "e2e_win:8:268435456:2883176122:41.2s",
+        "e2e_projection:443s projected > 420s budget (4/395 in 4s)",
+    ]
+    out = bench._e2e_forensics(stages)
+    assert out.startswith(
+        "projection-aborted (443s projected > 420s budget (4/395 in 4s))"
+    )
+    assert "stalled after window 8" in out
+
+
+def test_history_append(tmp_path, monkeypatch, capsys):
+    """main() with a missing fixture still prints its one JSON line and
+    appends the same record to BENCH_HISTORY.jsonl next to bench.py."""
+    monkeypatch.setattr(bench, "FIXTURE", tmp_path / "nope.bam")
+    fake_file = tmp_path / "bench.py"
+    fake_file.write_text("")
+    monkeypatch.setattr(bench, "__file__", str(fake_file))
+    bench.main()
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    record = json.loads(line)
+    assert record["error"] == "fixture unavailable"
+    hist = (tmp_path / "BENCH_HISTORY.jsonl").read_text().strip().splitlines()
+    assert len(hist) == 1
+    entry = json.loads(hist[0])
+    assert entry["error"] == "fixture unavailable"
+    assert "ts" in entry
