@@ -38,6 +38,7 @@ __all__ = [
     "record_starts_streaming",
     "stream_read_batches",
     "full_check_summary_streaming",
+    "count_reads_sharded",
 ]
 
 # Lazy exports: the load API pulls in numpy/jax; keep `import spark_bam_tpu`
@@ -58,6 +59,7 @@ _LAZY = {
         )
     },
     "full_check_summary_streaming": "spark_bam_tpu.tpu.stream_check",
+    "count_reads_sharded": "spark_bam_tpu.parallel.stream_mesh",
 }
 
 

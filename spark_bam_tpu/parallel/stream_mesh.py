@@ -1,0 +1,155 @@
+"""Mesh-sharded streaming count-reads: one BAM across all chips.
+
+Bridges the two scale paths that already exist separately:
+
+- ``tpu/stream_check.StreamChecker`` — whole-file streaming in O(window)
+  host memory, single device;
+- ``parallel/mesh.make_shard_map_count_step`` — the mesh-partitioned
+  count unit (``lax.psum`` over ICI) that ``multihost.py`` feeds with
+  preassembled window rows.
+
+Here the host assembles consecutive halo-carried windows into a
+``(n_devices, W+PAD)`` batch per step — the same carry/ownership
+discipline as ``StreamChecker`` (each row's trailing ``halo`` is owned by
+the next row, so every owned position has full chain lookahead) — and
+every step runs one sharded kernel with the global count reduced on the
+mesh. This is the single-host multi-chip production path of the
+count-reads workload (reference docs/benchmarks.md:53-59; SURVEY.md §2.8
+maps file/block data-parallelism onto per-core batch pipelines, §2.9
+replaces Spark accumulators with ``psum``).
+
+Exactness: rows whose chains outrun the halo report escapes; any escape
+aborts the device pass and the file re-runs through ``StreamChecker``'s
+deferral-exact spans path (single device). On real data with the default
+halo this never triggers — same policy as ``StreamChecker.count_reads``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from spark_bam_tpu.bam.header import read_header
+from spark_bam_tpu.core.config import Config
+from spark_bam_tpu.parallel.mesh import make_mesh, make_shard_map_count_step
+from spark_bam_tpu.tpu.checker import PAD
+from spark_bam_tpu.tpu.inflate import InflatePipeline
+from spark_bam_tpu.tpu.stream_check import (
+    _next_pow2,
+    halo_windows,
+    pad_contig_lengths,
+)
+
+
+def count_reads_sharded(
+    path,
+    config: Config = Config(),
+    mesh=None,
+    window_uncompressed: int | None = None,
+    halo: int | None = None,
+    metas: list | None = None,
+    progress: Callable[[int, int, int], None] | None = None,
+) -> int:
+    """Record count of ``path`` computed across ``mesh`` (default: all
+    devices). ``progress(steps_done, positions_done, total_positions)``
+    fires after each sharded step."""
+    mesh = mesh if mesh is not None else make_mesh()
+    n_dev = int(mesh.devices.size)
+    axis = mesh.axis_names[0]
+
+    header = read_header(path)
+    lens_list = header.contig_lengths.lengths_list()
+    lengths = pad_contig_lengths(np.asarray(lens_list, dtype=np.int32))
+
+    fresh = window_uncompressed or config.window_size
+    halo = config.halo_size if halo is None else halo
+    halo = min(halo, fresh // 2)
+    pipeline = InflatePipeline(
+        path, window_uncompressed=fresh, device_copy=config.device_inflate,
+        metas=metas,
+    )
+    total = pipeline.total
+    kernel_window = _next_pow2(min(fresh + halo, max(total, 1 << 16)))
+    header_end = header.uncompressed_size
+
+    step = make_shard_map_count_step(
+        mesh, reads_to_check=config.reads_to_check, axis=axis
+    )
+    row_sharding = NamedSharding(mesh, P(axis))
+    repl = NamedSharding(mesh, P())
+    lengths_d = jax.device_put(jnp.asarray(lengths), repl)
+    nc = jnp.int32(len(lens_list))
+
+    count = 0
+    escapes = 0
+    steps = 0
+    done_positions = 0
+
+    ws = np.zeros((n_dev, kernel_window + PAD), dtype=np.uint8)
+    ns = np.zeros(n_dev, dtype=np.int32)
+    eofs = np.zeros(n_dev, dtype=bool)
+    los = np.zeros(n_dev, dtype=np.int32)
+    owns = np.zeros(n_dev, dtype=np.int32)
+
+    def flush(k_rows: int):
+        nonlocal count, escapes, steps
+        if k_rows == 0:
+            return
+        # Zero unused rows so a stale previous batch can't leak in.
+        ws[k_rows:] = 0
+        ns[k_rows:] = 0
+        eofs[k_rows:] = False
+        los[k_rows:] = 0
+        owns[k_rows:] = 0
+        totals = np.asarray(step(
+            jax.device_put(jnp.asarray(ws), row_sharding),
+            jax.device_put(jnp.asarray(ns), row_sharding),
+            jax.device_put(jnp.asarray(eofs), row_sharding),
+            jax.device_put(jnp.asarray(los), row_sharding),
+            jax.device_put(jnp.asarray(owns), row_sharding),
+            lengths_d, nc,
+        ))
+        count += int(totals[0])
+        escapes += int(totals[1])
+        steps += 1
+        if progress is not None:
+            progress(steps, done_positions, total)
+
+    # Seam semantics (carry, ownership, header clamp) come from the same
+    # generator StreamChecker uses — one source of truth, so the mesh path
+    # and its exact fallback can never diverge.
+    k = 0
+    for buf, base, own_end, lo, at_eof in halo_windows(
+        pipeline, halo, header_end
+    ):
+        n = len(buf)
+        ws[k, :n] = buf
+        ws[k, n: kernel_window + PAD] = 0
+        ns[k] = n
+        eofs[k] = at_eof
+        los[k] = lo
+        owns[k] = own_end
+        done_positions = base + own_end
+        k += 1
+        if k == n_dev:
+            flush(k)
+            if escapes:
+                break
+            k = 0
+    if not escapes:
+        flush(k)
+
+    if escapes:
+        # Ultra-long chains outran the halo: resolve bit-exactly through
+        # the single-device deferral path.
+        from spark_bam_tpu.tpu.stream_check import StreamChecker
+
+        return StreamChecker(
+            path, config, window_uncompressed=fresh, halo=halo, metas=metas,
+        ).count_reads()
+    return count

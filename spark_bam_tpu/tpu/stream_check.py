@@ -59,6 +59,42 @@ def _next_pow2(n: int) -> int:
     return 1 << max(0, (max(n, 1) - 1).bit_length())
 
 
+def pad_contig_lengths(lengths: np.ndarray, cmax: int = 1024) -> np.ndarray:
+    """Contig lengths zero-padded to a static kernel shape."""
+    lens = np.zeros(max(cmax, len(lengths)), dtype=np.int32)
+    lens[: len(lengths)] = lengths
+    return lens
+
+
+def halo_windows(pipeline, halo: int, header_end: int):
+    """Yield ``(buf, base, own_end, lo, at_eof)`` rows with the halo-carry
+    ownership discipline — the single source of truth for seam semantics,
+    shared by ``StreamChecker`` (single device) and
+    ``parallel.stream_mesh.count_reads_sharded`` (whole mesh):
+
+    - each buffer is ``carry + window`` where carry is the previous
+      buffer's trailing ``halo`` bytes, so every owned position has
+      ≥ halo bytes of chain lookahead;
+    - a non-final buffer owns everything but its halo tail (the next
+      buffer re-evaluates those positions with full lookahead); the final
+      buffer owns through EOF;
+    - ``lo`` clamps the owned span's start past the BAM header, so header
+      bytes are never counted as record starts.
+    """
+    carry = np.empty(0, dtype=np.uint8)
+    base_next = 0
+    for view in pipeline:
+        base = base_next
+        buf = np.concatenate([carry, view.data]) if len(carry) else view.data
+        n = len(buf)
+        at_eof = view.at_eof
+        own_end = n if at_eof else max(n - halo, 0)
+        lo = min(max(header_end - base, 0), own_end)
+        yield buf, base, own_end, lo, at_eof
+        carry = buf[own_end:]
+        base_next = base + own_end
+
+
 @jax.jit
 def _reduce_span(verdict, escaped, lo, hi):
     """Device-side reduction of one window's owned span → two scalars."""
@@ -131,30 +167,21 @@ class StreamChecker:
     def _windows(self, launch):
         """Yield ``(buf, base, own_end, at_eof, launched)`` one window behind
         the device: window *k+1* is dispatched before *k* is yielded, so the
-        consumer's host work overlaps the device."""
-        carry = np.empty(0, dtype=np.uint8)
-        base_next = 0
+        consumer's host work overlaps the device. Seam semantics live in
+        ``halo_windows`` (shared with the mesh streaming path)."""
         prev = None
-        for view in self.pipeline:
-            base = base_next
-            buf = (
-                np.concatenate([carry, view.data]) if len(carry) else view.data
-            )
-            n = len(buf)
-            at_eof = view.at_eof
-            own_end = n if at_eof else max(n - self.halo, 0)
-            out = launch(buf, n, at_eof, base, own_end)
+        for buf, base, own_end, lo, at_eof in halo_windows(
+            self.pipeline, self.halo, self.header_end_abs
+        ):
+            out = launch(buf, len(buf), at_eof, lo, own_end)
             if prev is not None:
                 yield prev
             prev = (buf, base, own_end, at_eof, out)
-            carry = buf[own_end:]
-            base_next = base + own_end
         if prev is not None:
             yield prev
 
     def _device_inputs(self):
-        lens = np.zeros(max(1024, len(self.lengths)), dtype=np.int32)
-        lens[: len(self.lengths)] = self.lengths
+        lens = pad_contig_lengths(self.lengths)
         lens_dev = jax.device_put(jnp.asarray(lens))
         return lens_dev, jnp.int32(len(self.lengths))
 
@@ -164,7 +191,7 @@ class StreamChecker:
     def _launcher(self):
         """Full-output launch (the spans path)."""
         if not self.use_device:
-            return lambda buf, n, at_eof, base, own_end: None  # host-lazy
+            return lambda buf, n, at_eof, lo, own_end: None  # host-lazy
         from spark_bam_tpu.tpu.checker import PAD, make_check_window
 
         kernel = make_check_window(
@@ -174,7 +201,7 @@ class StreamChecker:
         lens_dev, nc = self._device_inputs()
         w = self.kernel_window
 
-        def launch(buf, n, at_eof, base, own_end):
+        def launch(buf, n, at_eof, lo, own_end):
             padded = np.zeros(w + PAD, dtype=np.uint8)
             padded[:n] = buf
             # Fresh buffer per window (never mutated after dispatch): safe
@@ -197,12 +224,10 @@ class StreamChecker:
         )
         lens_dev, nc = self._device_inputs()
         w = self.kernel_window
-        he = self.header_end_abs
 
-        def launch(buf, n, at_eof, base, own_end):
+        def launch(buf, n, at_eof, lo, own_end):
             padded = np.zeros(w + PAD, dtype=np.uint8)
             padded[:n] = buf
-            lo = min(max(he - base, 0), own_end)
             return kernel(
                 jnp.asarray(padded), lens_dev, nc, jnp.int32(n),
                 jnp.bool_(at_eof), jnp.int32(lo), jnp.int32(own_end),

@@ -1,0 +1,75 @@
+"""Mesh-sharded streaming count-reads (parallel/stream_mesh.py) on the
+virtual 8-device CPU mesh: the single-host multi-chip production path must
+agree with the single-device streaming engine and the pinned fixture
+counts (2.bam = 2500 reads, 1.bam = 4917 — reference
+docs/command-line.md:46-53, cli golden output/check-bam/1.bam)."""
+
+import jax
+
+from spark_bam_tpu.core.config import Config
+from spark_bam_tpu.parallel.mesh import make_mesh
+from spark_bam_tpu.parallel.stream_mesh import count_reads_sharded
+from spark_bam_tpu.tpu.stream_check import StreamChecker
+
+from conftest import FIXTURES
+
+BAM1 = FIXTURES / "1.bam"
+BAM2 = FIXTURES / "2.bam"
+
+
+def _mesh():
+    return make_mesh(jax.devices("cpu")[:8])
+
+
+def test_sharded_count_matches_fixture_and_single_device():
+    mesh = _mesh()
+    # 128 KiB windows over the ~1.6 MB flat stream: ≥2 sharded steps with a
+    # partial final batch, plus carry/halo seams between every row.
+    got = count_reads_sharded(
+        BAM2, Config(), mesh=mesh,
+        window_uncompressed=128 << 10, halo=32 << 10,
+    )
+    assert got == 2500
+    single = StreamChecker(
+        BAM2, Config(), window_uncompressed=128 << 10, halo=32 << 10,
+    ).count_reads()
+    assert got == single
+
+
+def test_sharded_count_bam1():
+    got = count_reads_sharded(
+        BAM1, Config(), mesh=_mesh(),
+        window_uncompressed=256 << 10, halo=64 << 10,
+    )
+    assert got == 4917
+
+
+def test_sharded_count_single_batch_small_file():
+    # Whole file fits one window: one step, one live row, 7 zero rows.
+    got = count_reads_sharded(
+        BAM2, Config(), mesh=_mesh(),
+        window_uncompressed=4 << 20, halo=256 << 10,
+    )
+    assert got == 2500
+
+
+def test_sharded_count_escape_falls_back_exact():
+    # A 1 KiB halo is shorter than a 10-record chain's span, so owned
+    # positions near every seam escape; the device pass must abort and the
+    # single-device deferral-exact path must still land the right count.
+    got = count_reads_sharded(
+        BAM2, Config(), mesh=_mesh(),
+        window_uncompressed=128 << 10, halo=1 << 10,
+    )
+    assert got == 2500
+
+
+def test_progress_callback_fires():
+    seen = []
+    count_reads_sharded(
+        BAM2, Config(), mesh=_mesh(),
+        window_uncompressed=128 << 10, halo=32 << 10,
+        progress=lambda s, d, t: seen.append((s, d, t)),
+    )
+    assert seen and seen[-1][0] == len(seen)
+    assert seen[-1][2] == seen[-1][1]  # final flush covers the whole file
