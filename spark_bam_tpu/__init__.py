@@ -39,6 +39,7 @@ __all__ = [
     "stream_read_batches",
     "full_check_summary_streaming",
     "count_reads_sharded",
+    "check_bam_sharded",
 ]
 
 # Lazy exports: the load API pulls in numpy/jax; keep `import spark_bam_tpu`
@@ -60,6 +61,7 @@ _LAZY = {
     },
     "full_check_summary_streaming": "spark_bam_tpu.tpu.stream_check",
     "count_reads_sharded": "spark_bam_tpu.parallel.stream_mesh",
+    "check_bam_sharded": "spark_bam_tpu.parallel.stream_mesh",
 }
 
 

@@ -183,39 +183,102 @@ def make_shard_map_check_step(mesh: Mesh, reads_to_check: int = 10, axis: str = 
     )
 
 
+def _make_sharded_stats_step(
+    mesh: Mesh, reads_to_check: int, axis: str, row_stats, with_truth: bool
+):
+    """Shared scaffolding for the streaming-step makers below: per-row
+    ``check_window`` + owned-span mask [lo, own), per-device ``vmap``, and
+    the stat vector all-reduced with ``lax.psum`` over the mesh axis.
+    ``row_stats(res, m, tr)`` stacks the workload's counters.
+
+    Every counter psum'd here must be record-scale (≤ positions/40 per
+    step), never position-scale: the reduction is int32 and a
+    position-scale counter overflows past ~64 devices × 32 MB windows.
+    Position totals are host-derivable (callers know their owned spans).
+    """
+    shard_map = _shard_map_compat()
+
+    def one(window, n, at_eof, lo, own, tr, lengths, num_contigs):
+        res = check_window(
+            window, lengths, num_contigs, n, at_eof,
+            reads_to_check=reads_to_check,
+        )
+        w = window.shape[0] - PAD
+        i = jnp.arange(w, dtype=jnp.int32)
+        m = (i >= lo) & (i < own)
+        return row_stats(res, m, tr)
+
+    if with_truth:
+        def local_step(windows, ns, at_eofs, truth, los, owns, lengths, nc):
+            stats = jax.vmap(
+                lambda wd, n, e, t, lo, ow: one(wd, n, e, lo, ow, t, lengths, nc)
+            )(windows, ns, at_eofs, truth, los, owns)
+            return jax.lax.psum(jnp.sum(stats, axis=0), axis)  # ← ICI
+
+        in_specs = (
+            P(axis), P(axis), P(axis), P(axis), P(axis), P(axis), P(), P(),
+        )
+    else:
+        def local_step(windows, ns, at_eofs, los, owns, lengths, nc):
+            stats = jax.vmap(
+                lambda wd, n, e, lo, ow: one(wd, n, e, lo, ow, None, lengths, nc)
+            )(windows, ns, at_eofs, los, owns)
+            return jax.lax.psum(jnp.sum(stats, axis=0), axis)  # ← ICI
+
+        in_specs = (P(axis), P(axis), P(axis), P(axis), P(axis), P(), P())
+    return jax.jit(
+        shard_map(
+            local_step,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=P(),
+            check_rep=False,
+        )
+    )
+
+
 def make_shard_map_count_step(mesh: Mesh, reads_to_check: int = 10, axis: str = "data"):
     """Sharded count-reads step: each device checks its window rows and the
     (boundary count, owned escapes) pair all-reduces with ``lax.psum`` —
     the count-reads workload (reference docs/benchmarks.md:53-59) as one
     mesh-partitioned unit. Rows carry per-row owned spans [lo, own) so
     halo bytes and the BAM header are counted exactly once globally."""
-    shard_map = _shard_map_compat()
 
-    def local_step(windows, ns, at_eofs, los, owns, lengths, num_contigs):
-        def one(window, n, at_eof, lo, own):
-            res = check_window(
-                window, lengths, num_contigs, n, at_eof,
-                reads_to_check=reads_to_check,
-            )
-            w = window.shape[0] - PAD
-            i = jnp.arange(w, dtype=jnp.int32)
-            m = (i >= lo) & (i < own)
-            return jnp.stack([
-                jnp.sum((res["verdict"] & m).astype(jnp.int32)),
-                jnp.sum((res["escaped"] & m).astype(jnp.int32)),
-            ])
+    def row_stats(res, m, _tr):
+        return jnp.stack([
+            jnp.sum((res["verdict"] & m).astype(jnp.int32)),
+            jnp.sum((res["escaped"] & m).astype(jnp.int32)),
+        ])
 
-        stats = jax.vmap(one)(windows, ns, at_eofs, los, owns)
-        return jax.lax.psum(jnp.sum(stats, axis=0), axis)  # ← ICI all-reduce
+    return _make_sharded_stats_step(
+        mesh, reads_to_check, axis, row_stats, with_truth=False
+    )
 
-    return jax.jit(
-        shard_map(
-            local_step,
-            mesh=mesh,
-            in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis), P(), P()),
-            out_specs=P(),
-            check_rep=False,
-        )
+
+def make_shard_map_confusion_step(
+    mesh: Mesh, reads_to_check: int = 10, axis: str = "data"
+):
+    """Sharded check-bam step: verdicts vs indexed truth at every owned
+    position, the (tp, fp, fn, escapes) counters ``psum``'d over the mesh
+    axis — the check-bam validation workload (reference
+    CheckerApp.scala:59-70's accumulators) as one mesh-partitioned unit.
+    Position totals and true negatives are deliberately NOT reduced on
+    device: they are position-scale (int32-overflow risk at mesh scale)
+    and the caller derives them exactly from its owned spans
+    (tn = positions - tp - fp - fn)."""
+
+    def row_stats(res, m, tr):
+        v = res["verdict"] & m
+        t = tr & m
+        return jnp.stack([
+            jnp.sum((v & t).astype(jnp.int32)),    # true positives
+            jnp.sum((v & ~t).astype(jnp.int32)),   # false positives
+            jnp.sum((~v & t).astype(jnp.int32)),   # false negatives
+            jnp.sum((res["escaped"] & m).astype(jnp.int32)),
+        ])
+
+    return _make_sharded_stats_step(
+        mesh, reads_to_check, axis, row_stats, with_truth=True
     )
 
 

@@ -1,22 +1,30 @@
-"""Mesh-sharded streaming count-reads: one BAM across all chips.
+"""Mesh-sharded streaming workloads: one BAM across all chips.
 
 Bridges the two scale paths that already exist separately:
 
 - ``tpu/stream_check.StreamChecker`` — whole-file streaming in O(window)
   host memory, single device;
-- ``parallel/mesh.make_shard_map_count_step`` — the mesh-partitioned
-  count unit (``lax.psum`` over ICI) that ``multihost.py`` feeds with
-  preassembled window rows.
+- ``parallel/mesh``'s sharded step makers — the mesh-partitioned units
+  (``lax.psum`` over ICI) that ``multihost.py`` feeds with preassembled
+  window rows.
 
 Here the host assembles consecutive halo-carried windows into a
 ``(n_devices, W+PAD)`` batch per step — the same carry/ownership
 discipline as ``StreamChecker`` (each row's trailing ``halo`` is owned by
-the next row, so every owned position has full chain lookahead) — and
-every step runs one sharded kernel with the global count reduced on the
-mesh. This is the single-host multi-chip production path of the
-count-reads workload (reference docs/benchmarks.md:53-59; SURVEY.md §2.8
-maps file/block data-parallelism onto per-core batch pipelines, §2.9
-replaces Spark accumulators with ``psum``).
+the next row, so every owned position has full chain lookahead; seam
+semantics come from the shared ``halo_windows`` generator) — and every
+step runs one sharded kernel with the tiny reduction riding the mesh.
+This is the single-host multi-chip production path of:
+
+- ``count_reads_sharded`` — the count-reads workload (reference
+  docs/benchmarks.md:53-59);
+- ``check_bam_sharded`` — the check-bam validation workload: verdicts vs
+  the ``.records`` indexed ground truth at every uncompressed position,
+  confusion matrix accumulated via ``psum`` (reference
+  CheckerApp.scala:59-93's accumulator pipeline).
+
+SURVEY.md §2.8 maps file/block data-parallelism onto per-core batch
+pipelines; §2.9 replaces Spark accumulators with ``psum``.
 
 Exactness: rows whose chains outrun the halo report escapes; any escape
 aborts the device pass and the file re-runs through ``StreamChecker``'s
@@ -36,14 +44,125 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from spark_bam_tpu.bam.header import read_header
 from spark_bam_tpu.core.config import Config
-from spark_bam_tpu.parallel.mesh import make_mesh, make_shard_map_count_step
+from spark_bam_tpu.parallel.mesh import (
+    make_mesh,
+    make_shard_map_confusion_step,
+    make_shard_map_count_step,
+)
 from spark_bam_tpu.tpu.checker import PAD
 from spark_bam_tpu.tpu.inflate import InflatePipeline
 from spark_bam_tpu.tpu.stream_check import (
+    StreamChecker,
     _next_pow2,
     halo_windows,
     pad_contig_lengths,
 )
+
+
+class _ShardedStream:
+    """Shared plumbing: plan the stream, build the row batch arrays, and
+    iterate ``halo_windows`` rows into ``n_devices``-row batches."""
+
+    def __init__(
+        self,
+        path,
+        config: Config,
+        mesh,
+        window_uncompressed: int | None,
+        halo: int | None,
+        metas: list | None,
+        with_truth: bool = False,
+    ):
+        self.path = path
+        self.config = config
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.n_dev = int(self.mesh.devices.size)
+        self.axis = self.mesh.axis_names[0]
+
+        header = read_header(path)
+        lens_list = header.contig_lengths.lengths_list()
+        self.num_contigs = len(lens_list)
+        self.lengths = pad_contig_lengths(np.asarray(lens_list, dtype=np.int32))
+
+        self.fresh = window_uncompressed or config.window_size
+        halo = config.halo_size if halo is None else halo
+        self.halo = min(halo, self.fresh // 2)
+        self.metas = metas
+        self.pipeline = InflatePipeline(
+            path, window_uncompressed=self.fresh,
+            device_copy=config.device_inflate, metas=metas,
+        )
+        self.total = self.pipeline.total
+        self.kernel_window = _next_pow2(
+            min(self.fresh + self.halo, max(self.total, 1 << 16))
+        )
+        self.header_end = header.uncompressed_size
+
+        self.row_sharding = NamedSharding(self.mesh, P(self.axis))
+        repl = NamedSharding(self.mesh, P())
+        self.lengths_d = jax.device_put(jnp.asarray(self.lengths), repl)
+        self.nc = jnp.int32(self.num_contigs)
+
+        kw = self.kernel_window
+        self.ws = np.zeros((self.n_dev, kw + PAD), dtype=np.uint8)
+        self.ns = np.zeros(self.n_dev, dtype=np.int32)
+        self.eofs = np.zeros(self.n_dev, dtype=bool)
+        self.los = np.zeros(self.n_dev, dtype=np.int32)
+        self.owns = np.zeros(self.n_dev, dtype=np.int32)
+        self.truth = (
+            np.zeros((self.n_dev, kw), dtype=bool) if with_truth else None
+        )
+
+    def zero_tail_rows(self, k_rows: int):
+        """Blank rows ≥ k_rows so a stale previous batch can't leak in."""
+        self.ws[k_rows:] = 0
+        self.ns[k_rows:] = 0
+        self.eofs[k_rows:] = False
+        self.los[k_rows:] = 0
+        self.owns[k_rows:] = 0
+        if self.truth is not None:
+            self.truth[k_rows:] = False
+
+    def batches(self, header_clamp: bool, fill_row=None):
+        """Yield ``(k_rows, positions_done)`` after filling each batch of up
+        to ``n_dev`` rows. ``fill_row(k, buf, base, n)`` fills aligned
+        per-row extras (e.g. truth masks). ``header_clamp=False`` counts
+        header bytes in owned spans (check-bam considers every position)."""
+        he = self.header_end if header_clamp else 0
+        k = 0
+        done = 0
+        for buf, base, own_end, lo, at_eof in halo_windows(
+            self.pipeline, self.halo, he
+        ):
+            n = len(buf)
+            self.ws[k, :n] = buf
+            self.ws[k, n:] = 0
+            self.ns[k] = n
+            self.eofs[k] = at_eof
+            self.los[k] = lo
+            self.owns[k] = own_end
+            if fill_row is not None:
+                fill_row(k, buf, base, n)
+            done = base + own_end
+            k += 1
+            if k == self.n_dev:
+                yield k, done
+                k = 0
+        if k:
+            yield k, done
+
+    def sharded_args(self):
+        put = jax.device_put
+        rs = self.row_sharding
+        args = [
+            put(jnp.asarray(self.ws), rs),
+            put(jnp.asarray(self.ns), rs),
+            put(jnp.asarray(self.eofs), rs),
+        ]
+        if self.truth is not None:
+            args.append(put(jnp.asarray(self.truth), rs))
+        args += [put(jnp.asarray(self.los), rs), put(jnp.asarray(self.owns), rs)]
+        return args + [self.lengths_d, self.nc]
 
 
 def count_reads_sharded(
@@ -58,98 +177,145 @@ def count_reads_sharded(
     """Record count of ``path`` computed across ``mesh`` (default: all
     devices). ``progress(steps_done, positions_done, total_positions)``
     fires after each sharded step."""
-    mesh = mesh if mesh is not None else make_mesh()
-    n_dev = int(mesh.devices.size)
-    axis = mesh.axis_names[0]
-
-    header = read_header(path)
-    lens_list = header.contig_lengths.lengths_list()
-    lengths = pad_contig_lengths(np.asarray(lens_list, dtype=np.int32))
-
-    fresh = window_uncompressed or config.window_size
-    halo = config.halo_size if halo is None else halo
-    halo = min(halo, fresh // 2)
-    pipeline = InflatePipeline(
-        path, window_uncompressed=fresh, device_copy=config.device_inflate,
-        metas=metas,
+    st = _ShardedStream(
+        path, config, mesh, window_uncompressed, halo, metas
     )
-    total = pipeline.total
-    kernel_window = _next_pow2(min(fresh + halo, max(total, 1 << 16)))
-    header_end = header.uncompressed_size
-
     step = make_shard_map_count_step(
-        mesh, reads_to_check=config.reads_to_check, axis=axis
+        st.mesh, reads_to_check=config.reads_to_check, axis=st.axis
     )
-    row_sharding = NamedSharding(mesh, P(axis))
-    repl = NamedSharding(mesh, P())
-    lengths_d = jax.device_put(jnp.asarray(lengths), repl)
-    nc = jnp.int32(len(lens_list))
-
-    count = 0
-    escapes = 0
-    steps = 0
-    done_positions = 0
-
-    ws = np.zeros((n_dev, kernel_window + PAD), dtype=np.uint8)
-    ns = np.zeros(n_dev, dtype=np.int32)
-    eofs = np.zeros(n_dev, dtype=bool)
-    los = np.zeros(n_dev, dtype=np.int32)
-    owns = np.zeros(n_dev, dtype=np.int32)
-
-    def flush(k_rows: int):
-        nonlocal count, escapes, steps
-        if k_rows == 0:
-            return
-        # Zero unused rows so a stale previous batch can't leak in.
-        ws[k_rows:] = 0
-        ns[k_rows:] = 0
-        eofs[k_rows:] = False
-        los[k_rows:] = 0
-        owns[k_rows:] = 0
-        totals = np.asarray(step(
-            jax.device_put(jnp.asarray(ws), row_sharding),
-            jax.device_put(jnp.asarray(ns), row_sharding),
-            jax.device_put(jnp.asarray(eofs), row_sharding),
-            jax.device_put(jnp.asarray(los), row_sharding),
-            jax.device_put(jnp.asarray(owns), row_sharding),
-            lengths_d, nc,
-        ))
+    count = escapes = steps = 0
+    for k_rows, done in st.batches(header_clamp=True):
+        st.zero_tail_rows(k_rows)
+        totals = np.asarray(step(*st.sharded_args()))
         count += int(totals[0])
         escapes += int(totals[1])
         steps += 1
         if progress is not None:
-            progress(steps, done_positions, total)
-
-    # Seam semantics (carry, ownership, header clamp) come from the same
-    # generator StreamChecker uses — one source of truth, so the mesh path
-    # and its exact fallback can never diverge.
-    k = 0
-    for buf, base, own_end, lo, at_eof in halo_windows(
-        pipeline, halo, header_end
-    ):
-        n = len(buf)
-        ws[k, :n] = buf
-        ws[k, n: kernel_window + PAD] = 0
-        ns[k] = n
-        eofs[k] = at_eof
-        los[k] = lo
-        owns[k] = own_end
-        done_positions = base + own_end
-        k += 1
-        if k == n_dev:
-            flush(k)
-            if escapes:
-                break
-            k = 0
-    if not escapes:
-        flush(k)
+            progress(steps, done, st.total)
+        if escapes:
+            break
 
     if escapes:
         # Ultra-long chains outran the halo: resolve bit-exactly through
         # the single-device deferral path.
-        from spark_bam_tpu.tpu.stream_check import StreamChecker
-
         return StreamChecker(
-            path, config, window_uncompressed=fresh, halo=halo, metas=metas,
+            path, config, window_uncompressed=st.fresh, halo=st.halo,
+            metas=metas,
         ).count_reads()
     return count
+
+
+def _truth_flats(path, records_path, metas) -> np.ndarray:
+    """The ``.records`` ground truth as sorted absolute flat offsets."""
+    from spark_bam_tpu.bam.index_records import read_records_index
+    from spark_bam_tpu.bgzf.flat import metas_block_table
+    from spark_bam_tpu.bgzf.index_blocks import blocks_metadata
+
+    records_path = (
+        str(path) + ".records" if records_path is None else records_path
+    )
+    positions = read_records_index(records_path)
+    metas = list(blocks_metadata(path)) if metas is None else metas
+    block_starts, block_flat = metas_block_table(metas)
+    blocks = np.array([p.block_pos for p in positions], dtype=np.int64)
+    offs = np.array([p.offset for p in positions], dtype=np.int64)
+    idx = np.searchsorted(block_starts, blocks)
+    if len(idx) and (
+        idx.max() >= len(block_starts)
+        or not np.array_equal(block_starts[idx], blocks)
+    ):
+        raise ValueError(
+            f"{records_path}: block positions not in {path}'s block table "
+            "(stale sidecar?)"
+        )
+    return np.sort(block_flat[idx] + offs)
+
+
+def check_bam_sharded(
+    path,
+    config: Config = Config(),
+    mesh=None,
+    records_path=None,
+    window_uncompressed: int | None = None,
+    halo: int | None = None,
+    metas: list | None = None,
+    progress: Callable[[int, int, int], None] | None = None,
+) -> dict:
+    """check-bam across the mesh: the vectorized checker's verdict vs the
+    ``.records`` indexed ground truth at **every uncompressed position** of
+    the file (header bytes included — reference check-bam semantics), the
+    confusion matrix ``psum``'d per sharded step.
+
+    Returns ``{"true_positives", "false_positives", "false_negatives",
+    "true_negatives", "positions"}``. Escaped chains fall back to the
+    single-device deferral-exact spans path, so the returned matrix is
+    always exact.
+    """
+    st = _ShardedStream(
+        path, config, mesh, window_uncompressed, halo, metas, with_truth=True
+    )
+    # The pipeline already walked every block header; reuse its scan for
+    # the truth table instead of a second whole-file metadata walk.
+    truth_flats = _truth_flats(path, records_path, st.pipeline.metas)
+    step = make_shard_map_confusion_step(
+        st.mesh, reads_to_check=config.reads_to_check, axis=st.axis
+    )
+
+    def fill_row(k, buf, base, n):
+        row = st.truth[k]
+        row[:] = False
+        i0, i1 = np.searchsorted(truth_flats, (base, base + n))
+        row[truth_flats[i0:i1] - base] = True
+
+    # Device stats are [tp, fp, fn, escapes] — record-scale counters only.
+    # Position totals and tn are host-derived (owned spans tile [0, total)
+    # exactly), which keeps the device reduction int32-safe at mesh scale.
+    agg = np.zeros(4, dtype=np.int64)
+    steps = 0
+    for k_rows, done in st.batches(header_clamp=False, fill_row=fill_row):
+        st.zero_tail_rows(k_rows)
+        agg += np.asarray(step(*st.sharded_args()), dtype=np.int64)
+        steps += 1
+        if progress is not None:
+            progress(steps, done, st.total)
+        if agg[3]:
+            break
+
+    if agg[3]:
+        return _check_bam_exact(
+            path, config, st.fresh, st.halo, st.pipeline.metas, truth_flats,
+            st.total,
+        )
+    tp, fp, fn = int(agg[0]), int(agg[1]), int(agg[2])
+    return {
+        "true_positives": tp,
+        "false_positives": fp,
+        "false_negatives": fn,
+        "true_negatives": st.total - tp - fp - fn,
+        "positions": st.total,
+    }
+
+
+def _check_bam_exact(
+    path, config, fresh, halo, metas, truth_flats, total
+) -> dict:
+    """Escape fallback: predicted-boundary set from the deferral-exact
+    single-device spans, confusion by set arithmetic."""
+    checker = StreamChecker(
+        path, config, window_uncompressed=fresh, halo=halo, metas=metas
+    )
+    parts = [base + np.flatnonzero(v) for base, v in checker.spans()]
+    pred = (
+        np.sort(np.concatenate(parts)) if parts
+        else np.empty(0, dtype=np.int64)
+    )
+    tp = int(np.isin(pred, truth_flats).sum())
+    fp = len(pred) - tp
+    fn = len(truth_flats) - tp
+    return {
+        "true_positives": tp,
+        "false_positives": fp,
+        "false_negatives": fn,
+        "true_negatives": total - tp - fp - fn,
+        "positions": total,
+    }

@@ -64,6 +64,56 @@ def test_sharded_count_escape_falls_back_exact():
     assert got == 2500
 
 
+def test_check_bam_sharded_bam2_all_match():
+    # Reference: eager vs indexed on 2.bam has no miscalls; 1,606,522
+    # uncompressed positions, 2,500 records (docs/command-line.md:46-53).
+    from spark_bam_tpu.parallel.stream_mesh import check_bam_sharded
+
+    stats = check_bam_sharded(
+        BAM2, Config(), mesh=_mesh(),
+        window_uncompressed=128 << 10, halo=32 << 10,
+    )
+    assert stats == {
+        "true_positives": 2500,
+        "false_positives": 0,
+        "false_negatives": 0,
+        "true_negatives": 1_606_522 - 2500,
+        "positions": 1_606_522,
+    }
+
+
+def test_check_bam_sharded_bam1():
+    # 1.bam: 1,608,257 positions, 4,917 reads, and the eager checker has
+    # no known miscalls vs the indexed truth (the 5 documented FPs are
+    # hadoop-bam's, not ours — cli golden output/check-bam/1.bam).
+    from spark_bam_tpu.parallel.stream_mesh import check_bam_sharded
+
+    stats = check_bam_sharded(
+        BAM1, Config(), mesh=_mesh(),
+        window_uncompressed=256 << 10, halo=64 << 10,
+    )
+    assert stats["true_positives"] == 4917
+    assert stats["false_positives"] == 0
+    assert stats["false_negatives"] == 0
+    assert stats["positions"] == 1_608_257
+
+
+def test_check_bam_sharded_escape_fallback_matches_device_pass():
+    # Tiny halo forces escapes; the exact set-arithmetic fallback must
+    # produce the same matrix the device pass produces with a real halo.
+    from spark_bam_tpu.parallel.stream_mesh import check_bam_sharded
+
+    via_fallback = check_bam_sharded(
+        BAM2, Config(), mesh=_mesh(),
+        window_uncompressed=128 << 10, halo=1 << 10,
+    )
+    via_device = check_bam_sharded(
+        BAM2, Config(), mesh=_mesh(),
+        window_uncompressed=128 << 10, halo=32 << 10,
+    )
+    assert via_fallback == via_device
+
+
 def test_progress_callback_fires():
     seen = []
     count_reads_sharded(
