@@ -3,6 +3,10 @@
 Default compares spark-bam's eager checker against the seqdoop
 (hadoop-bam-semantics) checker; ``-s``/``-u`` score eager/seqdoop against
 the ``.records`` ground truth (reference cli/.../check/eager/CheckBam.scala).
+``--sharded`` runs the mesh-scale streaming path instead (verdicts vs the
+``.records`` truth across every device, O(window) host memory) and prints
+a compact confusion summary — the operator face of
+``parallel.stream_mesh.check_bam_sharded``.
 """
 
 from __future__ import annotations
@@ -10,7 +14,28 @@ from __future__ import annotations
 from spark_bam_tpu.cli.app import CheckerContext
 
 
-def run(ctx: CheckerContext, spark_bam: bool = False, hadoop_bam: bool = False) -> None:
+def run(
+    ctx: CheckerContext,
+    spark_bam: bool = False,
+    hadoop_bam: bool = False,
+    sharded: bool = False,
+) -> None:
+    if sharded:
+        # --sharded IS eager-vs-truth (the -s scoring) at mesh scale, so
+        # -s composes; -u (seqdoop oracle) and -i (byte ranges) have no
+        # sharded implementation — reject rather than silently ignore.
+        if hadoop_bam:
+            raise ValueError(
+                "--sharded scores the eager checker against the .records "
+                "truth; the seqdoop oracle (-u) has no sharded path"
+            )
+        if ctx.ranges is not None:
+            raise ValueError(
+                "--sharded checks the whole file; -i/--intervals is not "
+                "supported on the sharded path"
+            )
+        _run_sharded(ctx)
+        return
     if spark_bam and not hadoop_bam:
         expected, actual = ctx.truth, ctx.eager_verdict
     elif hadoop_bam and not spark_bam:
@@ -18,3 +43,22 @@ def run(ctx: CheckerContext, spark_bam: bool = False, hadoop_bam: bool = False) 
     else:
         expected, actual = ctx.eager_verdict, ctx.seqdoop_verdict
     ctx.print_header_and_confusion(expected, actual)
+
+
+def _run_sharded(ctx: CheckerContext) -> None:
+    from spark_bam_tpu.parallel.stream_mesh import check_bam_sharded
+
+    stats = check_bam_sharded(ctx.path, ctx.config)
+    p = ctx.printer
+    p.echo(
+        f"{stats['positions']} positions checked across "
+        f"{stats['devices']} device(s)"
+    )
+    p.echo(
+        f"{stats['false_positives']} false positives, "
+        f"{stats['false_negatives']} false negatives"
+    )
+    p.echo(
+        f"true positives: {stats['true_positives']}, "
+        f"true negatives: {stats['true_negatives']}"
+    )

@@ -19,7 +19,25 @@ def run(
     spark_bam_first: bool = False,
     iterations: int = 1,
     reference=None,
+    sharded: bool = False,
 ) -> None:
+    if sharded:
+        # Mesh-scale streaming count across every device (no hadoop-bam
+        # leg: this is the scale mode; the comparison mode is the default).
+        if str(path).endswith(".cram"):
+            raise ValueError(
+                "--sharded supports BAM only: CRAM has no BGZF block "
+                "structure to window (use the default count-reads path)"
+            )
+        from spark_bam_tpu.parallel.stream_mesh import count_reads_sharded
+
+        for _ in range(max(iterations, 1)):
+            t0 = time.perf_counter()
+            count = count_reads_sharded(path, config)
+            ms = int((time.perf_counter() - t0) * 1000)
+            p.echo(f"spark-bam read-count time: {ms}")
+            p.echo(f"Read count: {count}", "")
+        return
     if str(path).endswith(".cram"):
         # No hadoop-bam leg for CRAM (the reference delegates CRAM entirely;
         # there is no competitor count to diff against). ``reference`` (-F)

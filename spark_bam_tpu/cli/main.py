@@ -51,6 +51,12 @@ def build_parser() -> argparse.ArgumentParser:
                          help="score the eager checker against the .records index")
         sub.add_argument("-u", "--upstream", action="store_true",
                          help="score the seqdoop checker against the .records index")
+        if name == "check-bam":
+            sub.add_argument(
+                "--sharded", action="store_true",
+                help="mesh-scale streaming check vs .records truth across"
+                     " all devices (compact summary output)",
+            )
         sub.add_argument("path")
 
     sub = sp.add_parser("full-check")
@@ -73,6 +79,10 @@ def build_parser() -> argparse.ArgumentParser:
     sub.add_argument("-n", "--num-iterations", type=int, default=1)
     sub.add_argument("-F", "--reference", default=None,
                      help="FASTA for reference-based (RR=true) CRAM decode")
+    sub.add_argument(
+        "--sharded", action="store_true",
+        help="mesh-scale streaming count across all devices (no hadoop leg)",
+    )
     sub.add_argument("path")
 
     sub = sp.add_parser("time-load")
@@ -134,7 +144,10 @@ def main(argv=None) -> int:
             if cmd == "check-bam":
                 from spark_bam_tpu.cli import check_bam
 
-                check_bam.run(ctx, args.spark_bam, args.upstream)
+                check_bam.run(
+                    ctx, args.spark_bam, args.upstream,
+                    sharded=getattr(args, "sharded", False),
+                )
             elif cmd == "check-blocks":
                 from spark_bam_tpu.cli import check_blocks
 
@@ -169,7 +182,7 @@ def main(argv=None) -> int:
             count_reads.run(
                 args.path, p, config.split_size_or(Config.LOAD_SPLIT_SIZE_DEFAULT),
                 config, args.spark_bam_first, args.num_iterations,
-                reference=args.reference,
+                reference=args.reference, sharded=args.sharded,
             )
         elif cmd == "index-blocks":
             from spark_bam_tpu.bgzf.index_blocks import index_blocks

@@ -247,7 +247,8 @@ def check_bam_sharded(
     confusion matrix ``psum``'d per sharded step.
 
     Returns ``{"true_positives", "false_positives", "false_negatives",
-    "true_negatives", "positions"}``. Escaped chains fall back to the
+    "true_negatives", "positions", "devices"}`` (``devices`` = the mesh
+    size the verdicts actually ran on). Escaped chains fall back to the
     single-device deferral-exact spans path, so the returned matrix is
     always exact.
     """
@@ -282,10 +283,12 @@ def check_bam_sharded(
             break
 
     if agg[3]:
-        return _check_bam_exact(
+        stats = _check_bam_exact(
             path, config, st.fresh, st.halo, st.pipeline.metas, truth_flats,
             st.total,
         )
+        stats["devices"] = 1  # the exact fallback is single-device
+        return stats
     tp, fp, fn = int(agg[0]), int(agg[1]), int(agg[2])
     return {
         "true_positives": tp,
@@ -293,6 +296,7 @@ def check_bam_sharded(
         "false_negatives": fn,
         "true_negatives": st.total - tp - fp - fn,
         "positions": st.total,
+        "devices": st.n_dev,
     }
 
 

@@ -263,3 +263,19 @@ def test_main_unknown_command_fails(capsys):
         main(["frobnicate"])
     assert e.value.code != 0
     assert "invalid choice" in capsys.readouterr().err
+
+
+def test_count_reads_sharded(bam2, tmp_path):
+    got = run_cli(["count-reads", "--sharded", str(bam2)], tmp_path)
+    lines = got.splitlines()
+    assert re.fullmatch(r"spark-bam read-count time: \d+", lines[0])
+    assert lines[1] == "Read count: 2500"
+
+
+def test_check_bam_sharded(bam1, tmp_path):
+    got = run_cli(["check-bam", "--sharded", str(bam1)], tmp_path)
+    assert got.splitlines() == [
+        "1608257 positions checked across 8 device(s)",
+        "0 false positives, 0 false negatives",
+        "true positives: 4917, true negatives: 1603340",
+    ]

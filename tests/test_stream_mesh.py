@@ -79,6 +79,7 @@ def test_check_bam_sharded_bam2_all_match():
         "false_negatives": 0,
         "true_negatives": 1_606_522 - 2500,
         "positions": 1_606_522,
+        "devices": 8,
     }
 
 
@@ -111,6 +112,8 @@ def test_check_bam_sharded_escape_fallback_matches_device_pass():
         BAM2, Config(), mesh=_mesh(),
         window_uncompressed=128 << 10, halo=32 << 10,
     )
+    assert via_fallback.pop("devices") == 1  # the exact fallback path ran
+    assert via_device.pop("devices") == 8
     assert via_fallback == via_device
 
 
