@@ -145,8 +145,7 @@ def main(argv=None) -> int:
                 from spark_bam_tpu.cli import check_bam
 
                 check_bam.run(
-                    ctx, args.spark_bam, args.upstream,
-                    sharded=getattr(args, "sharded", False),
+                    ctx, args.spark_bam, args.upstream, sharded=args.sharded
                 )
             elif cmd == "check-blocks":
                 from spark_bam_tpu.cli import check_blocks
@@ -205,6 +204,11 @@ def main(argv=None) -> int:
                 reindex=args.index,
             )
         return 0
+    except ValueError as e:
+        # Flag-combination errors (e.g. --sharded with -u or CRAM) present
+        # as one-line usage errors, not tracebacks.
+        print(f"error: {e}", file=sys.stderr)
+        return 2
     finally:
         if out:
             out.close()

@@ -279,3 +279,10 @@ def test_check_bam_sharded(bam1, tmp_path):
         "0 false positives, 0 false negatives",
         "true positives: 4917, true negatives: 1603340",
     ]
+
+
+def test_sharded_flag_conflicts_are_usage_errors(bam1, capsys):
+    assert main(["check-bam", "--sharded", "-u", str(bam1)]) == 2
+    assert "no sharded path" in capsys.readouterr().err
+    assert main(["count-reads", "--sharded", "x.cram"]) == 2
+    assert "BAM only" in capsys.readouterr().err

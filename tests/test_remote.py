@@ -212,3 +212,21 @@ def test_http_load_bam_and_plan(http_server):
     blocks = plan_blocks(url)  # no .blocks sidecar on the server → search path
     total = sum(m.uncompressed_size for p in blocks.partitions for m in p)
     assert total == manifest["uncompressed_bytes"]
+
+
+def test_http_count_reads_sharded(http_server):
+    """The mesh streaming path composes with remote IO: the sharded count
+    over an http:// URL equals the manifest (InflatePipeline, block plan,
+    and truth-free count all ride the ranged-GET channel)."""
+    import jax
+
+    from spark_bam_tpu.parallel.mesh import make_mesh
+    from spark_bam_tpu.parallel.stream_mesh import count_reads_sharded
+
+    url, manifest = http_server
+    mesh = make_mesh(jax.devices("cpu")[:8])
+    got = count_reads_sharded(
+        url, CFG, mesh=mesh,
+        window_uncompressed=512 << 10, halo=128 << 10,
+    )
+    assert got == manifest["reads"]
