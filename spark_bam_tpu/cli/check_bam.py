@@ -12,6 +12,7 @@ a compact confusion summary — the operator face of
 from __future__ import annotations
 
 from spark_bam_tpu.cli.app import CheckerContext
+from spark_bam_tpu.cli.output import UsageError
 
 
 def run(
@@ -25,12 +26,12 @@ def run(
         # -s composes; -u (seqdoop oracle) and -i (byte ranges) have no
         # sharded implementation — reject rather than silently ignore.
         if hadoop_bam:
-            raise ValueError(
+            raise UsageError(
                 "--sharded scores the eager checker against the .records "
                 "truth; the seqdoop oracle (-u) has no sharded path"
             )
         if ctx.ranges is not None:
-            raise ValueError(
+            raise UsageError(
                 "--sharded checks the whole file; -i/--intervals is not "
                 "supported on the sharded path"
             )

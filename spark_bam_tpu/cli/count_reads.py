@@ -5,7 +5,7 @@ from __future__ import annotations
 
 import time
 
-from spark_bam_tpu.cli.output import Printer
+from spark_bam_tpu.cli.output import Printer, UsageError
 from spark_bam_tpu.core.config import Config
 from spark_bam_tpu.load.api import load_bam, load_reads
 from spark_bam_tpu.load.hadoop import hadoop_bam_count
@@ -25,7 +25,7 @@ def run(
         # Mesh-scale streaming count across every device (no hadoop-bam
         # leg: this is the scale mode; the comparison mode is the default).
         if str(path).endswith(".cram"):
-            raise ValueError(
+            raise UsageError(
                 "--sharded supports BAM only: CRAM has no BGZF block "
                 "structure to window (use the default count-reads path)"
             )

@@ -18,6 +18,7 @@ from __future__ import annotations
 import argparse
 import sys
 
+from spark_bam_tpu.cli.output import UsageError
 from spark_bam_tpu.core.config import Config, parse_bytes
 
 
@@ -204,9 +205,9 @@ def main(argv=None) -> int:
                 reindex=args.index,
             )
         return 0
-    except ValueError as e:
+    except UsageError as e:
         # Flag-combination errors (e.g. --sharded with -u or CRAM) present
-        # as one-line usage errors, not tracebacks.
+        # as one-line usage errors; library failures keep their tracebacks.
         print(f"error: {e}", file=sys.stderr)
         return 2
     finally:

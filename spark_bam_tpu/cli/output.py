@@ -10,6 +10,12 @@ from __future__ import annotations
 import sys
 
 
+class UsageError(ValueError):
+    """Operator-facing flag/argument misuse: rendered by the CLI as a
+    one-line ``error: ...`` with exit code 2 (library failures keep their
+    tracebacks)."""
+
+
 class Printer:
     def __init__(self, out=None, limit: int = 10):
         self.out = out or sys.stdout

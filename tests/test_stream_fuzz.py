@@ -57,3 +57,29 @@ def test_streaming_projections_match_whole_file(tmp_path, seed):
     for base, batch in StreamChecker(path, Config(), **CFG).read_batches():
         rows += len(batch)
     assert rows == int(want.verdict[he:].sum())
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_sharded_count_matches_whole_file(tmp_path, seed):
+    """The mesh streaming count agrees with the whole-file oracle on the
+    same adversarial random BAMs (tiny windows/halos force multi-batch
+    assembly, seam carries, and — at halo=32K — occasional escapes)."""
+    import jax
+
+    from spark_bam_tpu.parallel.mesh import make_mesh
+    from spark_bam_tpu.parallel.stream_mesh import count_reads_sharded
+
+    path = tmp_path / f"fuzz{seed}.bam"
+    random_bam(
+        path, seed, contigs=(("chr1", 5_000_000), ("chr2", 3_000_000)),
+        dup_rate=0.1,
+    )
+    flat = flatten_file(path)
+    hdr = read_header(path)
+    lens = np.array(hdr.contig_lengths.lengths_list(), dtype=np.int32)
+    want = check_flat(flat.data, lens, at_eof=True)
+    he = hdr.uncompressed_size
+
+    mesh = make_mesh(jax.devices("cpu")[:8])
+    got = count_reads_sharded(path, Config(), mesh=mesh, **CFG)
+    assert got == int(want.verdict[he:].sum())
