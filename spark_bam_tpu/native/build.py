@@ -99,6 +99,7 @@ def _load_native_locked():
     c_u8p = ctypes.POINTER(ctypes.c_uint8)
     c_i64p = ctypes.POINTER(ctypes.c_int64)
     c_i32p = ctypes.POINTER(ctypes.c_int32)
+    c_u16p = ctypes.POINTER(ctypes.c_uint16)
 
     lib.sbt_inflate_blocks.restype = ctypes.c_long
     lib.sbt_inflate_blocks.argtypes = [
@@ -117,7 +118,7 @@ def _load_native_locked():
     lib.sbt_tokenize_deflate.restype = ctypes.c_long
     lib.sbt_tokenize_deflate.argtypes = [
         c_u8p, c_i64p, c_i64p, ctypes.c_int64,
-        c_u8p, c_i32p, ctypes.c_int64, c_i64p,
+        c_u8p, c_u16p, ctypes.c_int64, c_i64p,
     ]
     lib.sbt_rans_decompress.restype = ctypes.c_int64
     lib.sbt_rans_decompress.argtypes = [
@@ -189,9 +190,11 @@ def tokenize_deflate_native(
     stride: int,
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray] | None:
     """Phase 1 of the two-phase device inflate: entropy-decode raw-DEFLATE
-    payloads into fixed-shape (lit, parent, out_lens) token rows for the
-    device LZ77 resolver (tpu/inflate.py). Returns None if the native
-    library is unavailable."""
+    payloads into fixed-shape (lit, dist, out_lens) token rows for the
+    device LZ77 resolver (tpu/inflate.py) — u8 lit + u16 dist, 3 wire
+    bytes per output byte (dist=0 marks a literal; a back-reference's
+    parent is i - dist). Returns None if the native library is
+    unavailable."""
     lib = load_native()
     if lib is None:
         return None
@@ -200,7 +203,7 @@ def tokenize_deflate_native(
     lengths = np.ascontiguousarray(lengths, dtype=np.int64)
     count = len(offsets)
     lit = np.empty((count, stride), dtype=np.uint8)
-    parent = np.empty((count, stride), dtype=np.int32)
+    dist = np.empty((count, stride), dtype=np.uint16)
     out_lens = np.zeros(count, dtype=np.int64)
     rc = lib.sbt_tokenize_deflate(
         _ptr(comp, ctypes.c_uint8),
@@ -208,13 +211,13 @@ def tokenize_deflate_native(
         _ptr(lengths, ctypes.c_int64),
         count,
         _ptr(lit, ctypes.c_uint8),
-        _ptr(parent, ctypes.c_int32),
+        _ptr(dist, ctypes.c_uint16),
         stride,
         _ptr(out_lens, ctypes.c_int64),
     )
     if rc != 0:
         raise IOError(f"deflate tokenize failed at block {rc - 1}")
-    return lit, parent, out_lens
+    return lit, dist, out_lens
 
 
 def rans_decompress_native(blob: bytes, out_size: int) -> bytes | None:

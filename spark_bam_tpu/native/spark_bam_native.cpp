@@ -11,6 +11,7 @@
 //     and split-point scans without Python-loop overhead
 //   - sbt_find_record_start: byte-wise scan until a position passes
 //   - sbt_tokenize_deflate: phase 1 of the two-phase device inflate
+//     (u8 lit + u16 dist token rows — 3 wire bytes per output byte)
 //     (SURVEY.md §7 hard-part #1): entropy-decode DEFLATE into per-output-
 //     byte (literal, parent-pointer) token arrays, leaving all LZ77
 //     back-reference byte motion to the device resolver (tpu/inflate.py)
@@ -150,11 +151,15 @@ int64_t sbt_find_record_start(
 // ------------------------------------------------------------- tokenizer
 // RFC-1951 entropy decoder that emits tokens instead of bytes: for each
 // uncompressed output position i it records
-//   parent[i] = i        and lit[i] = the byte, for literal/stored output
-//   parent[i] = i - dist and lit[i] = 0,        for back-reference output
-// so the byte at i is the byte at its chain's root literal. The device
-// resolves every chain in parallel with log-step pointer doubling
-// (tpu/inflate.py resolve_lz77); this host phase does no byte copying.
+//   dist[i] = 0    and lit[i] = the byte, for literal/stored output
+//   dist[i] = dist and lit[i] = 0,        for back-reference output
+// so position i's implied parent is i - dist[i] (itself for literals) and
+// its byte is the byte at its chain's root literal. DEFLATE distances fit
+// 16 bits (max 32768), so the token stream is u8 lit + u16 dist = 3 bytes
+// per output byte on the wire — the device reconstructs parents from an
+// iota and resolves every chain in parallel with log-step pointer
+// doubling (tpu/inflate.py resolve_lz77); this host phase does no byte
+// copying.
 
 namespace {
 
@@ -291,7 +296,7 @@ static bool dynamic_tables(BitReader& br, Huff& lit, Huff& dist) {
 
 // Tokenize one raw-DEFLATE stream. Returns bytes produced, or -1 on error.
 static int64_t tokenize_one(const uint8_t* comp, int64_t clen, uint8_t* lit,
-                            int32_t* parent, int64_t cap) {
+                            uint16_t* dist_out, int64_t cap) {
   BitReader br{comp, clen, 0, 0, 0, true};
   int64_t o = 0;
   for (;;) {
@@ -310,7 +315,7 @@ static int64_t tokenize_one(const uint8_t* comp, int64_t clen, uint8_t* lit,
       if (br.pos + len > br.n || o + len > cap) return -1;
       for (uint32_t k = 0; k < len; ++k) {
         lit[o] = comp[br.pos + k];
-        parent[o] = (int32_t)o;
+        dist_out[o] = 0;
         ++o;
       }
       br.pos += len;
@@ -327,7 +332,7 @@ static int64_t tokenize_one(const uint8_t* comp, int64_t clen, uint8_t* lit,
         if (sym < 256) {
           if (o >= cap) return -1;
           lit[o] = (uint8_t)sym;
-          parent[o] = (int32_t)o;
+          dist_out[o] = 0;
           ++o;
         } else if (sym == 256) {
           break;
@@ -341,7 +346,7 @@ static int64_t tokenize_one(const uint8_t* comp, int64_t clen, uint8_t* lit,
           if (!br.ok || dist > o || o + len > cap) return -1;
           for (int k = 0; k < len; ++k) {
             lit[o] = 0;
-            parent[o] = (int32_t)(o - dist);
+            dist_out[o] = (uint16_t)dist;
             ++o;
           }
         }
@@ -527,8 +532,8 @@ int64_t sbt_rans_decompress(
   return -1;
 }
 
-// Tokenize `count` raw-DEFLATE payloads into (count, stride) lit/parent
-// rows; pads each row's tail with identity pointers so the device resolver
+// Tokenize `count` raw-DEFLATE payloads into (count, stride) lit/dist
+// rows; pads each row's tail with dist=0 (identity) so the device resolver
 // works on fixed shapes. Returns 0, or the 1-based index of the first
 // failing block.
 long sbt_tokenize_deflate(
@@ -537,19 +542,19 @@ long sbt_tokenize_deflate(
     const int64_t* lengths,
     int64_t count,
     uint8_t* lit,
-    int32_t* parent,
+    uint16_t* dist,
     int64_t stride,
     int64_t* out_lens) {
   for (int64_t i = 0; i < count; ++i) {
     uint8_t* l = lit + i * stride;
-    int32_t* p = parent + i * stride;
+    uint16_t* d = dist + i * stride;
     int64_t produced =
-        tokenize_one(comp + offsets[i], lengths[i], l, p, stride);
+        tokenize_one(comp + offsets[i], lengths[i], l, d, stride);
     if (produced < 0) return i + 1;
     out_lens[i] = produced;
     for (int64_t k = produced; k < stride; ++k) {
       l[k] = 0;
-      p[k] = (int32_t)k;
+      d[k] = 0;
     }
   }
   return 0;

@@ -10,10 +10,13 @@ Bit-serial Huffman decoding resists lane-parallelism, so the split is:
 
 1. *Host entropy phase* (`sbt_tokenize_deflate`, native/): decode the
    DEFLATE bitstream into per-output-byte tokens — ``lit[i]`` (the byte, if
-   position ``i`` was emitted by a literal) and ``parent[i]`` (``i`` for
-   literals; ``i - dist`` for back-reference bytes). No byte copying
-   happens on host: the LZ77 "copy" half of inflate — the memory-bandwidth
-   half — is deferred entirely.
+   position ``i`` was emitted by a literal) and ``dist[i]`` (0 for
+   literals; the back-reference distance otherwise, which fits u16 —
+   DEFLATE's max is 32768). Tokens cost 3 wire bytes per output byte on
+   the H2D hop; the implied parent pointer ``i - dist[i]`` is
+   reconstructed on device from an iota. No byte copying happens on host:
+   the LZ77 "copy" half of inflate — the memory-bandwidth half — is
+   deferred entirely.
 2. *Device copy phase* (`resolve_lz77`): every output byte's value is the
    byte at its pointer chain's root literal. Chains collapse in
    ``log2(64 KiB) = 16`` lock-step pointer-doubling rounds — pure gathers
@@ -54,16 +57,20 @@ _DOUBLING_ROUNDS = (STRIDE - 1).bit_length()  # collapses any chain in-range
 
 
 @jax.jit
-def resolve_lz77(lit: jnp.ndarray, parent: jnp.ndarray) -> jnp.ndarray:
+def resolve_lz77(lit: jnp.ndarray, dist: jnp.ndarray) -> jnp.ndarray:
     """Device phase 2: resolve all LZ77 back-references in parallel.
 
-    ``lit``/``parent`` are (B, STRIDE) token rows from the host entropy
-    phase. Pointer chains (copy → … → root literal) collapse with log-step
-    doubling — ``parent = parent[parent]`` per round — then one final
-    gather reads each root's literal byte. 16 rounds cover any chain that
-    fits a 64 KiB block; padded tails are identity pointers, so they
-    resolve to themselves harmlessly.
+    ``lit``/``dist`` are (B, STRIDE) u8/u16 token rows from the host
+    entropy phase (dist=0 ⇒ literal). Parents materialize on device as
+    ``i - dist`` (an iota minus the shipped distances — u16 on the wire,
+    i32 only in HBM), then pointer chains (copy → … → root literal)
+    collapse with log-step doubling — ``parent = parent[parent]`` per
+    round — and one final gather reads each root's literal byte. 16
+    rounds cover any chain that fits a 64 KiB block; padded tails are
+    dist=0 identities, so they resolve to themselves harmlessly.
     """
+    iota = jnp.arange(lit.shape[1], dtype=jnp.int32)[None, :]
+    parent = iota - dist.astype(jnp.int32)
 
     def round_(p, _):
         return jnp.take_along_axis(p, p, axis=1), None
@@ -86,7 +93,7 @@ def inflate_blocks_device(
     toks = tokenize_deflate_native(comp, offsets, lengths, stride=STRIDE)
     if toks is None:
         return None
-    lit, parent, out_lens = toks
+    lit, dist, out_lens = toks
     out_lengths = np.asarray(out_lengths, dtype=np.int64)
     if not np.array_equal(out_lens, out_lengths):
         raise IOError("tokenized output sizes disagree with block footers")
@@ -96,12 +103,12 @@ def inflate_blocks_device(
     b_pad = max(1 << max(b - 1, 0).bit_length(), 1)
     if b_pad != b:
         lit = np.concatenate([lit, np.zeros((b_pad - b, STRIDE), dtype=np.uint8)])
-        ident = np.broadcast_to(
-            np.arange(STRIDE, dtype=np.int32), (b_pad - b, STRIDE)
+        # dist=0 rows are identity chains — the pad resolves to itself.
+        dist = np.concatenate(
+            [dist, np.zeros((b_pad - b, STRIDE), dtype=np.uint16)]
         )
-        parent = np.concatenate([parent, ident])
     resolved = np.asarray(
-        resolve_lz77(jnp.asarray(lit), jnp.asarray(parent))
+        resolve_lz77(jnp.asarray(lit), jnp.asarray(dist))
     )[:b]
     return np.concatenate(
         [resolved[i, :n] for i, n in enumerate(out_lens.tolist())]

@@ -167,17 +167,23 @@ def test_size_mismatch_raises():
 def test_tokenize_shapes():
     data = b"shape check " * 32
     comp = np.frombuffer(_deflate(data), dtype=np.uint8)
-    lit, parent, out_lens = tokenize_deflate_native(
+    lit, dist, out_lens = tokenize_deflate_native(
         comp,
         np.array([0], dtype=np.int64),
         np.array([len(comp)], dtype=np.int64),
         stride=STRIDE,
     )
-    assert lit.shape == (1, STRIDE) and parent.shape == (1, STRIDE)
+    assert lit.shape == (1, STRIDE) and dist.shape == (1, STRIDE)
+    assert dist.dtype == np.uint16  # 3 wire bytes per output byte total
     assert out_lens[0] == len(data)
-    # Padded tail must be identity pointers.
-    tail = np.arange(len(data), STRIDE, dtype=np.int32)
-    assert np.array_equal(parent[0, len(data):], tail)
+    # Padded tail must be dist=0 identities.
+    assert not dist[0, len(data):].any()
+    # The repeated motif must actually produce back-references (dist>0)
+    # whose implied parents point strictly backwards.
+    used = dist[0, : len(data)].astype(np.int64)
+    assert used.max() > 0
+    idx = np.arange(len(data), dtype=np.int64)
+    assert ((idx - used) >= 0).all()
 
 
 def test_pipeline_device_copy_matches_host(bam2):
