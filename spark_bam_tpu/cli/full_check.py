@@ -41,6 +41,111 @@ def _mask_counts(masks: np.ndarray) -> dict[str, int]:
     return out
 
 
+def _render_report(p, crit_idx, crit_masks, two_idx, two_masks,
+                   total_counts, fmt_pos) -> None:
+    """The critical / two-check / total sections, shared by the in-memory
+    and streaming paths so the mask-derived output cannot diverge.
+    ``fmt_pos(flat_idx)`` renders one position (annotated in-memory,
+    ``block:offset`` streaming)."""
+
+    def limited(idx):
+        # Respect limit=0 = unlimited; otherwise avoid formatting more
+        # than the printer will show.
+        return idx if not p.limit else idx[: p.limit]
+
+    if len(crit_idx) == 0:
+        p.echo("No positions where only one check failed")
+    else:
+        p.echo("Critical error counts (true negatives where only one check failed):")
+        p.echo(*("\t" + l for l in _counts_lines(_mask_counts(crit_masks))))
+        p.echo("")
+        p.print_limited(
+            [fmt_pos(int(i)) for i in limited(crit_idx)],
+            total=len(crit_idx),
+            header=f"{len(crit_idx)} critical positions:",
+            truncated_header=lambda n: f"{n} of {len(crit_idx)} critical positions:",
+        )
+
+    p.echo("")
+
+    if len(two_idx) == 0:
+        p.echo("No positions where exactly two checks failed", "")
+    else:
+        p.print_limited(
+            [fmt_pos(int(i)) for i in limited(two_idx)],
+            total=len(two_idx),
+            header=f"{len(two_idx)} positions where exactly two checks failed:",
+            truncated_header=lambda n: (
+                f"{n} of {len(two_idx)} positions where exactly two checks failed:"
+            ),
+        )
+        p.echo("")
+        combo_hist: dict[int, int] = {}
+        for m in two_masks:
+            combo_hist[int(m)] = combo_hist.get(int(m), 0) + 1
+
+        def combo_str(mask: int) -> str:
+            return ",".join(n for i, n in enumerate(FLAG_NAMES) if mask & (1 << i))
+
+        top = sorted(combo_hist.items(), key=lambda kv: -kv[1])
+        if top[0][1] > 1:
+            with p.indent():
+                p.print_limited(
+                    [f"{count}:\t{combo_str(mask)}" for mask, count in top],
+                    header="Histogram:",
+                    truncated_header=lambda n: "Histogram:",
+                )
+            p.echo("")
+        with p.indent():
+            p.echo("Per-flag totals:")
+            p.echo(*("\t" + l for l in _counts_lines(_mask_counts(two_masks))))
+        p.echo("")
+
+    p.echo("Total error counts:")
+    p.echo(*(
+        "\t" + l
+        for l in _counts_lines(total_counts, hide_bit0=True, include_zeros=True)
+    ))
+    p.echo("")
+
+
+def run_streaming(ctx: CheckerContext) -> None:
+    """The WGS-scale face: same aggregations via ``full_spans`` in
+    O(window) host memory. Mask-derived sections render through the same
+    code as the in-memory report (byte-identical); position lists print
+    as ``block:offset`` without the record annotations (those need
+    per-hit record decodes, which the default in-memory path provides).
+    The device/NumPy engine choice honors ``spark.bam.backend`` through
+    the same hang-proof probe as the in-memory path."""
+    from spark_bam_tpu.bgzf.flat import metas_block_table, pos_of_flat_tables
+    from spark_bam_tpu.bgzf.index_blocks import blocks_metadata
+    from spark_bam_tpu.cli.output import UsageError
+    from spark_bam_tpu.tpu.stream_check import full_check_summary_streaming
+
+    if ctx.ranges is not None:
+        raise UsageError(
+            "--streaming scans the whole file; -i/--intervals is not "
+            "supported on the streaming path"
+        )
+    p = ctx.printer
+    metas = list(blocks_metadata(ctx.path))  # one scan: summary + pos tables
+    s = full_check_summary_streaming(
+        ctx.path, ctx.config, use_device=ctx._use_tpu_backend(), metas=metas
+    )
+    block_starts, block_flat = metas_block_table(metas)
+
+    def pos_str(i: int) -> str:
+        b, o = pos_of_flat_tables(block_starts, block_flat, i)
+        return f"{b}:{o}"
+
+    _render_report(
+        p,
+        s["critical_positions"], s["critical_masks"],
+        s["two_check_positions"], s["two_check_masks"],
+        s["per_flag"], pos_str,
+    )
+
+
 def run(ctx: CheckerContext) -> None:
     p = ctx.printer
     res = ctx.eager_result
@@ -68,63 +173,12 @@ def run(ctx: CheckerContext) -> None:
         return np.flatnonzero(considered & (num_fields == k))
 
     ones = bucket(1)
-    if len(ones) == 0:
-        p.echo("No positions where only one check failed")
-    else:
-        p.echo("Critical error counts (true negatives where only one check failed):")
-        p.echo(*("\t" + l for l in _counts_lines(_mask_counts(masks[ones]))))
-        p.echo("")
-        p.print_limited(
-            [str(ctx.annotate(int(i))) for i in ones[: max(p.limit, 1)]],
-            total=len(ones),
-            header=f"{len(ones)} critical positions:",
-            truncated_header=lambda n: f"{n} of {len(ones)} critical positions:",
-        )
-
-    p.echo("")
-
     twos = bucket(2)
-    if len(twos) == 0:
-        p.echo("No positions where exactly two checks failed", "")
-    else:
-        p.print_limited(
-            [str(ctx.annotate(int(i))) for i in twos[: max(p.limit, 1)]],
-            total=len(twos),
-            header=f"{len(twos)} positions where exactly two checks failed:",
-            truncated_header=lambda n: (
-                f"{n} of {len(twos)} positions where exactly two checks failed:"
-            ),
-        )
-        p.echo("")
-        combo_hist: dict[int, int] = {}
-        for m in masks[twos]:
-            combo_hist[int(m)] = combo_hist.get(int(m), 0) + 1
-
-        def combo_str(mask: int) -> str:
-            return ",".join(n for i, n in enumerate(FLAG_NAMES) if mask & (1 << i))
-
-        top = sorted(combo_hist.items(), key=lambda kv: -kv[1])
-        if top[0][1] > 1:
-            with p.indent():
-                p.print_limited(
-                    [f"{count}:\t{combo_str(mask)}" for mask, count in top],
-                    header="Histogram:",
-                    truncated_header=lambda n: "Histogram:",
-                )
-            p.echo("")
-        with p.indent():
-            p.echo("Per-flag totals:")
-            p.echo(*("\t" + l for l in _counts_lines(_mask_counts(masks[twos]))))
-        p.echo("")
-
     all_considered = np.flatnonzero(considered)
-    p.echo("Total error counts:")
-    # include_zeros: the reference's Counts.lines defaults to showing zero
-    # counts here (only the critical/per-flag sections exclude them).
-    p.echo(*(
-        "\t" + l
-        for l in _counts_lines(
-            _mask_counts(masks[all_considered]), hide_bit0=True, include_zeros=True
-        )
-    ))
-    p.echo("")
+    _render_report(
+        p,
+        ones, masks[ones],
+        twos, masks[twos],
+        _mask_counts(masks[all_considered]),
+        lambda i: str(ctx.annotate(i)),
+    )

@@ -62,6 +62,11 @@ def build_parser() -> argparse.ArgumentParser:
 
     sub = sp.add_parser("full-check")
     _add_common(sub)
+    sub.add_argument(
+        "--streaming", action="store_true",
+        help="WGS-scale O(window)-memory scan; mask-derived sections match"
+             " the default report, position lists print unannotated",
+    )
     sub.add_argument("path")
 
     sub = sp.add_parser("compute-splits")
@@ -155,7 +160,10 @@ def main(argv=None) -> int:
             elif cmd == "full-check":
                 from spark_bam_tpu.cli import full_check
 
-                full_check.run(ctx)
+                if args.streaming:
+                    full_check.run_streaming(ctx)
+                else:
+                    full_check.run(ctx)
             elif cmd == "compute-splits":
                 from spark_bam_tpu.cli import compute_splits
 

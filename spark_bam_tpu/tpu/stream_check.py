@@ -564,6 +564,7 @@ def full_check_summary_streaming(
     halo: int | None = None,
     use_device: bool = True,
     progress: Callable[[int, int, int], None] | None = None,
+    metas: list | None = None,
 ) -> dict:
     """The full-check workload's aggregations at arbitrary scale: per-flag
     totals, considered-position count, and the critical (exactly one check
@@ -580,7 +581,8 @@ def full_check_summary_streaming(
     )
 
     checker = StreamChecker(
-        path, config, window_uncompressed, halo, use_device, progress
+        path, config, window_uncompressed, halo, use_device, progress,
+        metas=metas,
     )
     per_flag = np.zeros(len(FLAG_NAMES), dtype=np.int64)
     considered_total = 0

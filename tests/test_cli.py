@@ -286,3 +286,27 @@ def test_sharded_flag_conflicts_are_usage_errors(bam1, capsys):
     assert "no sharded path" in capsys.readouterr().err
     assert main(["count-reads", "--sharded", "x.cram"]) == 2
     assert "BAM only" in capsys.readouterr().err
+
+
+def test_full_check_streaming_matches_golden_sections(bam2, tmp_path):
+    """full-check --streaming (the WGS-scale O(window) path): every
+    mask-derived section — two-check histogram, per-flag totals, total
+    error counts — is byte-identical to the reference golden; the
+    position list carries the same positions, unannotated."""
+    got = run_cli(["full-check", "--streaming", str(bam2)], tmp_path)
+    golden = (GOLDEN / "full-check" / "2.bam").read_text()
+
+    assert got.startswith(
+        "No positions where only one check failed\n"
+        "\n"
+        "10 of 2880 positions where exactly two checks failed:\n"
+        "\t0:5649\n"
+    )
+    hist_start = golden.index("\tHistogram:")
+    assert golden[hist_start: golden.index("Total error counts:")] in got
+    assert golden[golden.index("Total error counts:"):] in got
+
+
+def test_full_check_streaming_rejects_intervals(bam2, capsys):
+    assert main(["full-check", "--streaming", "-i", "0-100k", str(bam2)]) == 2
+    assert "not supported on the streaming path" in capsys.readouterr().err
