@@ -19,7 +19,9 @@ tests/test_remote.py for the injected-latency proof.
 from __future__ import annotations
 
 import http.client
+import random
 import threading
+import time
 import urllib.parse
 
 from spark_bam_tpu.core.channel import ByteChannel
@@ -33,9 +35,13 @@ class HttpRangeChannel(ByteChannel):
     concurrent in-flight GETs.
     """
 
+    #: transient statuses worth retrying (GCS/S3 throttling + 5xx blips)
+    RETRY_STATUSES = (429, 500, 502, 503, 504)
+
     def __init__(self, url: str, headers: dict | None = None,
-                 timeout: float = 30.0):
+                 timeout: float = 30.0, retries: int = 3):
         super().__init__()
+        self._retries = max(0, retries)
         self.url = url
         u = urllib.parse.urlsplit(url)
         if u.scheme not in ("http", "https"):
@@ -84,13 +90,39 @@ class HttpRangeChannel(ByteChannel):
                 if attempt:
                     raise
 
+    def _request_retrying(self, method: str, extra_headers: dict):
+        """One logical request absorbing transient throttle/5xx statuses:
+        bounded retries with jittered exponential backoff (lockstep
+        prefetch workers must not re-fire in synchronized bursts), a
+        server-provided ``Retry-After`` honored when present, and an early
+        exit when the channel closes mid-backoff. Returns (resp, body)."""
+        delay = 0.1
+        for attempt in range(self._retries + 1):
+            resp = self._request(method, extra_headers)
+            body = resp.read()
+            if (
+                resp.status not in self.RETRY_STATUSES
+                or attempt == self._retries
+                or self._closed
+            ):
+                return resp, body
+            retry_after = resp.headers.get("Retry-After")
+            try:
+                wait = float(retry_after) if retry_after else 0.0
+            except ValueError:
+                wait = 0.0
+            if not wait:
+                wait = delay * (0.5 + random.random())
+            time.sleep(min(wait, 5.0))
+            delay *= 4
+        return resp, body  # unreachable; loop always returns
+
     def _read_at(self, pos: int, n: int) -> bytes:
         if n <= 0 or self._closed:
             return b""
-        resp = self._request(
+        resp, body = self._request_retrying(
             "GET", {"Range": f"bytes={pos}-{pos + n - 1}"}
         )
-        body = resp.read()
         if resp.status == 206:
             self._learn_size(resp.headers.get("Content-Range"))
             return body
@@ -114,8 +146,7 @@ class HttpRangeChannel(ByteChannel):
     def size(self) -> int:
         with self._size_lock:
             if self._size is None:
-                resp = self._request("HEAD", {})
-                resp.read()
+                resp, _ = self._request_retrying("HEAD", {})
                 length = resp.headers.get("Content-Length")
                 if resp.status == 404:
                     # Distinguishable "missing" (sidecar probes rely on it);

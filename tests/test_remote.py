@@ -11,7 +11,6 @@ with Range support must serve the same counts through ``http://`` paths.
 
 import threading
 import time
-from functools import partial
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
@@ -169,9 +168,8 @@ class _RangeHandler(BaseHTTPRequestHandler):
 @pytest.fixture(scope="module")
 def http_server(synth):
     path, manifest = synth
-    handler = partial(_RangeHandler)
     _RangeHandler.payload = path.read_bytes()
-    srv = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _RangeHandler)
     thread = threading.Thread(target=srv.serve_forever, daemon=True)
     thread.start()
     yield f"http://127.0.0.1:{srv.server_address[1]}/synth.bam", manifest
@@ -230,3 +228,58 @@ def test_http_count_reads_sharded(http_server):
         window_uncompressed=512 << 10, halo=128 << 10,
     )
     assert got == manifest["reads"]
+
+
+class _FlakyHandler(_RangeHandler):
+    """Returns 503 for the first ``fail_budget`` requests, then serves."""
+
+    fail_budget = 0
+
+    def _maybe_fail(self) -> bool:
+        cls = _FlakyHandler
+        if cls.fail_budget > 0:
+            cls.fail_budget -= 1
+            self.send_response(503)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return True
+        return False
+
+    def do_GET(self):
+        if not self._maybe_fail():
+            super().do_GET()
+
+    def do_HEAD(self):
+        if not self._maybe_fail():
+            super().do_HEAD()
+
+
+def test_http_transient_503_retries(synth):
+    """Transient throttling (GCS/S3-style 503s) must be absorbed by the
+    channel's bounded retry, and a persistent failure must still raise."""
+    from spark_bam_tpu.core.remote import HttpRangeChannel
+
+    path, _ = synth
+    _FlakyHandler.payload = path.read_bytes()
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _FlakyHandler)
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    url = f"http://127.0.0.1:{srv.server_address[1]}/synth.bam"
+    try:
+        _FlakyHandler.fail_budget = 2
+        with HttpRangeChannel(url) as ch:
+            assert ch.read_at(0, 4) == _FlakyHandler.payload[:4]
+        assert _FlakyHandler.fail_budget == 0
+
+        # The size probe (HEAD) rides the same retry.
+        _FlakyHandler.fail_budget = 2
+        with HttpRangeChannel(url) as ch:
+            assert ch.size == len(_FlakyHandler.payload)
+        assert _FlakyHandler.fail_budget == 0
+
+        _FlakyHandler.fail_budget = 10**6  # beyond any retry budget
+        with HttpRangeChannel(url, retries=1) as ch:
+            with pytest.raises(IOError, match="HTTP 503"):
+                ch.read_at(0, 4)
+    finally:
+        srv.shutdown()
