@@ -9,6 +9,7 @@ was seen (reference :160-174), unless ``strict``.
 from __future__ import annotations
 
 import logging
+import os
 import time
 
 from spark_bam_tpu.bam.iterators import PosStream
@@ -44,18 +45,27 @@ def index_records(
     out_path = str(out_path) if out_path is not None else str(bam_path) + ".records"
     count = 0
     last_beat = time.monotonic()
-    with open_channel(bam_path) as ch, open(out_path, "w") as out:
-        stream = PosStream.open(ch)
-        try:
-            for pos in stream:
-                out.write(format_record_line(pos) + "\n")
-                count += 1
-                now = time.monotonic()
-                if now - last_beat >= heartbeat_seconds:
-                    log.info("indexed %d records (at %s)", count, pos)
-                    last_beat = now
-        except (EOFError, IOError):
-            if strict:
-                raise
-            log.warning("truncated BAM: stopping after %d records", count)
+    # Write-then-rename (pid-suffixed: concurrent indexers must not
+    # interleave): a crash mid-index must never leave a truncated sidecar
+    # that downstream consumers would trust as ground truth.
+    tmp_path = f"{out_path}.tmp{os.getpid()}"
+    try:
+        with open_channel(bam_path) as ch, open(tmp_path, "w") as out:
+            stream = PosStream.open(ch)
+            try:
+                for pos in stream:
+                    out.write(format_record_line(pos) + "\n")
+                    count += 1
+                    now = time.monotonic()
+                    if now - last_beat >= heartbeat_seconds:
+                        log.info("indexed %d records (at %s)", count, pos)
+                        last_beat = now
+            except (EOFError, IOError):
+                if strict:
+                    raise
+                log.warning("truncated BAM: stopping after %d records", count)
+        os.replace(tmp_path, out_path)
+    finally:
+        if os.path.exists(tmp_path):  # failure path only; replace moved it
+            os.unlink(tmp_path)
     return out_path, count

@@ -9,6 +9,7 @@ it skips the parallel block search.
 from __future__ import annotations
 
 import logging
+import os
 import time
 from typing import Iterable
 
@@ -47,14 +48,25 @@ def index_blocks(
     out_path = str(out_path) if out_path is not None else str(bam_path) + ".blocks"
     count = 0
     last_beat = time.monotonic()
-    with open_channel(bam_path) as ch, open(out_path, "w") as out:
-        for meta in MetadataStream(ch):
-            out.write(format_block_line(meta) + "\n")
-            count += 1
-            now = time.monotonic()
-            if now - last_beat >= heartbeat_seconds:
-                log.info("indexed %d blocks (at offset %d)", count, meta.start)
-                last_beat = now
+    # Write-then-rename (pid-suffixed: concurrent indexers must not
+    # interleave): a crash mid-index must never leave a truncated sidecar
+    # (blocks_metadata trusts it blindly, as the reference does).
+    tmp_path = f"{out_path}.tmp{os.getpid()}"
+    try:
+        with open_channel(bam_path) as ch, open(tmp_path, "w") as out:
+            for meta in MetadataStream(ch):
+                out.write(format_block_line(meta) + "\n")
+                count += 1
+                now = time.monotonic()
+                if now - last_beat >= heartbeat_seconds:
+                    log.info(
+                        "indexed %d blocks (at offset %d)", count, meta.start
+                    )
+                    last_beat = now
+        os.replace(tmp_path, out_path)
+    finally:
+        if os.path.exists(tmp_path):  # failure path only; replace moved it
+            os.unlink(tmp_path)
     return out_path, count
 
 

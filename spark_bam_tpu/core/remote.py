@@ -91,31 +91,42 @@ class HttpRangeChannel(ByteChannel):
                     raise
 
     def _request_retrying(self, method: str, extra_headers: dict):
-        """One logical request absorbing transient throttle/5xx statuses:
-        bounded retries with jittered exponential backoff (lockstep
+        """One logical request absorbing transient failures: throttle/5xx
+        statuses AND connection drops mid-body (the common object-store
+        blip), with bounded jittered exponential backoff (lockstep
         prefetch workers must not re-fire in synchronized bursts), a
-        server-provided ``Retry-After`` honored when present, and an early
-        exit when the channel closes mid-backoff. Returns (resp, body)."""
+        server-provided ``Retry-After`` honored when positive, and an
+        early exit when the channel closes mid-backoff. Returns
+        (resp, body)."""
         delay = 0.1
         for attempt in range(self._retries + 1):
-            resp = self._request(method, extra_headers)
-            body = resp.read()
-            if (
-                resp.status not in self.RETRY_STATUSES
-                or attempt == self._retries
-                or self._closed
-            ):
-                return resp, body
-            retry_after = resp.headers.get("Retry-After")
+            final = attempt == self._retries or self._closed
+            wait = 0.0
             try:
-                wait = float(retry_after) if retry_after else 0.0
-            except ValueError:
-                wait = 0.0
-            if not wait:
+                resp = self._request(method, extra_headers)
+                body = resp.read()
+            except (http.client.HTTPException, ConnectionError, OSError):
+                # Reset during read(): drop the stale keep-alive so the
+                # next attempt reconnects; retryable like a 5xx.
+                conn = getattr(self._local, "conn", None)
+                if conn is not None:
+                    conn.close()
+                    self._local.conn = None
+                if final:
+                    raise
+            else:
+                if resp.status not in self.RETRY_STATUSES or final:
+                    return resp, body
+                retry_after = resp.headers.get("Retry-After")
+                try:
+                    wait = float(retry_after) if retry_after else 0.0
+                except ValueError:
+                    wait = 0.0
+            if wait <= 0:
                 wait = delay * (0.5 + random.random())
             time.sleep(min(wait, 5.0))
             delay *= 4
-        return resp, body  # unreachable; loop always returns
+        raise IOError(f"{method} {self.url}: retries exhausted")
 
     def _read_at(self, pos: int, n: int) -> bytes:
         if n <= 0 or self._closed:
@@ -144,19 +155,23 @@ class HttpRangeChannel(ByteChannel):
 
     @property
     def size(self) -> int:
-        with self._size_lock:
-            if self._size is None:
-                resp, _ = self._request_retrying("HEAD", {})
-                length = resp.headers.get("Content-Length")
-                if resp.status == 404:
-                    # Distinguishable "missing" (sidecar probes rely on it);
-                    # other statuses are real errors and must propagate.
-                    raise FileNotFoundError(f"HEAD {self.url}: HTTP 404")
-                if resp.status != 200 or length is None:
-                    raise IOError(
-                        f"HEAD {self.url}: HTTP {resp.status}, no length"
-                    )
-                self._size = int(length)
+        # Double-checked: the HEAD (with its retry backoff) runs outside
+        # the lock so a throttled probe can't stall every thread that
+        # touches ``size``; a rare duplicate probe is harmless.
+        if self._size is None:
+            resp, _ = self._request_retrying("HEAD", {})
+            length = resp.headers.get("Content-Length")
+            if resp.status == 404:
+                # Distinguishable "missing" (sidecar probes rely on it);
+                # other statuses are real errors and must propagate.
+                raise FileNotFoundError(f"HEAD {self.url}: HTTP 404")
+            if resp.status != 200 or length is None:
+                raise IOError(
+                    f"HEAD {self.url}: HTTP {resp.status}, no length"
+                )
+            with self._size_lock:
+                if self._size is None:
+                    self._size = int(length)
         return self._size
 
     def close(self) -> None:

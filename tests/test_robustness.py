@@ -109,3 +109,31 @@ def test_streaming_count_truncated_at_block_boundary_counts_prefix(
     t.write_bytes(data[: metas[15].start])
     n = count_reads_streaming(t)
     assert 0 < n < 2500  # a strict prefix of the 2500 reads
+
+
+def test_index_records_strict_raise_leaves_no_sidecar(bam2, tmp_path):
+    """When strict mode raises (cut length prefix — the one case the
+    pinned reference semantics make strict-fatal), neither the sidecar
+    nor its tmp file may be left behind (write-then-rename discipline)."""
+    from spark_bam_tpu.bam.index_records import index_records
+    from spark_bam_tpu.bam.iterators import RecordStream
+    from spark_bam_tpu.bam.writer import BgzfWriter, encode_bam_header
+    from spark_bam_tpu.core.channel import open_channel
+
+    with open_channel(bam2) as ch:
+        rs = RecordStream.open(ch)
+        header = rs.header
+        records = [rec.encode() for _, rec in rs][:5]
+
+    bad = tmp_path / "cut.bam"
+    with open(bad, "wb") as f, BgzfWriter(f, block_payload=100_000) as w:
+        w.write(encode_bam_header(header))
+        for enc in records:
+            w.write(enc)
+        w.write(b"\x99\x01")  # dangling 2-byte length-prefix fragment
+
+    out = tmp_path / "cut.records"
+    with pytest.raises(EOFError):
+        index_records(bad, out, strict=True)
+    assert not out.exists()
+    assert not list(tmp_path.glob("*.tmp*"))
