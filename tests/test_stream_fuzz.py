@@ -83,3 +83,42 @@ def test_sharded_count_matches_whole_file(tmp_path, seed):
     mesh = make_mesh(jax.devices("cpu")[:8])
     got = count_reads_sharded(path, Config(), mesh=mesh, **CFG)
     assert got == int(want.verdict[he:].sum())
+
+
+def test_sharded_check_bam_matches_whole_file(tmp_path):
+    """check_bam_sharded's truth alignment (block→flat mapping via
+    searchsorted against the sidecar) must reproduce the whole-file
+    confusion exactly on a random BAM."""
+    import jax
+
+    from spark_bam_tpu.bam.index_records import index_records
+    from spark_bam_tpu.parallel.mesh import make_mesh
+    from spark_bam_tpu.parallel.stream_mesh import check_bam_sharded
+
+    path = tmp_path / "fuzz_cb.bam"
+    random_bam(
+        path, 7, contigs=(("chr1", 5_000_000), ("chr2", 3_000_000)),
+        dup_rate=0.1,
+    )
+    index_records(path)
+
+    flat = flatten_file(path)
+    hdr = read_header(path)
+    lens = np.array(hdr.contig_lengths.lengths_list(), dtype=np.int32)
+    want = check_flat(flat.data, lens, at_eof=True)
+    truth = np.zeros(flat.size, dtype=bool)
+    he = hdr.uncompressed_size
+    truth_idx = np.flatnonzero(want.verdict)
+    truth[truth_idx[truth_idx >= he]] = True  # sidecar == real starts
+
+    stats = check_bam_sharded(
+        path, Config(), mesh=make_mesh(jax.devices("cpu")[:8]), **CFG
+    )
+    tp = int((want.verdict & truth).sum())
+    fp = int((want.verdict & ~truth).sum())
+    fn = int((~want.verdict & truth).sum())
+    assert stats["true_positives"] == tp
+    assert stats["false_positives"] == fp
+    assert stats["false_negatives"] == fn
+    assert stats["positions"] == flat.size
+    assert stats["true_negatives"] == flat.size - tp - fp - fn
