@@ -60,6 +60,17 @@ class PosAnnotation:
         return f"{self.pos}:\t{rec}. Failing checks: {self.flags}"
 
 
+def print_report_header(p, total: int, compressed: int, num_reads: int):
+    """The golden report's four-line header (positions / compressed size /
+    ratio / reads) — one renderer for the in-memory and sharded paths."""
+    p.echo(
+        f"{total} uncompressed positions",
+        f"{format_bytes_binary(compressed)} compressed",
+        "Compression ratio: %.2f" % (total / compressed),
+        f"{num_reads} reads",
+    )
+
+
 class CheckerContext:
     def __init__(
         self,
@@ -244,15 +255,7 @@ class CheckerContext:
         num_reads = tp + len(fn_idx)
         tn = in_scope - num_reads - len(fp_idx)
         total = in_scope
-        compressed = self.selected_compressed_size
-        ratio = total / compressed
-
-        p.echo(
-            f"{total} uncompressed positions",
-            f"{format_bytes_binary(compressed)} compressed",
-            "Compression ratio: %.2f" % ratio,
-            f"{num_reads} reads",
-        )
+        print_report_header(p, total, self.selected_compressed_size, num_reads)
 
         if not len(fp_idx) and not len(fn_idx):
             p.echo("All calls matched!")

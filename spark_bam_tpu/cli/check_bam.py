@@ -47,19 +47,23 @@ def run(
 
 
 def _run_sharded(ctx: CheckerContext) -> None:
+    from spark_bam_tpu.bgzf.index_blocks import blocks_metadata
+    from spark_bam_tpu.cli.app import print_report_header
     from spark_bam_tpu.parallel.stream_mesh import check_bam_sharded
 
-    stats = check_bam_sharded(ctx.path, ctx.config)
+    metas = list(blocks_metadata(ctx.path))  # one scan: stats + sizes
+    stats = check_bam_sharded(ctx.path, ctx.config, metas=metas)
+    # Golden semantics: sum of data blocks, excluding the EOF sentinel
+    # (the reference's compressedSizeAccumulator) — NOT the raw file size.
+    compressed = sum(m.compressed_size for m in metas)
+    num_reads = stats["true_positives"] + stats["false_negatives"]
     p = ctx.printer
-    p.echo(
-        f"{stats['positions']} positions checked across "
-        f"{stats['devices']} device(s)"
-    )
+    print_report_header(p, stats["positions"], compressed, num_reads)
+    p.echo(f"checked across {stats['devices']} device(s)")
+    if not stats["false_positives"] and not stats["false_negatives"]:
+        p.echo("All calls matched!")
+        return
     p.echo(
         f"{stats['false_positives']} false positives, "
         f"{stats['false_negatives']} false negatives"
-    )
-    p.echo(
-        f"true positives: {stats['true_positives']}, "
-        f"true negatives: {stats['true_negatives']}"
     )

@@ -274,10 +274,14 @@ def test_count_reads_sharded(bam2, tmp_path):
 
 def test_check_bam_sharded(bam1, tmp_path):
     got = run_cli(["check-bam", "--sharded", str(bam1)], tmp_path)
+    golden = (GOLDEN / "check-bam" / "1.bam").read_text()
+    # Header block identical to the golden report's first four lines
+    # (eager-vs-truth has no miscalls; the golden's FP lines are the
+    # seqdoop comparison's).
     assert got.splitlines() == [
-        "1608257 positions checked across 8 device(s)",
-        "0 false positives, 0 false negatives",
-        "true positives: 4917, true negatives: 1603340",
+        *golden.splitlines()[:4],
+        "checked across 8 device(s)",
+        "All calls matched!",
     ]
 
 

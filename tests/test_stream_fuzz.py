@@ -95,6 +95,8 @@ def test_sharded_check_bam_matches_whole_file(tmp_path):
     from spark_bam_tpu.parallel.mesh import make_mesh
     from spark_bam_tpu.parallel.stream_mesh import check_bam_sharded
 
+    from spark_bam_tpu.core.pos import Pos
+
     path = tmp_path / "fuzz_cb.bam"
     random_bam(
         path, 7, contigs=(("chr1", 5_000_000), ("chr2", 3_000_000)),
@@ -111,14 +113,56 @@ def test_sharded_check_bam_matches_whole_file(tmp_path):
     truth_idx = np.flatnonzero(want.verdict)
     truth[truth_idx[truth_idx >= he]] = True  # sidecar == real starts
 
+    # Perturb: one bogus truth entry at a non-boundary position, so the
+    # false-negative accounting is actually exercised (fn must come out 1,
+    # not merely 0 == 0).
+    bogus = int(truth_idx[len(truth_idx) // 2]) + 1
+    assert not truth[bogus]
+    truth[bogus] = True
+    sidecar = tmp_path / "tampered.records"
+    lines = [
+        f"{b},{o}"
+        for b, o in zip(*flat.pos_of_flat_many(np.flatnonzero(truth)))
+    ]
+    sidecar.write_text("\n".join(lines) + "\n")
+
     stats = check_bam_sharded(
-        path, Config(), mesh=make_mesh(jax.devices("cpu")[:8]), **CFG
+        path, Config(), mesh=make_mesh(jax.devices("cpu")[:8]),
+        records_path=sidecar, **CFG
     )
     tp = int((want.verdict & truth).sum())
     fp = int((want.verdict & ~truth).sum())
     fn = int((~want.verdict & truth).sum())
+    assert fn == 1  # the perturbation is visible, not vacuous
     assert stats["true_positives"] == tp
     assert stats["false_positives"] == fp
     assert stats["false_negatives"] == fn
     assert stats["positions"] == flat.size
     assert stats["true_negatives"] == flat.size - tp - fp - fn
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_truncation_fuzz_device_vs_numpy_engines(tmp_path, seed):
+    """Random cuts through a random BAM: the device and NumPy engines must
+    agree byte-for-byte through the identical streaming control flow —
+    same count when the cut reads cleanly, same error class when it
+    doesn't (the pinned truncation semantics)."""
+    rng = np.random.default_rng(1000 + seed)
+    path = tmp_path / f"t{seed}.bam"
+    random_bam(path, seed, contigs=(("chr1", 5_000_000),), dup_rate=0.05)
+    data = path.read_bytes()
+
+    for cut in sorted(rng.integers(100, len(data), 4).tolist()):
+        trunc = tmp_path / f"t{seed}_{cut}.bam"
+        trunc.write_bytes(data[:cut])
+
+        def run(use_device):
+            try:
+                return StreamChecker(
+                    trunc, Config(), use_device=use_device, **CFG
+                ).count_reads()
+            except (EOFError, IOError) as e:
+                return type(e).__name__
+
+        dev, host = run(True), run(False)
+        assert dev == host, (cut, dev, host)
