@@ -145,9 +145,7 @@ class CheckerContext:
                 window=window,
                 halo=min(self.config.halo_size, window // 4),
                 reads_to_check=self.config.reads_to_check,
-                flags_impl=(
-                    "pallas" if self.config.backend == "pallas" else "xla"
-                ),
+                flags_impl=self.config.flags_impl,
             )
             res = checker.check_buffer(self.view.data, at_eof=True)
             return ChainResult(

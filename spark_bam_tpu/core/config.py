@@ -95,6 +95,13 @@ class Config:
     CHECK_SPLIT_SIZE_DEFAULT = 2 << 20  # Blocks.scala:64
     LOAD_SPLIT_SIZE_DEFAULT = 32 << 20  # hadoop FileSplits default in the load path
 
+    @property
+    def flags_impl(self) -> str:
+        """Which flag-pass kernel the device engines run ("pallas" when
+        ``backend=pallas``, else the XLA pass) — the single mapping every
+        tier consults (StreamChecker, the CLI, the mesh steps)."""
+        return "pallas" if self.backend == "pallas" else "xla"
+
     def split_size_or(self, default: int) -> int:
         return self.split_size if self.split_size is not None else default
 

@@ -184,7 +184,8 @@ def make_shard_map_check_step(mesh: Mesh, reads_to_check: int = 10, axis: str = 
 
 
 def _make_sharded_stats_step(
-    mesh: Mesh, reads_to_check: int, axis: str, row_stats, with_truth: bool
+    mesh: Mesh, reads_to_check: int, axis: str, row_stats, with_truth: bool,
+    flags_impl: str = "xla",
 ):
     """Shared scaffolding for the streaming-step makers below: per-row
     ``check_window`` + owned-span mask [lo, own), per-device ``vmap``, and
@@ -198,10 +199,18 @@ def _make_sharded_stats_step(
     """
     shard_map = _shard_map_compat()
 
+    # Interpret mode is decided by where THIS mesh's kernels actually run
+    # (not the process-default backend): Mosaic compiles only on real TPUs.
+    pallas_interpret = (
+        flags_impl == "pallas"
+        and mesh.devices.flat[0].platform != "tpu"
+    )
+
     def one(window, n, at_eof, lo, own, tr, lengths, num_contigs):
         res = check_window(
             window, lengths, num_contigs, n, at_eof,
-            reads_to_check=reads_to_check,
+            reads_to_check=reads_to_check, flags_impl=flags_impl,
+            pallas_interpret=pallas_interpret,
         )
         w = window.shape[0] - PAD
         i = jnp.arange(w, dtype=jnp.int32)
@@ -237,12 +246,17 @@ def _make_sharded_stats_step(
     )
 
 
-def make_shard_map_count_step(mesh: Mesh, reads_to_check: int = 10, axis: str = "data"):
+def make_shard_map_count_step(
+    mesh: Mesh, reads_to_check: int = 10, axis: str = "data",
+    flags_impl: str = "xla",
+):
     """Sharded count-reads step: each device checks its window rows and the
     (boundary count, owned escapes) pair all-reduces with ``lax.psum`` —
     the count-reads workload (reference docs/benchmarks.md:53-59) as one
     mesh-partitioned unit. Rows carry per-row owned spans [lo, own) so
-    halo bytes and the BAM header are counted exactly once globally."""
+    halo bytes and the BAM header are counted exactly once globally.
+    ``flags_impl="pallas"`` swaps the flag pass for the Pallas kernel
+    (``spark.bam.backend=pallas`` reaches the mesh tier too)."""
 
     def row_stats(res, m, _tr):
         return jnp.stack([
@@ -251,12 +265,14 @@ def make_shard_map_count_step(mesh: Mesh, reads_to_check: int = 10, axis: str = 
         ])
 
     return _make_sharded_stats_step(
-        mesh, reads_to_check, axis, row_stats, with_truth=False
+        mesh, reads_to_check, axis, row_stats, with_truth=False,
+        flags_impl=flags_impl,
     )
 
 
 def make_shard_map_confusion_step(
-    mesh: Mesh, reads_to_check: int = 10, axis: str = "data"
+    mesh: Mesh, reads_to_check: int = 10, axis: str = "data",
+    flags_impl: str = "xla",
 ):
     """Sharded check-bam step: verdicts vs indexed truth at every owned
     position, the (tp, fp, fn, escapes) counters ``psum``'d over the mesh
@@ -278,7 +294,8 @@ def make_shard_map_confusion_step(
         ])
 
     return _make_sharded_stats_step(
-        mesh, reads_to_check, axis, row_stats, with_truth=True
+        mesh, reads_to_check, axis, row_stats, with_truth=True,
+        flags_impl=flags_impl,
     )
 
 

@@ -181,7 +181,8 @@ def count_reads_sharded(
         path, config, mesh, window_uncompressed, halo, metas
     )
     step = make_shard_map_count_step(
-        st.mesh, reads_to_check=config.reads_to_check, axis=st.axis
+        st.mesh, reads_to_check=config.reads_to_check, axis=st.axis,
+        flags_impl=config.flags_impl,
     )
     count = escapes = steps = 0
     for k_rows, done in st.batches(header_clamp=True):
@@ -259,7 +260,8 @@ def check_bam_sharded(
     # the truth table instead of a second whole-file metadata walk.
     truth_flats = _truth_flats(path, records_path, st.pipeline.metas)
     step = make_shard_map_confusion_step(
-        st.mesh, reads_to_check=config.reads_to_check, axis=st.axis
+        st.mesh, reads_to_check=config.reads_to_check, axis=st.axis,
+        flags_impl=config.flags_impl,
     )
 
     def fill_row(k, buf, base, n):

@@ -186,7 +186,7 @@ class StreamChecker:
         return lens_dev, jnp.int32(len(self.lengths))
 
     def _flags_impl(self) -> str:
-        return "pallas" if self.config.backend == "pallas" else "xla"
+        return self.config.flags_impl
 
     def _launcher(self):
         """Full-output launch (the spans path)."""
