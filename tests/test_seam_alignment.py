@@ -1,0 +1,85 @@
+"""Adversarial seam alignment: every window/block boundary lands EXACTLY
+on a record start. Uniform-size records packed at a block payload that is
+an exact multiple of the record size make every BGZF block boundary a
+record boundary; streaming windows then put their ownership seams
+(own_end) precisely on record starts — the off-by-one surface for
+double-counting or dropping the seam record."""
+
+import numpy as np
+
+import jax
+
+from spark_bam_tpu.bam.header import BamHeader, ContigLengths, read_header
+from spark_bam_tpu.bam.record import BamRecord
+from spark_bam_tpu.bam.writer import write_bam
+from spark_bam_tpu.bgzf.flat import flatten_file
+from spark_bam_tpu.check.vectorized import check_flat
+from spark_bam_tpu.core.config import Config
+from spark_bam_tpu.core.pos import Pos
+from spark_bam_tpu.parallel.mesh import make_mesh
+from spark_bam_tpu.parallel.stream_mesh import count_reads_sharded
+from spark_bam_tpu.tpu.stream_check import StreamChecker
+
+N_RECORDS = 240
+
+
+def _uniform_bam(path):
+    """All records encode to one identical size."""
+    sam = "@HD\tVN:1.6\n@SQ\tSN:chr1\tLN:10000000\n"
+    header = BamHeader(ContigLengths({0: ("chr1", 10_000_000)}), Pos(0, 0), 0, sam)
+
+    def records():
+        for i in range(N_RECORDS):
+            yield BamRecord(
+                ref_id=0, pos=100 + 7 * i, mapq=30, bin=0, flag=0,
+                next_ref_id=-1, next_pos=-1, tlen=0,
+                read_name=f"u{i:04d}",  # fixed-width name
+                cigar=[(64, 0)],
+                seq="ACGT" * 16,
+                qual=bytes([30] * 64),
+            )
+
+    recs = list(records())
+    sizes = {len(r.encode()) for r in recs}
+    assert len(sizes) == 1, sizes
+    rec_size = sizes.pop()
+    # Block payload = 4 records exactly ⇒ every block boundary is a
+    # record boundary (after the header block, which write_bam emits
+    # separately).
+    write_bam(path, header, recs, block_payload=4 * rec_size)
+    return rec_size
+
+
+def test_seams_on_record_boundaries(tmp_path):
+    path = tmp_path / "uniform.bam"
+    rec_size = _uniform_bam(path)
+
+    flat = flatten_file(path)
+    hdr = read_header(path)
+    lens = np.array(hdr.contig_lengths.lengths_list(), dtype=np.int32)
+    want = check_flat(flat.data, lens, at_eof=True)
+    he = hdr.uncompressed_size
+    expected = int(want.verdict[he:].sum())
+    assert expected == N_RECORDS
+
+    # Window = 2 blocks (8 records), halo = 1 block: own_end lands on a
+    # record start at every single seam.
+    win = 8 * rec_size
+    halo = 4 * rec_size
+    got = StreamChecker(
+        path, Config(), window_uncompressed=win, halo=halo
+    ).count_reads()
+    assert got == N_RECORDS
+
+    # Same alignment through the mesh tier (rows seam on record starts).
+    got = count_reads_sharded(
+        path, Config(), mesh=make_mesh(jax.devices("cpu")[:8]),
+        window_uncompressed=win, halo=halo,
+    )
+    assert got == N_RECORDS
+
+    # Degenerate: window = one block, minimum legal halo.
+    got = StreamChecker(
+        path, Config(), window_uncompressed=4 * rec_size, halo=2 * rec_size
+    ).count_reads()
+    assert got == N_RECORDS
