@@ -274,6 +274,9 @@ class CramReader:
                 else:
                     seq = r_ba_bulk(rl).decode("latin-1")
                     qual = r_qs_bulk(rl) if cf & CF_QS_PRESERVED else b"\xff" * rl
+                # MQ is a mapped-only data series in CRAM: an unmapped
+                # read's nonzero MAPQ is not representable and decodes as
+                # 0 (htsjdk behaves identically).
                 rec = BamRecord(
                     ri, pos, 0, reg2bin(pos, pos + 1) if pos >= 0 else 0,
                     bf, -1, -1, 0, "", [], seq, qual, b"",

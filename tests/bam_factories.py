@@ -2,6 +2,7 @@
 
 import numpy as np
 
+from spark_bam_tpu.bam.bai import reg2bin
 from spark_bam_tpu.bam.header import BamHeader, ContigLengths
 from spark_bam_tpu.bam.index_records import index_records
 from spark_bam_tpu.bam.record import BamRecord
@@ -42,7 +43,12 @@ def random_bam(
             yield BamRecord(
                 ref_id=int(rng.integers(0, len(contigs))) if mapped else -1,
                 pos=pos if mapped else -1,
-                mapq=int(rng.integers(0, 61)), bin=0, flag=flag,
+                # Canonical values (CRAM derives bin on decode, and MQ is
+                # a mapped-only data series in the CRAM spec — a bogus bin
+                # or an unmapped MAPQ would fail round-trips vacuously).
+                mapq=int(rng.integers(0, 61)) if mapped else 0,
+                bin=reg2bin(pos, pos + n) if mapped else 0,
+                flag=flag,
                 next_ref_id=-1, next_pos=-1, tlen=0,
                 read_name=f"f{seed}_{i}",
                 cigar=[(n, 0)] if mapped else [],

@@ -498,3 +498,20 @@ def test_nf_linked_mates_share_synthesized_qname():
     out = [rec("a"), rec("b")]
     CramReader._resolve_mates(out, [0, None], names_included=True)
     assert out[1].read_name == "b"
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_roundtrip_random_bam(tmp_path, seed):
+    """Randomized BAMs (mixed mapped/unmapped, duplicate flags, wide
+    length spread) survive the CRAM round-trip with full field equality."""
+    from tests.bam_factories import random_bam
+
+    path = tmp_path / f"r{seed}.bam"
+    random_bam(path, seed, dup_rate=0.15, read_len=(1, 5000))
+    header, recs = read_bam(path)
+    out = tmp_path / f"r{seed}.cram"
+    with CramWriter(out, header.contig_lengths, header.text) as w:
+        w.write_all(recs)
+    with CramReader(out) as r:
+        back = list(r)
+    assert back == recs
