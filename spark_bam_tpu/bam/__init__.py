@@ -1,3 +1,4 @@
+from spark_bam_tpu.bam.bai import BaiIndex, build_bai, index_bam
 from spark_bam_tpu.bam.header import BamHeader, ContigLengths, read_header
 from spark_bam_tpu.bam.record import BamRecord
 from spark_bam_tpu.bam.iterators import (
@@ -8,6 +9,9 @@ from spark_bam_tpu.bam.iterators import (
 )
 
 __all__ = [
+    "BaiIndex",
+    "build_bai",
+    "index_bam",
     "BamHeader",
     "ContigLengths",
     "read_header",

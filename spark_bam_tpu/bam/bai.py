@@ -110,6 +110,154 @@ class BaiIndex:
         return merge_chunks(sorted(chunks, key=lambda c: (c.start, c.end)))
 
 
+    # ------------------------------------------------------------------ write
+    def write(self, out_path) -> str:
+        """Serialize in the standard BAI layout (readable by this module's
+        reader and by htsjdk/samtools). Write-then-rename, like every
+        sidecar writer here: a crash must not leave a truncated index."""
+        parts = [b"BAI\x01", struct.pack("<i", len(self.references))]
+        for ref in self.references:
+            bins = dict(ref.bins)
+            n_bin = len(bins) + (1 if ref.metadata_chunks else 0)
+            parts.append(struct.pack("<i", n_bin))
+            for bin_id in sorted(bins):
+                chunks = bins[bin_id]
+                parts.append(struct.pack("<Ii", bin_id, len(chunks)))
+                for c in chunks:
+                    parts.append(
+                        struct.pack("<QQ", c.start.to_htsjdk(), c.end.to_htsjdk())
+                    )
+            if ref.metadata_chunks:
+                parts.append(
+                    struct.pack("<Ii", METADATA_BIN_ID, len(ref.metadata_chunks))
+                )
+                for c in ref.metadata_chunks:
+                    parts.append(
+                        struct.pack("<QQ", c.start.to_htsjdk(), c.end.to_htsjdk())
+                    )
+            parts.append(struct.pack("<i", len(ref.linear_index)))
+            parts.append(struct.pack(f"<{len(ref.linear_index)}Q", *ref.linear_index))
+        if self.n_no_coor is not None:
+            parts.append(struct.pack("<Q", self.n_no_coor))
+        import os
+
+        tmp_path = f"{out_path}.tmp{os.getpid()}"
+        try:
+            with open(tmp_path, "wb") as f:
+                f.write(b"".join(parts))
+            os.replace(tmp_path, out_path)
+        finally:
+            if os.path.exists(tmp_path):  # failure path only
+                os.unlink(tmp_path)
+        return str(out_path)
+
+
+def build_bai(bam_path) -> BaiIndex:
+    """Build the BAI binning + linear index for a coordinate-sorted BAM —
+    the samtools-index role (beyond the reference, which consumes ``.bai``
+    via HTSJDK but never writes one; load/.../CanLoadBam.scala:387-421).
+
+    One sequential pass: each record contributes its virtual-position span
+    ``[start, next record's start)`` to its ``reg2bin`` bin and its minimum
+    start offset to every 16 KiB linear window it overlaps. Placed-unmapped
+    reads index at ``[pos, pos+1)``; unplaced reads count into
+    ``n_no_coor``. Per-reference metadata pseudo-bins (37450) carry the
+    begin/end offsets and mapped/unmapped counts, as samtools writes them.
+    """
+    from spark_bam_tpu.bam.iterators import RecordStream
+    from spark_bam_tpu.core.channel import open_channel, path_size
+
+    ch = open_channel(bam_path)
+    stream = RecordStream.open(ch)
+    header = stream.header
+    n_ref = header.num_contigs
+    eof_pos = Pos(path_size(bam_path), 0)
+
+    bins: list[dict[int, list[Chunk]]] = [{} for _ in range(n_ref)]
+    linear: list[dict[int, int]] = [{} for _ in range(n_ref)]
+    span: list[list] = [[None, None, 0, 0] for _ in range(n_ref)]  # beg,end,mapped,unmapped
+    n_no_coor = 0
+
+    def add(ref_id: int, beg: int, end_coord: int, vstart: Pos, vend: Pos):
+        b = reg2bin(beg, end_coord)
+        chunks = bins[ref_id].setdefault(b, [])
+        if chunks and (
+            (vstart.block_pos, vstart.offset)
+            <= (chunks[-1].end.block_pos, chunks[-1].end.offset)
+            or vstart.block_pos == chunks[-1].end.block_pos
+        ):
+            # Adjacent/same-block chunks coalesce (samtools/htsjdk do too).
+            if (vend.block_pos, vend.offset) > (
+                chunks[-1].end.block_pos, chunks[-1].end.offset
+            ):
+                chunks[-1] = Chunk(chunks[-1].start, vend)
+        else:
+            chunks.append(Chunk(vstart, vend))
+        vs = vstart.to_htsjdk()
+        lin = linear[ref_id]
+        for w in range(beg >> LINEAR_INDEX_SHIFT,
+                       max(beg, end_coord - 1) >> LINEAR_INDEX_SHIFT):
+            lin[w] = min(lin.get(w, vs), vs)
+        w = max(beg, end_coord - 1) >> LINEAR_INDEX_SHIFT
+        lin[w] = min(lin.get(w, vs), vs)
+        sp = span[ref_id]
+        sp[0] = vstart if sp[0] is None else sp[0]
+        sp[1] = vend
+
+    try:
+        prev = None
+        for pos, rec in stream:
+            if prev is not None:
+                _index_one(prev[1], prev[0], pos, add, span)
+            prev = (pos, rec)
+            if rec.ref_id < 0 or rec.pos < 0:
+                n_no_coor += 1
+        if prev is not None:
+            _index_one(prev[1], prev[0], eof_pos, add, span)
+    finally:
+        ch.close()
+
+    refs = []
+    for r in range(n_ref):
+        lin = linear[r]
+        n_win = (max(lin) + 1) if lin else 0
+        # Gap windows carry the previous window's value (samtools layout);
+        # leading gaps are 0 (= unconstrained for query pruning).
+        arr = []
+        last = 0
+        for w in range(n_win):
+            last = lin.get(w, last)
+            arr.append(last)
+        meta = []
+        beg_v, end_v, n_mapped, n_unmapped = span[r]
+        if beg_v is not None:
+            meta = [
+                Chunk(beg_v, end_v),
+                Chunk(Pos.from_htsjdk(n_mapped), Pos.from_htsjdk(n_unmapped)),
+            ]
+        refs.append(Reference(bins[r], arr, meta))
+    return BaiIndex(refs, n_no_coor)
+
+
+def _index_one(rec, vstart: Pos, vend: Pos, add, span) -> None:
+    if rec.ref_id < 0 or rec.pos < 0:
+        return
+    if rec.is_unmapped:
+        add(rec.ref_id, rec.pos, rec.pos + 1, vstart, vend)
+        span[rec.ref_id][3] += 1
+    else:
+        add(rec.ref_id, rec.pos, rec.end_pos(), vstart, vend)
+        span[rec.ref_id][2] += 1
+
+
+def index_bam(bam_path, out_path=None) -> tuple[str, "BaiIndex"]:
+    """Build and write ``bam_path``'s ``.bai``; returns (path, index)."""
+    out_path = str(out_path) if out_path is not None else str(bam_path) + ".bai"
+    index = build_bai(bam_path)
+    index.write(out_path)
+    return out_path, index
+
+
 def reg2bins(beg: int, end: int) -> list[int]:
     """All bin ids overlapping [beg, end) in the UCSC binning scheme."""
     end -= 1

@@ -111,3 +111,34 @@ def test_load_bam_intervals_sam_degrade(bam2, sam2):
         for r in load_bam_intervals(sam2, loci, split_size=10_000).collect()
     )
     assert small == sam_names
+
+
+def test_load_sam_roundtrip_random(tmp_path):
+    """Random records → SAM text (to_sam) → load_sam: every field the SAM
+    format can carry must round-trip (bin is recomputed; that's SAM)."""
+    from tests.bam_factories import random_bam
+
+    from spark_bam_tpu.bam.iterators import RecordStream
+    from spark_bam_tpu.bgzf.stream import BlockStream, UncompressedBytes
+    from spark_bam_tpu.core.channel import open_channel
+
+    bam = tmp_path / "r.bam"
+    random_bam(bam, 11, dup_rate=0.1)
+    rs = RecordStream(UncompressedBytes(BlockStream(open_channel(bam))))
+    header = rs.header
+    recs = [r for _, r in rs]
+
+    contigs = header.contig_lengths
+    sam_path = tmp_path / "r.sam"
+    with open(sam_path, "w") as f:
+        f.write(header.text)
+        for r in recs:
+            f.write(r.to_sam(contigs) + "\n")
+
+    back = list(load_sam(sam_path, split_size=200_000))
+    assert len(back) == len(recs)
+    for a, b in zip(recs, back):
+        assert (a.read_name, a.flag, a.ref_id, a.pos, a.mapq, a.cigar,
+                a.seq, a.qual, a.next_ref_id, a.next_pos, a.tlen, a.tags) == (
+               b.read_name, b.flag, b.ref_id, b.pos, b.mapq, b.cigar,
+               b.seq, b.qual, b.next_ref_id, b.next_pos, b.tlen, b.tags)
