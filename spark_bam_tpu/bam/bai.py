@@ -117,11 +117,10 @@ class BaiIndex:
         sidecar writer here: a crash must not leave a truncated index."""
         parts = [b"BAI\x01", struct.pack("<i", len(self.references))]
         for ref in self.references:
-            bins = dict(ref.bins)
-            n_bin = len(bins) + (1 if ref.metadata_chunks else 0)
+            n_bin = len(ref.bins) + (1 if ref.metadata_chunks else 0)
             parts.append(struct.pack("<i", n_bin))
-            for bin_id in sorted(bins):
-                chunks = bins[bin_id]
+            for bin_id in sorted(ref.bins):
+                chunks = ref.bins[bin_id]
                 parts.append(struct.pack("<Ii", bin_id, len(chunks)))
                 for c in chunks:
                     parts.append(
@@ -206,7 +205,20 @@ def build_bai(bam_path) -> BaiIndex:
 
     try:
         prev = None
+        prev_key = None
         for pos, rec in stream:
+            if rec.ref_id >= 0 and rec.pos >= 0:
+                key = (rec.ref_id, rec.pos)
+                if prev_key is not None and key < prev_key:
+                    # An index built from unsorted input would silently
+                    # drop records at query time (the linear-index pruning
+                    # assumes coordinate order) — refuse, like samtools.
+                    raise ValueError(
+                        f"{bam_path}: not coordinate-sorted at {pos} "
+                        f"(ref {rec.ref_id} pos {rec.pos} after "
+                        f"ref {prev_key[0]} pos {prev_key[1]})"
+                    )
+                prev_key = key
             if prev is not None:
                 _index_one(prev[1], prev[0], pos, add, span)
             prev = (pos, rec)

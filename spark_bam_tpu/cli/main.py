@@ -104,6 +104,13 @@ def build_parser() -> argparse.ArgumentParser:
     sub.add_argument("-t", "--throw-on-truncation", action="store_true")
     sub.add_argument("path")
 
+    # Beyond the reference's 10 commands: the samtools-index role for the
+    # built-in .bai writer (the reference consumes .bai but can't produce
+    # one; ours can, so indexed interval loads work on any sorted BAM).
+    sub = sp.add_parser("index-bam")
+    sub.add_argument("-o", "--out", default=None)
+    sub.add_argument("path")
+
     sub = sp.add_parser("htsjdk-rewrite", aliases=["rewrite"])
     sub.add_argument("-o", "--out", default=None, help="write output to file")
     sub.add_argument("-b", "--block-payload", default="65280")
@@ -204,6 +211,18 @@ def main(argv=None) -> int:
                 args.path, args.out, strict=args.throw_on_truncation
             )
             print(f"Wrote {count} records to {out_path}", file=sys.stderr)
+        elif cmd == "index-bam":
+            from spark_bam_tpu.bam.bai import index_bam
+
+            out_path, idx = index_bam(args.path, args.out)
+            n_chunks = sum(
+                len(cs) for ref in idx.references for cs in ref.bins.values()
+            )
+            print(
+                f"Wrote {out_path}: {len(idx.references)} references, "
+                f"{n_chunks} chunks, {idx.n_no_coor} unplaced reads",
+                file=sys.stderr,
+            )
         elif cmd in ("htsjdk-rewrite", "rewrite"):
             from spark_bam_tpu.cli import rewrite
 

@@ -21,6 +21,7 @@ def random_bam(
     pos_step=(1, 900),
     block_payload=(2000, 40000),
     index: bool = False,
+    sort: bool = False,
 ):
     """Write a randomized (but structurally valid) BAM; returns the header
     SAM text's contig count for convenience."""
@@ -57,8 +58,12 @@ def random_bam(
             )
             pos += int(rng.integers(*pos_step))
 
+    recs = list(records())
+    if sort:
+        # Coordinate order (unplaced last) — what BAI indexing requires.
+        recs.sort(key=lambda r: (r.ref_id < 0, r.ref_id, r.pos))
     write_bam(
-        path, header, records(), block_payload=int(rng.integers(*block_payload))
+        path, header, recs, block_payload=int(rng.integers(*block_payload))
     )
     if index:
         index_records(path)

@@ -8,7 +8,6 @@ import shutil
 import numpy as np
 
 from spark_bam_tpu.bam.bai import BaiIndex, index_bam
-from spark_bam_tpu.bam.header import read_header
 from spark_bam_tpu.bam.iterators import RecordStream
 from spark_bam_tpu.bgzf.stream import BlockStream, UncompressedBytes
 from spark_bam_tpu.core.channel import open_channel
@@ -51,7 +50,6 @@ def test_fuzz_interval_loads_vs_brute_force(tmp_path):
     )
     index_bam(bam)
 
-    header = read_header(bam)
     stream = RecordStream(UncompressedBytes(BlockStream(open_channel(bam))))
     all_recs = [r for _, r in stream]
 
@@ -83,3 +81,47 @@ def test_unplaced_reads_count_no_coor(tmp_path):
     unplaced = sum(1 for _, r in stream if r.ref_id < 0)
     assert unplaced > 0
     assert idx.n_no_coor == unplaced
+
+
+def test_fuzz_multi_contig_sorted(tmp_path):
+    from tests.bam_factories import random_bam
+
+    rng = np.random.default_rng(321)
+    bam = tmp_path / "m.bam"
+    random_bam(
+        bam, 321, contigs=(("chr1", 1_000_000), ("chr2", 800_000)),
+        n_records=(300, 301), pos_step=(1, 30), read_len=(10, 400),
+        mapped_rate=0.85, sort=True,
+    )
+    index_bam(bam)
+    stream = RecordStream(UncompressedBytes(BlockStream(open_channel(bam))))
+    all_recs = [r for _, r in stream]
+
+    for contig in ("chr1", "chr2"):
+        for _ in range(6):
+            a = int(rng.integers(1, 10_000))
+            b = a + int(rng.integers(1, 4_000))
+            got = _names(load_bam_intervals(bam, f"{contig}:{a}-{b}"))
+            ref_idx = 0 if contig == "chr1" else 1
+            want = _names([
+                r for r in all_recs
+                if r.ref_id == ref_idx and not r.is_unmapped
+                and r.pos < b and r.end_pos() > a - 1
+            ])
+            assert got == want, f"{contig}:{a}-{b}"
+
+
+def test_unsorted_bam_refused(tmp_path):
+    """Indexing unsorted input would silently drop records at query time
+    (linear-index pruning assumes coordinate order) — it must refuse,
+    like samtools."""
+    import pytest
+
+    from tests.bam_factories import random_bam
+
+    bam = tmp_path / "unsorted.bam"
+    # Two contigs with random interleaving: not coordinate-sorted.
+    random_bam(bam, 4, contigs=(("chr1", 1_000_000), ("chr2", 800_000)))
+    with pytest.raises(ValueError, match="not coordinate-sorted"):
+        index_bam(bam)
+    assert not (tmp_path / "unsorted.bam.bai").exists()

@@ -314,3 +314,16 @@ def test_full_check_streaming_matches_golden_sections(bam2, tmp_path):
 def test_full_check_streaming_rejects_intervals(bam2, capsys):
     assert main(["full-check", "--streaming", "-i", "0-100k", str(bam2)]) == 2
     assert "not supported on the streaming path" in capsys.readouterr().err
+
+
+def test_index_bam_command(bam2, tmp_path, capsys):
+    import shutil
+
+    bam = tmp_path / "2.bam"
+    shutil.copy(bam2, bam)
+    assert main(["index-bam", str(bam)]) == 0
+    err = capsys.readouterr().err
+    assert "84 references" in err
+    from spark_bam_tpu.bam.bai import BaiIndex
+
+    assert len(BaiIndex.read(str(bam) + ".bai").references) == 84
