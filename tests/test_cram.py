@@ -515,3 +515,39 @@ def test_roundtrip_random_bam(tmp_path, seed):
     with CramReader(out) as r:
         back = list(r)
     assert back == recs
+
+
+def test_load_cram_intervals_fuzz_random(tmp_path):
+    """Random sorted BAM → CRAM + .crai → interval loads equal the BAM
+    interval loads (which the .bai fuzz pins against brute force)."""
+    import numpy as np
+
+    from tests.bam_factories import random_bam
+
+    from spark_bam_tpu.bam.bai import index_bam
+    from spark_bam_tpu.load.api import load_bam_intervals, load_cram_intervals
+
+    rng = np.random.default_rng(77)
+    bam = tmp_path / "s.bam"
+    random_bam(
+        bam, 77, contigs=(("chr1", 2_000_000),), n_records=(250, 251),
+        pos_step=(1, 50), read_len=(10, 600), mapped_rate=0.9, sort=True,
+    )
+    index_bam(bam)
+    header, recs = read_bam(bam)
+    cram = tmp_path / "s.cram"
+    with CramWriter(
+        cram, header.contig_lengths, header.text, records_per_container=64
+    ) as w:
+        w.write_all(recs)
+
+    def key(r):
+        return (r.read_name, r.flag, r.pos)
+
+    for _ in range(8):
+        a = int(rng.integers(1, 10_000))
+        b = a + int(rng.integers(1, 4_000))
+        loci = f"chr1:{a}-{b}"
+        want = sorted(key(r) for r in load_bam_intervals(bam, loci))
+        got = sorted(key(r) for r in load_cram_intervals(cram, loci))
+        assert got == want, loci
