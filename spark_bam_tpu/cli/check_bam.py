@@ -50,9 +50,13 @@ def _run_sharded(ctx: CheckerContext) -> None:
     from spark_bam_tpu.bgzf.index_blocks import blocks_metadata
     from spark_bam_tpu.cli.app import print_report_header
     from spark_bam_tpu.parallel.stream_mesh import check_bam_sharded
+    from spark_bam_tpu.utils.timer import heartbeat_progress
 
     metas = list(blocks_metadata(ctx.path))  # one scan: stats + sizes
-    stats = check_bam_sharded(ctx.path, ctx.config, metas=metas)
+    with heartbeat_progress(f"check-bam --sharded {ctx.path}") as progress:
+        stats = check_bam_sharded(
+            ctx.path, ctx.config, metas=metas, progress=progress
+        )
     # Golden semantics: sum of data blocks, excluding the EOF sentinel
     # (the reference's compressedSizeAccumulator) — NOT the raw file size.
     compressed = sum(m.compressed_size for m in metas)

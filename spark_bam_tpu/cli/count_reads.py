@@ -30,10 +30,14 @@ def run(
                 "structure to window (use the default count-reads path)"
             )
         from spark_bam_tpu.parallel.stream_mesh import count_reads_sharded
+        from spark_bam_tpu.utils.timer import heartbeat_progress
 
         for _ in range(max(iterations, 1)):
             t0 = time.perf_counter()
-            count = count_reads_sharded(path, config)
+            with heartbeat_progress(
+                f"count-reads --sharded {path}"
+            ) as progress:
+                count = count_reads_sharded(path, config, progress=progress)
             ms = int((time.perf_counter() - t0) * 1000)
             p.echo(f"spark-bam read-count time: {ms}")
             p.echo(f"Read count: {count}", "")

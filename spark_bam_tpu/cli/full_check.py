@@ -127,11 +127,17 @@ def run_streaming(ctx: CheckerContext) -> None:
             "--streaming scans the whole file; -i/--intervals is not "
             "supported on the streaming path"
         )
+    from spark_bam_tpu.utils.timer import heartbeat_progress
+
     p = ctx.printer
     metas = list(blocks_metadata(ctx.path))  # one scan: summary + pos tables
-    s = full_check_summary_streaming(
-        ctx.path, ctx.config, use_device=ctx._use_tpu_backend(), metas=metas
-    )
+    with heartbeat_progress(
+        f"full-check --streaming {ctx.path}", unit="window"
+    ) as progress:
+        s = full_check_summary_streaming(
+            ctx.path, ctx.config, use_device=ctx._use_tpu_backend(),
+            metas=metas, progress=progress,
+        )
     block_starts, block_flat = metas_block_table(metas)
 
     def pos_str(i: int) -> str:

@@ -53,6 +53,19 @@ def heartbeat(what: str, interval_seconds: float = 10.0):
 
 
 @contextlib.contextmanager
+def heartbeat_progress(
+    what: str, unit: str = "step", interval_seconds: float = 10.0
+):
+    """Heartbeat shaped as the streaming APIs' ``progress`` callback
+    (``(k, done, total)`` — StreamChecker windows / sharded steps): yields
+    a callable suitable for their ``progress=`` kwarg."""
+    with heartbeat(what, interval_seconds) as beat:
+        yield lambda k, done, total: beat(
+            f"{unit} {k}, {done}/{total} positions"
+        )
+
+
+@contextlib.contextmanager
 def profile_trace(name: str = "spark-bam-tpu"):
     """JAX device trace when SPARK_BAM_PROFILE_DIR is set; else no-op."""
     trace_dir = os.environ.get("SPARK_BAM_PROFILE_DIR")

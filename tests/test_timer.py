@@ -43,3 +43,21 @@ def test_profile_trace_noop_and_enabled(tmp_path, monkeypatch):
         jnp.zeros(8).block_until_ready()
     # A trace directory with profiler artifacts must exist.
     assert any((tmp_path / "t").rglob("*")), "no profiler artifacts written"
+
+
+def test_heartbeat_progress_shape_and_rate(caplog):
+    import logging
+
+    from spark_bam_tpu.utils.timer import heartbeat_progress
+
+    with caplog.at_level(logging.INFO):
+        with heartbeat_progress("t", unit="window", interval_seconds=0) as p:
+            p(3, 100, 200)
+    assert "t: window 3, 100/200 positions" in caplog.text
+
+    # Rate limit: a long interval suppresses the very first beat too.
+    with caplog.at_level(logging.INFO):
+        caplog.clear()
+        with heartbeat_progress("u", interval_seconds=3600) as p:
+            p(1, 1, 2)
+    assert "u:" not in caplog.text
