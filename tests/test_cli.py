@@ -327,3 +327,24 @@ def test_index_bam_command(bam2, tmp_path, capsys):
     from spark_bam_tpu.bam.bai import BaiIndex
 
     assert len(BaiIndex.read(str(bam) + ".bai").references) == 84
+
+
+def test_compare_splits_corpus(bam2, tmp_path):
+    """The many-BAM cohort shape (BASELINE config: compute-splits over a
+    corpus; reference CompareSplits runs one task per BAM): ten repacks of
+    2.bam at varied block payloads, every one's splits matching."""
+    from spark_bam_tpu.cli import rewrite
+    from spark_bam_tpu.cli.output import Printer
+
+    paths = []
+    for i, payload in enumerate(range(12_000, 62_000, 5_000)):
+        out = tmp_path / f"r{i}.bam"
+        rewrite.run(str(bam2), str(out), Printer(), block_payload=payload,
+                    reindex=False)
+        paths.append(out)
+    bams = tmp_path / "bams.txt"
+    bams.write_text("".join(f"{p}\n" for p in paths))
+    got = run_cli(["compare-splits", "-m", "100k", str(bams)], tmp_path)
+    assert got.splitlines()[0] == (
+        f"All {len(paths)} BAMs' splits (totals: 60, 60) matched!"
+    )
