@@ -265,6 +265,34 @@ def _child_device_all(window_mb: int, platform: str, iters: int,
                 "inflate_error:" + f"{type(e).__name__}: {e}"[:300].replace("\n", " ")
             )
 
+    # ---- sharded-count smoke (tail zone): the mesh streaming path on the
+    # real hardware — the default mesh over all visible devices (one chip
+    # here), the shard_map count step, psum'd count equal to the fixture's
+    # read count. ok requires the MESH pass itself to have produced the
+    # count (an escape fallback to the single-device path doesn't count as
+    # hardware proof). CPU-mesh tests prove the 8-way form in CI. --------
+    if backend == "tpu":
+        try:
+            from spark_bam_tpu.benchmarks.synth import FIXTURE_READS
+            from spark_bam_tpu.core.config import Config as _Cfg
+            from spark_bam_tpu.parallel.stream_mesh import count_reads_sharded
+
+            t0 = time.perf_counter()
+            stats = {}
+            n = count_reads_sharded(FIXTURE, _Cfg(), stats_out=stats)
+            _emit_result("sharded_smoke", {
+                "count": int(n),
+                "ok": int(n) == FIXTURE_READS and not stats.get("fallback"),
+                "fallback": bool(stats.get("fallback")),
+                "wall_s": round(time.perf_counter() - t0, 2),
+                "backend": backend,
+            })
+            _emit_stage("sharded_done")
+        except Exception as e:
+            _emit_stage(
+                "sharded_error:" + f"{type(e).__name__}: {e}"[:300].replace("\n", " ")
+            )
+
     # ---- Pallas on-TPU probe (last: compile risk must not cost the
     # artifacts above; VERDICT r3 item 4's on-TPU timing) ------------------
     if backend == "tpu":
@@ -954,6 +982,9 @@ def _main_measure(record, warnings, errors):
     cli = results.get("cli_smoke")
     if cli is not None:
         record["cli_smoke_ok"] = cli["ok"]
+    sh = results.get("sharded_smoke")
+    if sh is not None:
+        record["sharded_smoke_ok"] = sh["ok"]
     f64 = results.get("fused64")
     if f64 is not None:
         record["steady_fused64_count_pps"] = round(f64["fused64_pps"])

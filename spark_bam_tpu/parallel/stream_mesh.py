@@ -173,10 +173,14 @@ def count_reads_sharded(
     halo: int | None = None,
     metas: list | None = None,
     progress: Callable[[int, int, int], None] | None = None,
+    stats_out: dict | None = None,
 ) -> int:
     """Record count of ``path`` computed across ``mesh`` (default: all
     devices). ``progress(steps_done, positions_done, total_positions)``
-    fires after each sharded step."""
+    fires after each sharded step. ``stats_out``, when given, receives
+    ``{"steps", "escapes", "fallback"}`` — callers that must know whether
+    the mesh pass itself produced the count (vs the escape fallback)
+    read ``fallback`` (e.g. hardware smoke tests)."""
     st = _ShardedStream(
         path, config, mesh, window_uncompressed, halo, metas
     )
@@ -196,6 +200,10 @@ def count_reads_sharded(
         if escapes:
             break
 
+    if stats_out is not None:
+        stats_out.update(
+            steps=steps, escapes=escapes, fallback=bool(escapes)
+        )
     if escapes:
         # Ultra-long chains outran the halo: resolve bit-exactly through
         # the single-device deferral path.

@@ -151,3 +151,19 @@ def test_check_bam_sharded_pallas_backend():
     assert stats["true_positives"] == 2500
     assert stats["false_positives"] == 0
     assert stats["false_negatives"] == 0
+
+
+def test_stats_out_reports_fallback():
+    stats = {}
+    count_reads_sharded(
+        BAM2, Config(), mesh=_mesh(),
+        window_uncompressed=128 << 10, halo=32 << 10, stats_out=stats,
+    )
+    assert stats["fallback"] is False and stats["steps"] > 0
+
+    stats = {}
+    count_reads_sharded(
+        BAM2, Config(), mesh=_mesh(),
+        window_uncompressed=128 << 10, halo=1 << 10, stats_out=stats,
+    )
+    assert stats["fallback"] is True and stats["escapes"] > 0
