@@ -26,7 +26,12 @@ from spark_bam_tpu.core.config import Config
 from spark_bam_tpu.core.pos import Pos
 from spark_bam_tpu.load.intervals import LociSet
 from spark_bam_tpu.tpu.checker import TpuChecker
-from spark_bam_tpu.tpu.parser import ReadBatch, interval_flag_filter, parse_flat_records
+from spark_bam_tpu.tpu.parser import (
+    ReadBatch,
+    _next_pow2,
+    interval_flag_filter,
+    parse_flat_records,
+)
 
 
 @dataclass
@@ -116,17 +121,29 @@ def _apply_filter(
         return batch
     import jax.numpy as jnp
 
-    # Only the columns the device filter reads make the trip.
+    # Only the columns the device filter reads make the trip; rows pad to
+    # a power of two (valid=False ⇒ masked out) so the jit sees at most
+    # log2 distinct shapes across batches, not one compile per batch size.
+    m = len(batch.columns["valid"])
+    m_pad = _next_pow2(m)
+
+    def padded(k):
+        col = batch.columns[k]
+        if m_pad == m:
+            return jnp.asarray(col)
+        out = np.zeros(m_pad, dtype=col.dtype)
+        out[:m] = col
+        return jnp.asarray(out)
+
     cols = {
-        k: jnp.asarray(batch.columns[k])
-        for k in ("pos", "ref_span", "ref_id", "flag", "valid")
+        k: padded(k) for k in ("pos", "ref_span", "ref_id", "flag", "valid")
     }
     mask = np.asarray(
         interval_flag_filter(
             cols, jnp.asarray(_interval_table(header, loci)),
             jnp.int32(flags_required), jnp.int32(flags_forbidden),
         )
-    )
+    )[:m]
     batch.columns["valid"] = batch.columns["valid"] & mask
     return batch
 

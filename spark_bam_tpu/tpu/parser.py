@@ -192,15 +192,30 @@ class ReadBatch:
         return bytes(self.buf[off: off + n])
 
 
+def _next_pow2(n: int) -> int:
+    return 1 << max(0, (max(n, 1) - 1).bit_length())
+
+
 def parse_flat_records(
     buf: np.ndarray, starts: np.ndarray, pad: int = 300_000
 ) -> ReadBatch:
     """Host entry: pad the buffer, run the device parser, fix up any rows
-    whose cigar exceeded the device scan cap."""
-    padded = np.zeros(len(buf) + pad, dtype=np.uint8)
+    whose cigar exceeded the device scan cap.
+
+    Both the buffer and the starts row count pad to powers of two so the
+    jit sees at most log2 distinct shapes — without this, every streaming
+    window's slightly-different size would trigger a fresh XLA compile
+    (the same discipline as the checker's pow2 kernel windows). The
+    bucket is ``pow2(len) + pad`` rather than ``pow2(len + pad)``: the
+    same O(log) compile bound without nearly doubling the allocation and
+    H2D transfer for pow2-sized windows."""
+    padded = np.zeros(_next_pow2(len(buf)) + pad, dtype=np.uint8)
     padded[: len(buf)] = buf
-    cols = parse_records(jnp.asarray(padded), jnp.asarray(starts.astype(np.int32)))
-    cols = {k: np.asarray(v) for k, v in cols.items()}
+    m = len(starts)
+    starts_padded = np.full(_next_pow2(m), -1, dtype=np.int32)
+    starts_padded[:m] = starts.astype(np.int32)
+    cols = parse_records(jnp.asarray(padded), jnp.asarray(starts_padded))
+    cols = {k: np.asarray(v)[:m] for k, v in cols.items()}
     inexact = np.flatnonzero(cols["valid"] & ~cols["span_exact"])
     if len(inexact):
         from spark_bam_tpu.bam.record import BamRecord

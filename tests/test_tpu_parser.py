@@ -74,3 +74,25 @@ def test_lazy_payloads_match_codec(bam2, parsed):
     assert batch.name(7) == rec.read_name
     assert batch.seq(7) == rec.seq
     assert batch.qual(7) == rec.qual
+
+
+def test_shape_bucketing_bounds_compiles(bam2):
+    """Streaming windows vary in size every step; the parser must bucket
+    both buffer and row-count shapes to powers of two so the jit compiles
+    O(log) variants, not one per window."""
+    from spark_bam_tpu.tpu.parser import parse_records
+
+    flat = flatten_file(bam2)
+    records = read_records_index(str(bam2) + ".records")
+    starts = np.array(
+        [flat.flat_of_pos(p.block_pos, p.offset) for p in records[:40]],
+        dtype=np.int64,
+    )
+    early = starts[starts < 90_000]
+    # Different buffer lengths in the same pow2 bucket and different row
+    # counts in the same pow2 bucket: the second call must be a full
+    # cache hit (order-independent: the first call may itself hit).
+    parse_flat_records(flat.data[:100_000], early[:5])
+    mid = parse_records._cache_size()
+    parse_flat_records(flat.data[:120_000], early[:7])
+    assert parse_records._cache_size() == mid
