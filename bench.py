@@ -372,7 +372,7 @@ def _run_stage_probe(window_mb: int, big_path: str, metas: list):
         )
         it = iter(pipe)
         rows = []
-        for _ in range(3):
+        for _ in range(min(3, len(pipe.groups))):
             if time.monotonic() > probe_deadline:
                 raise TimeoutError("stage probe over budget")
             t0 = time.perf_counter()
