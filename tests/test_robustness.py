@@ -137,3 +137,32 @@ def test_index_records_strict_raise_leaves_no_sidecar(bam2, tmp_path):
         index_records(bad, out, strict=True)
     assert not out.exists()
     assert not list(tmp_path.glob("*.tmp*"))
+
+
+def test_header_only_bam_all_paths(tmp_path):
+    """Zero-record (header-only) BAM through every load/count/check path."""
+    import jax
+
+    from spark_bam_tpu.bam.bai import index_bam
+    from spark_bam_tpu.bam.header import BamHeader, ContigLengths
+    from spark_bam_tpu.bam.writer import write_bam
+    from spark_bam_tpu.core.config import Config
+    from spark_bam_tpu.core.pos import Pos
+    from spark_bam_tpu.load.api import load_bam
+    from spark_bam_tpu.load.tpu_load import count_reads_tpu, load_reads_columnar
+    from spark_bam_tpu.parallel.mesh import make_mesh
+    from spark_bam_tpu.parallel.stream_mesh import count_reads_sharded
+
+    sam = "@HD\tVN:1.6\n@SQ\tSN:chr1\tLN:1000000\n"
+    header = BamHeader(ContigLengths({0: ("chr1", 1_000_000)}), Pos(0, 0), 0, sam)
+    p = tmp_path / "empty.bam"
+    write_bam(p, header, [])
+
+    assert count_reads_tpu(p) == 0
+    assert len(load_reads_columnar(p)) == 0
+    assert load_bam(p, split_size="1MB").count() == 0
+    assert count_reads_sharded(
+        p, Config(), mesh=make_mesh(jax.devices("cpu")[:8])
+    ) == 0
+    _, idx = index_bam(p)
+    assert len(idx.references) == 1 and idx.n_no_coor == 0
