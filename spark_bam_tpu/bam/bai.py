@@ -171,6 +171,7 @@ def build_bai(bam_path) -> BaiIndex:
     header = stream.header
     n_ref = header.num_contigs
     eof_pos = Pos(path_size(bam_path), 0)
+    after_pos = None  # virtual offset just past the most recent record
 
     bins: list[dict[int, list[Chunk]]] = [{} for _ in range(n_ref)]
     linear: list[dict[int, int]] = [{} for _ in range(n_ref)]
@@ -207,6 +208,7 @@ def build_bai(bam_path) -> BaiIndex:
         prev = None
         prev_key = None
         for pos, rec in stream:
+            after_pos = _tell_after(stream)
             if rec.ref_id >= 0 and rec.pos >= 0:
                 key = (rec.ref_id, rec.pos)
                 if prev_key is not None and key < prev_key:
@@ -225,7 +227,14 @@ def build_bai(bam_path) -> BaiIndex:
             if rec.ref_id < 0 or rec.pos < 0:
                 n_no_coor += 1
         if prev is not None:
-            _index_one(prev[1], prev[0], eof_pos, add, span)
+            # The final record's chunk ends at the virtual offset just past
+            # it (what samtools writes), not at the physical file size —
+            # Pos(file_size, 0) would drag the BGZF EOF sentinel into the
+            # last chunk and byte-differ from samtools output.
+            _index_one(
+                prev[1], prev[0],
+                eof_pos if after_pos is None else after_pos, add, span,
+            )
     finally:
         ch.close()
 
@@ -249,6 +258,21 @@ def build_bai(bam_path) -> BaiIndex:
             ]
         refs.append(Reference(bins[r], arr, meta))
     return BaiIndex(refs, n_no_coor)
+
+
+def _tell_after(stream) -> Pos | None:
+    """The stream cursor as samtools' ``bgzf_tell`` would report it: when
+    the just-read record exhausted its block, the *next* block's compressed
+    start with offset 0 (htslib normalizes block-end to next-block-start;
+    for the final record that is the BGZF EOF sentinel's offset, which is
+    the exclusive bound samtools writes into the index). Side-effect free —
+    unlike ``cur_pos`` it never advances the block cursor."""
+    blk = stream.u.stream.head()
+    if blk is None:
+        return None
+    if blk.idx >= len(blk.data):
+        return Pos(blk.next_start, 0)
+    return blk.pos
 
 
 def _index_one(rec, vstart: Pos, vend: Pos, add, span) -> None:

@@ -19,12 +19,33 @@ tests/test_remote.py for the injected-latency proof.
 from __future__ import annotations
 
 import http.client
+import math
 import random
 import threading
 import time
 import urllib.parse
 
 from spark_bam_tpu.core.channel import ByteChannel
+
+
+def _parse_retry_after(value: str | None) -> float:
+    """``Retry-After`` as seconds: delta-seconds or an HTTP-date (RFC 9110
+    §10.2.3 allows either form); unparseable/absent → 0 (jittered
+    backoff applies)."""
+    if not value:
+        return 0.0
+    try:
+        wait = float(value)
+        return wait if math.isfinite(wait) else 0.0
+    except ValueError:
+        pass
+    try:
+        from email.utils import parsedate_to_datetime
+
+        dt = parsedate_to_datetime(value)
+        return dt.timestamp() - time.time()
+    except (TypeError, ValueError, OverflowError):
+        return 0.0
 
 
 class HttpRangeChannel(ByteChannel):
@@ -117,16 +138,12 @@ class HttpRangeChannel(ByteChannel):
             else:
                 if resp.status not in self.RETRY_STATUSES or final:
                     return resp, body
-                retry_after = resp.headers.get("Retry-After")
-                try:
-                    wait = float(retry_after) if retry_after else 0.0
-                except ValueError:
-                    wait = 0.0
+                wait = _parse_retry_after(resp.headers.get("Retry-After"))
             if wait <= 0:
                 wait = delay * (0.5 + random.random())
             time.sleep(min(wait, 5.0))
             delay *= 4
-        raise IOError(f"{method} {self.url}: retries exhausted")
+        raise AssertionError("unreachable: final attempt returns or raises")
 
     def _read_at(self, pos: int, n: int) -> bytes:
         if n <= 0 or self._closed:

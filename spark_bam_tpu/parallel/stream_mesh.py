@@ -189,16 +189,23 @@ def count_reads_sharded(
         flags_impl=config.flags_impl,
     )
     count = escapes = steps = 0
-    for k_rows, done in st.batches(header_clamp=True):
-        st.zero_tail_rows(k_rows)
-        totals = np.asarray(step(*st.sharded_args()))
-        count += int(totals[0])
-        escapes += int(totals[1])
-        steps += 1
-        if progress is not None:
-            progress(steps, done, st.total)
-        if escapes:
-            break
+    # Closing the batch generator on early exit (escape break, error)
+    # propagates into the pipeline iterator's finally, shutting down its
+    # inflate pool and channel before any fallback reopens the file.
+    batches = st.batches(header_clamp=True)
+    try:
+        for k_rows, done in batches:
+            st.zero_tail_rows(k_rows)
+            totals = np.asarray(step(*st.sharded_args()))
+            count += int(totals[0])
+            escapes += int(totals[1])
+            steps += 1
+            if progress is not None:
+                progress(steps, done, st.total)
+            if escapes:
+                break
+    finally:
+        batches.close()
 
     if stats_out is not None:
         stats_out.update(
@@ -206,10 +213,11 @@ def count_reads_sharded(
         )
     if escapes:
         # Ultra-long chains outran the halo: resolve bit-exactly through
-        # the single-device deferral path.
+        # the single-device deferral path (reusing the sharded pass's
+        # block-metadata scan, not a second whole-file walk).
         return StreamChecker(
             path, config, window_uncompressed=st.fresh, halo=st.halo,
-            metas=metas,
+            metas=st.pipeline.metas,
         ).count_reads()
     return count
 
@@ -283,14 +291,18 @@ def check_bam_sharded(
     # exactly), which keeps the device reduction int32-safe at mesh scale.
     agg = np.zeros(4, dtype=np.int64)
     steps = 0
-    for k_rows, done in st.batches(header_clamp=False, fill_row=fill_row):
-        st.zero_tail_rows(k_rows)
-        agg += np.asarray(step(*st.sharded_args()), dtype=np.int64)
-        steps += 1
-        if progress is not None:
-            progress(steps, done, st.total)
-        if agg[3]:
-            break
+    batches = st.batches(header_clamp=False, fill_row=fill_row)
+    try:
+        for k_rows, done in batches:
+            st.zero_tail_rows(k_rows)
+            agg += np.asarray(step(*st.sharded_args()), dtype=np.int64)
+            steps += 1
+            if progress is not None:
+                progress(steps, done, st.total)
+            if agg[3]:
+                break
+    finally:
+        batches.close()
 
     if agg[3]:
         stats = _check_bam_exact(
