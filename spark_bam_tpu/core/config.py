@@ -77,11 +77,13 @@ class Config:
     window_size: int = 24 << 20
     halo_size: int = 4 << 20            # extra trailing bytes so chains can complete
     # Two-phase device inflate (host entropy decode + on-device LZ77
-    # resolution, tpu/inflate.py). Off by default: tokens cost ~5x the
-    # uncompressed bytes in transfer, so host inflate wins whenever
-    # host↔device bandwidth is the constraint; the capability stays one
-    # knob away (and demotes to host zlib per window on any failure).
-    device_inflate: bool = False
+    # resolution, tpu/inflate.py). ``None`` = auto: on the TPU backend with
+    # the native tokenizer built it resolves True (the production default —
+    # the LZ77 copy phase, inflate's memory-bandwidth half, belongs on HBM);
+    # anywhere else False. Tokens cost ~3x the uncompressed bytes on the
+    # wire, so hosts whose device link is the constraint should pin False;
+    # either way the pipeline demotes to host zlib per window on failure.
+    device_inflate: bool | None = None
     # --- misc ---
     warn: bool = False                  # root log-level toggle (args/LogArgs.scala:30-33)
     # Accepted for config-surface parity (PostPartitionArgs -p, default
@@ -128,10 +130,19 @@ class Config:
                 value = parse_bytes(value) if isinstance(value, str) else int(value)
             elif f.type in ("float", float):
                 value = float(value)
-            elif f.type in ("bool", bool):
-                value = value if isinstance(value, bool) else str(value).lower() in ("1", "true", "yes")
+            elif f.type in ("bool", bool, "bool | None"):
+                if not isinstance(value, bool):
+                    s = str(value).lower()
+                    if "None" in str(f.type) and s in ("auto", "none", ""):
+                        value = None
+                    else:
+                        value = s in ("1", "true", "yes")
             kw[name] = value
         return base.replace(**kw)
+
+    # SPARK_BAM_* sub-namespaces that are NOT Config knobs (cloud backend
+    # endpoints/tokens, core/cloud.py) — from_env must not trip on them.
+    _ENV_NON_CONFIG = ("gs_", "s3_", "profile_")
 
     @classmethod
     def from_env(cls, env=os.environ, base: "Config | None" = None) -> "Config":
@@ -139,7 +150,10 @@ class Config:
         d = {}
         for key, value in env.items():
             if key.startswith("SPARK_BAM_"):
-                d[key[len("SPARK_BAM_"):].lower()] = value
+                name = key[len("SPARK_BAM_"):].lower()
+                if name.startswith(cls._ENV_NON_CONFIG):
+                    continue
+                d[name] = value
         return cls.from_dict(d, base=base) if d else (base or cls())
 
 

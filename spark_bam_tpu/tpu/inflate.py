@@ -169,6 +169,27 @@ def inflate_file_device(path) -> FlatView | None:
     return view
 
 
+def resolve_device_inflate(config, use_device: bool = True) -> bool:
+    """Resolve ``Config.device_inflate``'s auto (``None``) state: True only
+    on the TPU backend with the native tokenizer built — the production
+    default per the measured A/B (bench.py's device_inflate probe); False
+    for host-only consumers (never initializes a JAX backend for them) and
+    wherever the tokenizer is missing (the pipeline would demote every
+    window to host zlib anyway, with a warning)."""
+    if config.device_inflate is not None:
+        return config.device_inflate
+    if not use_device:
+        return False
+    import jax
+
+    if jax.default_backend() != "tpu":
+        return False
+    from spark_bam_tpu.native.build import load_native
+
+    lib = load_native()
+    return lib is not None and hasattr(lib, "sbt_tokenize_deflate")
+
+
 def window_plan(metas: list[Metadata], window_uncompressed: int) -> list[list[Metadata]]:
     """Group consecutive blocks into ≈window-sized uncompressed runs."""
     groups: list[list[Metadata]] = []

@@ -52,7 +52,7 @@ import jax.numpy as jnp
 from spark_bam_tpu.bam.header import read_header
 from spark_bam_tpu.check.vectorized import check_flat
 from spark_bam_tpu.core.config import Config
-from spark_bam_tpu.tpu.inflate import InflatePipeline
+from spark_bam_tpu.tpu.inflate import InflatePipeline, resolve_device_inflate
 
 
 def _next_pow2(n: int) -> int:
@@ -147,7 +147,8 @@ class StreamChecker:
         # header walk over every BGZF block — seconds on multi-GB files).
         self.pipeline = InflatePipeline(
             path, window_uncompressed=fresh,
-            device_copy=config.device_inflate, metas=metas, **pipe_kw,
+            device_copy=resolve_device_inflate(config, use_device),
+            metas=metas, **pipe_kw,
         )
         self.total = self.pipeline.total
         # Kernel shape: one power of two covering carry + window, clamped to
