@@ -5,8 +5,16 @@ Scan forward ≤ MAX_BLOCK_SIZE bytes; the first offset where
 (reference bgzf/.../block/FindBlockStart.scala:8-36; false-positive
 probability ≈ 2^(-32N)).
 
-Two implementations:
-- ``find_block_start``      — faithful sequential scan over a channel
+Three implementations:
+- ``find_block_start``      — the production scan: one vectorized
+  single-header mask over the window pre-filters candidates (the mask is
+  exactly ``Header.parse``'s fixed-byte contract, so it admits no false
+  negatives), then the sequential chain check verifies each — identical
+  results to the faithful scan at ~1/10,000 the Python-bytecode cost
+  (split resolution runs this once per split; at WGS scale that was the
+  load path's dominant term)
+- ``find_block_start_sequential`` — the faithful per-offset scan
+  (reference FindBlockStart.scala:8-36 shape); the differential oracle
 - ``find_block_starts_np``  — vectorized NumPy scan over an in-memory window,
   used by the TPU-era split planner to resolve many shard starts at once
 """
@@ -30,7 +38,48 @@ def find_block_start(
     bgzf_blocks_to_check: int = 5,
     path: str = "<channel>",
 ) -> int:
-    """First valid block-start offset ≥ ``start``."""
+    """First valid block-start offset ≥ ``start``.
+
+    Offsets that could possibly parse (the 7 fixed header bytes — magic,
+    FLG.FEXTRA, leading BC subfield — match) come from one NumPy mask over
+    the ≤64 KiB scan window; only those run the sequential chain check, so
+    the result (and every exception surface) is identical to
+    ``find_block_start_sequential`` by construction.
+    """
+    size = ch.size
+    span = min(MAX_BLOCK_SIZE, max(size - start, 0))
+    window = np.frombuffer(
+        ch.read_at(start, min(span + 17, max(size - start, 0))), dtype=np.uint8
+    )
+    m = len(window) - 17
+    if m > 0:
+        w = window
+        ok = (
+            (w[0:m] == 31)
+            & (w[1:m + 1] == 139)
+            & (w[2:m + 2] == 8)
+            & (w[3:m + 3] == 4)
+            & (w[12:m + 12] == 66)
+            & (w[13:m + 13] == 67)
+            & (w[14:m + 14] == 2)
+        )
+        for off in np.flatnonzero(ok).tolist():
+            try:
+                _check_chain(ch, start + off, bgzf_blocks_to_check)
+                return start + off
+            except (HeaderParseException, EOFError):
+                continue
+    raise HeaderSearchFailedException(path, start, min(MAX_BLOCK_SIZE, size - start))
+
+
+def find_block_start_sequential(
+    ch: ByteChannel,
+    start: int,
+    bgzf_blocks_to_check: int = 5,
+    path: str = "<channel>",
+) -> int:
+    """The faithful per-offset scan (reference FindBlockStart.scala:8-36) —
+    the differential oracle for ``find_block_start``."""
     size = ch.size
     for delta in range(MAX_BLOCK_SIZE):
         pos = start + delta

@@ -115,6 +115,12 @@ def _load_native_locked():
         c_u8p, ctypes.c_int64, ctypes.c_int64,
         c_i32p, ctypes.c_int32, ctypes.c_int32, ctypes.c_int64,
     ]
+    lib.sbt_find_record_start_window.restype = ctypes.c_int64
+    lib.sbt_find_record_start_window.argtypes = [
+        c_u8p, ctypes.c_int64, ctypes.c_int64,
+        c_i32p, ctypes.c_int32, ctypes.c_int32, ctypes.c_int64,
+        ctypes.c_int32, c_i64p,
+    ]
     lib.sbt_tokenize_deflate.restype = ctypes.c_long
     lib.sbt_tokenize_deflate.argtypes = [
         c_u8p, c_i64p, c_i64p, ctypes.c_int64,
@@ -181,6 +187,39 @@ def find_record_start_native(
             reads_to_check, max_read_size,
         )
     )
+
+
+def find_record_start_window_native(
+    buf: np.ndarray,
+    start: int,
+    contig_lengths: np.ndarray,
+    reads_to_check: int = 10,
+    max_read_size: int = 10_000_000,
+    exact_eof: bool = False,
+) -> tuple[int, int] | None:
+    """Tri-state bounded-window scan: ``(found, uncertain_at)``.
+
+    ``found`` ≥ 0 is the first position whose chain passed on in-window
+    bytes alone (certain). ``found`` = -1 with ``uncertain_at`` ≥ 0 means
+    scanning stopped where a verdict depended on the window edge — every
+    earlier position is a certain fail; grow the window and resume there.
+    ``(-1, -1)`` = certain fails throughout the scanned span. ``None`` if
+    the native library is unavailable."""
+    lib = load_native()
+    if lib is None:
+        return None
+    buf = np.ascontiguousarray(buf, dtype=np.uint8)
+    lens = np.ascontiguousarray(contig_lengths, dtype=np.int32)
+    uncertain = ctypes.c_int64(-1)
+    found = int(
+        lib.sbt_find_record_start_window(
+            _ptr(buf, ctypes.c_uint8), len(buf), start,
+            _ptr(lens, ctypes.c_int32), len(lens),
+            reads_to_check, max_read_size,
+            1 if exact_eof else 0, ctypes.byref(uncertain),
+        )
+    )
+    return found, int(uncertain.value)
 
 
 def tokenize_deflate_native(

@@ -63,17 +63,29 @@ static inline int32_t rd_i32(const uint8_t* p) {
   return (int32_t)v;
 }
 
-static int eager_ok(
+// Core chain walk. `touched` (when non-null) is set to 1 iff the verdict
+// depended on the buffer edge `n` — the chain was cut mid-walk, so a
+// caller whose buffer end is NOT the file's EOF must treat the result as
+// uncertain in BOTH directions (a cut mid-record false-fails; a cut
+// exactly at a record edge false-passes). Verdicts that return without
+// touching `n` are exact regardless of what lies beyond the buffer.
+static int eager_ok_ex(
     const uint8_t* buf, int64_t n, int64_t start,
-    const int32_t* contig_lengths, int32_t num_contigs, int32_t reads_to_check) {
+    const int32_t* contig_lengths, int32_t num_contigs, int32_t reads_to_check,
+    int* touched) {
   int64_t logical = start;   // the recursion's startPos bookkeeping
   int64_t physical = start;  // actual stream position
   for (int32_t successes = 0;; ++successes) {
     if (successes == reads_to_check) return 1;
-    if (physical >= n)
+    if (physical >= n) {
       // Zero bytes exactly at the expected record edge after >=1 success.
+      if (touched) *touched = 1;
       return physical == logical && successes > 0;
-    if (physical + 36 > n) return 0;
+    }
+    if (physical + 36 > n) {
+      if (touched) *touched = 1;
+      return 0;
+    }
 
     const uint8_t* p = buf + physical;
     int32_t remaining = rd_i32(p);
@@ -103,7 +115,10 @@ static int eager_ok(
     if (next_ref >= 0 && next_pos > contig_lengths[next_ref]) return 0;
 
     int64_t name_end = physical + 36 + name_len;
-    if (name_end > n) return 0;
+    if (name_end > n) {
+      if (touched) *touched = 1;
+      return 0;
+    }
     if (buf[name_end - 1] != 0) return 0;
     for (int64_t j = physical + 36; j < name_end - 1; ++j) {
       uint8_t b = buf[j];
@@ -111,7 +126,10 @@ static int eager_ok(
     }
 
     int64_t cig_end = name_end + 4 * (int64_t)n_cigar;
-    if (cig_end > n) return 0;
+    if (cig_end > n) {
+      if (touched) *touched = 1;
+      return 0;
+    }
     for (int64_t j = name_end; j < cig_end; j += 4)
       if ((buf[j] & 0xf) > 8) return 0;
 
@@ -121,6 +139,13 @@ static int eager_ok(
     logical = next_logical;
     physical = next_physical;
   }
+}
+
+static int eager_ok(
+    const uint8_t* buf, int64_t n, int64_t start,
+    const int32_t* contig_lengths, int32_t num_contigs, int32_t reads_to_check) {
+  return eager_ok_ex(buf, n, start, contig_lengths, num_contigs,
+                     reads_to_check, nullptr);
 }
 
 // Verdicts for `m` candidate offsets.
@@ -143,6 +168,36 @@ int64_t sbt_find_record_start(
   for (int64_t pos = start; pos < limit && pos < n; ++pos)
     if (eager_ok(buf, n, pos, contig_lengths, num_contigs, reads_to_check))
       return pos;
+  return -1;
+}
+
+// Tri-state scan for bounded windows whose end is NOT the file's EOF
+// (split-boundary resolution over a partial inflate — load/api.py).
+// Returns the first position in [start, start+max_read_size) ∩ [0, n)
+// whose chain passes using only in-window bytes (a *certain* pass).
+// Scanning stops at the first position whose verdict depended on the
+// window edge: its index goes to *uncertain_at (else -1) and -1 is
+// returned — every position before it carries a certain verdict, so the
+// caller can grow the window and resume exactly there. With exact_eof
+// nonzero the window end IS the file end: classic semantics, never
+// uncertain.
+int64_t sbt_find_record_start_window(
+    const uint8_t* buf, int64_t n, int64_t start,
+    const int32_t* contig_lengths, int32_t num_contigs,
+    int32_t reads_to_check, int64_t max_read_size,
+    int32_t exact_eof, int64_t* uncertain_at) {
+  *uncertain_at = -1;
+  int64_t limit = start + max_read_size;
+  for (int64_t pos = start; pos < limit && pos < n; ++pos) {
+    int touched = 0;
+    int ok = eager_ok_ex(buf, n, pos, contig_lengths, num_contigs,
+                         reads_to_check, &touched);
+    if (touched && !exact_eof) {
+      *uncertain_at = pos;
+      return -1;
+    }
+    if (ok) return pos;
+  }
   return -1;
 }
 

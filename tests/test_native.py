@@ -62,3 +62,49 @@ def test_native_inflate_matches_zlib(native, bam2):
     )
     flat = flatten_file(bam2)
     np.testing.assert_array_equal(out, flat.data)
+
+
+def test_window_scan_never_skips_a_boundary(native, bam1):
+    """The tri-state bounded-window scan's safety invariant (the defect
+    class it exists to prevent): for ANY truncation of the buffer, either
+    it certainly finds the same first boundary the full-file scan finds,
+    or it stops with ``uncertain_at`` AT OR BEFORE that boundary — it must
+    never report a certain result that skips the true first boundary
+    because the cut falsified verdicts near the edge."""
+    from spark_bam_tpu.native.build import find_record_start_window_native
+
+    flat = flatten_file(bam1)
+    lens = np.array(contig_lengths(bam1).lengths_list(), dtype=np.int32)
+    rng = np.random.default_rng(23)
+    for _ in range(200):
+        start = int(rng.integers(0, flat.size - (64 << 10)))
+        truth = find_record_start_native(flat.data, start, lens)
+        cut = int(rng.integers(start + 1, min(start + (64 << 10), flat.size)))
+        window = flat.data[:cut]
+        found, uncertain_at = find_record_start_window_native(
+            window, start, lens, exact_eof=False
+        )
+        if found >= 0:
+            assert found == truth, (start, cut, found, truth)
+        elif uncertain_at >= 0:
+            assert truth == -1 or uncertain_at <= truth, (
+                start, cut, uncertain_at, truth
+            )
+        else:
+            # certain fails through the whole window: no boundary ≤ cut
+            assert truth == -1 or truth >= cut - 36, (start, cut, truth)
+
+
+def test_window_scan_exact_eof_matches_classic(native, bam2):
+    from spark_bam_tpu.native.build import find_record_start_window_native
+
+    flat = flatten_file(bam2)
+    lens = np.array(contig_lengths(bam2).lengths_list(), dtype=np.int32)
+    rng = np.random.default_rng(29)
+    for start in rng.integers(0, flat.size, 50).tolist():
+        classic = find_record_start_native(flat.data, int(start), lens)
+        found, uncertain_at = find_record_start_window_native(
+            flat.data, int(start), lens, exact_eof=True
+        )
+        assert uncertain_at == -1
+        assert found == classic
