@@ -175,11 +175,15 @@ def run_worker_bam(
     chunk_bytes: int = 192 << 20,
 ) -> dict:
     """Real-data multi-host count-reads: each process inflates only its own
-    block-range shard of ``path`` (seam halos stitched host-side from the
-    following blocks — SURVEY.md §2.9's halo-exchange plan), checks its rows
-    on its local devices, and the global count reduces with ``psum``.
+    block-range shard of ``path`` (seam halos read from the following
+    blocks — SURVEY.md §2.9's halo-exchange plan), checks its rows on its
+    local devices, and the global count reduces with ``psum``.
 
-    The division of labor mirrors the reference's executor-per-split layout
+    The sharding engine is ``parallel.stream_mesh.count_reads_sharded`` —
+    the SAME codepath the single-host ``--sharded`` CLI modes run (VERDICT
+    r4 item 6: one row discipline for both tiers); this worker only brings
+    up the cluster and passes its process coordinates. The division of
+    labor mirrors the reference's executor-per-split layout
     (load/.../SplitRDD.scala:43-79): block ranges are the shards, no
     cross-host byte motion beyond the halo overlap each host reads itself.
     """
@@ -196,119 +200,33 @@ def run_worker_bam(
             process_id=process_id,
         )
 
-    import jax.numpy as jnp
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from spark_bam_tpu.core.config import Config
+    from spark_bam_tpu.parallel.stream_mesh import count_reads_sharded
 
-    from spark_bam_tpu.bam.header import read_header
-    from spark_bam_tpu.bgzf.flat import inflate_blocks
-    from spark_bam_tpu.bgzf.index_blocks import blocks_metadata
-    from spark_bam_tpu.core.channel import open_channel
-    from spark_bam_tpu.parallel.mesh import make_mesh, make_shard_map_count_step
-    from spark_bam_tpu.tpu.checker import PAD
-    from spark_bam_tpu.tpu.inflate import window_plan
-
-    header = read_header(path)
-    header_end = header.uncompressed_size
-    lens_list = header.contig_lengths.lengths_list()
-    # GRCh38+alt/decoy references exceed 1024 contigs; size to the input.
-    lengths = np.zeros(max(1024, len(lens_list)), dtype=np.int32)
-    lengths[: len(lens_list)] = lens_list
-
-    metas = list(blocks_metadata(path))
-    groups = window_plan(metas, row_bytes)
-    # Row r owns its group's uncompressed span; flat start offsets:
-    sizes = [sum(m.uncompressed_size for m in g) for g in groups]
-    flat_starts = np.concatenate([[0], np.cumsum(sizes)[:-1]]).astype(np.int64)
-
-    devices = jax.devices()
-    n_global = len(devices)
-    n_local = jax.local_device_count()
-    mesh = make_mesh(devices)
-
-    # Pad the global row count to a multiple of the device count; empty
-    # rows check nothing (n=0, own=0).
-    n_rows = -(-len(groups) // n_global) * n_global
-    per_proc = n_rows // num_processes
-    # A row holds ≤ row_bytes of owned data plus a halo that overshoots by
-    # at most one BGZF block (≤64 KiB); size the kernel window to cover it.
-    from spark_bam_tpu.bgzf.block import MAX_BLOCK_SIZE
-
-    w = 1 << max(16, (row_bytes + halo + MAX_BLOCK_SIZE - 1).bit_length())
-
-    # Groups partition ``metas`` consecutively; first block index per group:
-    first_block_of_group = np.concatenate(
-        [[0], np.cumsum([len(g) for g in groups])[:-1]]
-    ).astype(np.int64)
-
-    # Rows are processed in fixed-size chunks so host memory stays
-    # O(chunk), not O(shard): every process loops the same chunk count
-    # (per_proc is identical across processes), inflating lazily per chunk
-    # and accumulating the psum'd chunk totals host-side.
-    rows_per_chunk = n_local * max(
-        1, chunk_bytes // ((w + PAD) * max(n_local, 1))
+    stats: dict = {}
+    count = count_reads_sharded(
+        path,
+        Config(),
+        window_uncompressed=row_bytes,
+        halo=halo,
+        num_processes=num_processes,
+        process_id=process_id,
+        chunk_bytes=chunk_bytes,
+        stats_out=stats,
     )
-    if per_proc:
-        # Never allocate more padding rows than the shard has (per_proc is
-        # a multiple of n_local and identical across processes).
-        rows_per_chunk = min(rows_per_chunk, per_proc)
-    shard = NamedSharding(mesh, P("data"))
-    repl = NamedSharding(mesh, P())
-    lengths_d = jax.device_put(lengths, repl)
-    step = make_shard_map_count_step(mesh)
-
-    totals = np.zeros(2, dtype=np.int64)
-    with open_channel(path) as ch:
-        for c0 in range(0, per_proc, rows_per_chunk):
-            # The final chunk keeps the full shape (trailing padding rows):
-            # every process must present identical shapes to the collective.
-            windows = np.zeros((rows_per_chunk, w + PAD), dtype=np.uint8)
-            ns = np.zeros(rows_per_chunk, dtype=np.int32)
-            eofs = np.zeros(rows_per_chunk, dtype=bool)
-            los = np.zeros(rows_per_chunk, dtype=np.int32)
-            owns = np.zeros(rows_per_chunk, dtype=np.int32)
-            for j in range(rows_per_chunk):
-                g = process_id * per_proc + c0 + j
-                if c0 + j >= per_proc or g >= len(groups):
-                    continue  # padding row (n=0, own=0 counts nothing)
-                b0 = int(first_block_of_group[g])
-                # Extend with following blocks until the halo is covered.
-                b1 = b0 + len(groups[g])
-                extra = 0
-                while b1 < len(metas) and extra < halo:
-                    extra += metas[b1].uncompressed_size
-                    b1 += 1
-                view = inflate_blocks(ch, metas[b0:b1])
-                n = view.size
-                windows[j, :n] = view.data
-                ns[j] = n
-                eofs[j] = b1 == len(metas)  # buffer end == file end
-                own = (
-                    n
-                    if b1 == len(metas) and g == len(groups) - 1
-                    else sizes[g]
-                )
-                owns[j] = own
-                los[j] = min(max(header_end - int(flat_starts[g]), 0), own)
-
-            args = [
-                jax.make_array_from_process_local_data(shard, a)
-                for a in (windows, ns, eofs, los, owns)
-            ]
-            totals += np.asarray(
-                step(*args, lengths_d, jnp.int32(len(lens_list)))
-            ).astype(np.int64)
     return {
         "mode": "bam",
         "path": str(path),
         "processes": num_processes,
         "process_id": process_id,
-        "global_devices": n_global,
-        "local_devices": n_local,
-        "rows": len(groups),
-        "chunks": -(-per_proc // rows_per_chunk) if per_proc else 0,
-        "count": int(totals[0]),
-        "escaped": int(totals[1]),
-        "ok": int(totals[1]) == 0,
+        "global_devices": len(jax.devices()),
+        "local_devices": jax.local_device_count(),
+        "rows": stats.get("rows", 0),
+        "chunks": stats.get("steps", 0),
+        "count": int(count),
+        "escaped": int(stats.get("escapes", 0)),
+        "fallback": bool(stats.get("fallback", False)),
+        "ok": True,
     }
 
 

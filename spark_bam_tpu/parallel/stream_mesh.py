@@ -1,39 +1,46 @@
-"""Mesh-sharded streaming workloads: one BAM across all chips.
+"""Mesh-sharded streaming workloads: one BAM across all chips — and hosts.
 
-Bridges the two scale paths that already exist separately:
+THE sharding engine for both scale tiers (VERDICT r4 item 6: one
+codepath):
 
-- ``tpu/stream_check.StreamChecker`` — whole-file streaming in O(window)
-  host memory, single device;
-- ``parallel/mesh``'s sharded step makers — the mesh-partitioned units
-  (``lax.psum`` over ICI) that ``multihost.py`` feeds with preassembled
-  window rows.
+- single-host multi-chip: ``count_reads_sharded`` / ``check_bam_sharded``
+  assemble rows over the local mesh (the CLI ``--sharded`` modes);
+- multi-host: ``parallel/multihost.py --bam`` calls the same functions
+  with ``num_processes``/``process_id`` — each process assembles only its
+  own row slice, and the tiny reductions ride the global mesh's
+  collectives (``lax.psum`` over ICI/DCN).
 
-Here the host assembles consecutive halo-carried windows into a
-``(n_devices, W+PAD)`` batch per step — the same carry/ownership
-discipline as ``StreamChecker`` (each row's trailing ``halo`` is owned by
-the next row, so every owned position has full chain lookahead; seam
-semantics come from the shared ``halo_windows`` generator) — and every
-step runs one sharded kernel with the tiny reduction riding the mesh.
-This is the single-host multi-chip production path of:
+Row discipline (the property multi-host needs — any row computable from
+``(path, metas)`` alone, no sequential carry):
+
+- ``window_plan`` groups consecutive BGZF blocks into ≈window-sized
+  uncompressed runs; row *g* OWNS group *g*'s uncompressed span, which
+  tiles ``[0, total)`` exactly;
+- each row's buffer extends past its owned span with following blocks
+  until ≥ ``halo`` lookahead bytes are present (re-inflated overlap —
+  ≤ halo + one block per row — traded for seam independence; the
+  reference's analog is hadoop-bam re-reading across split edges,
+  load/.../SplitRDD.scala:43-79);
+- a chain that outruns even the halo reports an *escape*; any escape
+  aborts the device pass and the file re-runs through ``StreamChecker``'s
+  deferral-exact spans path (single device) — same policy as
+  ``StreamChecker.count_reads``. On real data with the default halo this
+  never triggers.
+
+Workloads (SURVEY.md §2.8 maps file/block data-parallelism onto per-core
+batch pipelines; §2.9 replaces Spark accumulators with ``psum``):
 
 - ``count_reads_sharded`` — the count-reads workload (reference
   docs/benchmarks.md:53-59);
-- ``check_bam_sharded`` — the check-bam validation workload: verdicts vs
-  the ``.records`` indexed ground truth at every uncompressed position,
+- ``check_bam_sharded`` — check-bam validation: verdicts vs the
+  ``.records`` indexed ground truth at every uncompressed position,
   confusion matrix accumulated via ``psum`` (reference
   CheckerApp.scala:59-93's accumulator pipeline).
-
-SURVEY.md §2.8 maps file/block data-parallelism onto per-core batch
-pipelines; §2.9 replaces Spark accumulators with ``psum``.
-
-Exactness: rows whose chains outrun the halo report escapes; any escape
-aborts the device pass and the file re-runs through ``StreamChecker``'s
-deferral-exact spans path (single device). On real data with the default
-halo this never triggers — same policy as ``StreamChecker.count_reads``.
 """
 
 from __future__ import annotations
 
+from concurrent.futures import ThreadPoolExecutor
 from typing import Callable
 
 import numpy as np
@@ -43,6 +50,9 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from spark_bam_tpu.bam.header import read_header
+from spark_bam_tpu.bgzf.block import MAX_BLOCK_SIZE
+from spark_bam_tpu.bgzf.flat import inflate_blocks
+from spark_bam_tpu.core.channel import open_channel
 from spark_bam_tpu.core.config import Config
 from spark_bam_tpu.parallel.mesh import (
     make_mesh,
@@ -50,18 +60,21 @@ from spark_bam_tpu.parallel.mesh import (
     make_shard_map_count_step,
 )
 from spark_bam_tpu.tpu.checker import PAD
-from spark_bam_tpu.tpu.inflate import InflatePipeline
+from spark_bam_tpu.tpu.inflate import (
+    inflate_group_device,
+    resolve_device_inflate,
+    window_plan,
+)
 from spark_bam_tpu.tpu.stream_check import (
     StreamChecker,
     _next_pow2,
-    halo_windows,
     pad_contig_lengths,
 )
 
 
 class _ShardedStream:
-    """Shared plumbing: plan the stream, build the row batch arrays, and
-    iterate ``halo_windows`` rows into ``n_devices``-row batches."""
+    """Shared plumbing: plan the block groups, assemble this process's row
+    slice into mesh-wide batches (double-buffered), build sharded args."""
 
     def __init__(
         self,
@@ -72,96 +85,160 @@ class _ShardedStream:
         halo: int | None,
         metas: list | None,
         with_truth: bool = False,
+        num_processes: int = 1,
+        process_id: int = 0,
+        chunk_bytes: int = 192 << 20,
     ):
+        from spark_bam_tpu.bgzf.index_blocks import blocks_metadata
+
         self.path = path
         self.config = config
         self.mesh = mesh if mesh is not None else make_mesh()
-        self.n_dev = int(self.mesh.devices.size)
+        self.n_global = int(self.mesh.devices.size)
         self.axis = self.mesh.axis_names[0]
+        self.num_processes = num_processes
+        self.process_id = process_id
 
         header = read_header(path)
         lens_list = header.contig_lengths.lengths_list()
         self.num_contigs = len(lens_list)
         self.lengths = pad_contig_lengths(np.asarray(lens_list, dtype=np.int32))
+        self.header_end = header.uncompressed_size
 
         self.fresh = window_uncompressed or config.window_size
         halo = config.halo_size if halo is None else halo
         self.halo = min(halo, self.fresh // 2)
-        self.metas = metas
-        self.pipeline = InflatePipeline(
-            path, window_uncompressed=self.fresh,
-            device_copy=config.device_inflate, metas=metas,
+        self.metas = list(blocks_metadata(path)) if metas is None else metas
+        self.groups = window_plan(self.metas, self.fresh)
+        self.sizes = np.array(
+            [sum(m.uncompressed_size for m in g) for g in self.groups],
+            dtype=np.int64,
         )
-        self.total = self.pipeline.total
+        self.flat_starts = np.zeros(len(self.groups), dtype=np.int64)
+        if len(self.groups):
+            np.cumsum(self.sizes[:-1], out=self.flat_starts[1:])
+        self.first_block = np.zeros(len(self.groups), dtype=np.int64)
+        if len(self.groups):
+            np.cumsum(
+                [len(g) for g in self.groups[:-1]], out=self.first_block[1:]
+            )
+        self.total = int(self.sizes.sum())
+        # Row buffer bound: owned span (≤ fresh, or one oversized block) +
+        # halo + ≤ one block of halo-extension overshoot.
+        row_bound = max(self.fresh, MAX_BLOCK_SIZE) + self.halo + MAX_BLOCK_SIZE
         self.kernel_window = _next_pow2(
-            min(self.fresh + self.halo, max(self.total, 1 << 16))
+            min(row_bound, max(self.total, 1 << 16))
         )
-        self.header_end = header.uncompressed_size
+        self.device_inflate = resolve_device_inflate(config)
+
+        # Global rows padded so every process loops identical step counts
+        # with identical shapes (the collective's requirement).
+        n_rows = -(-max(len(self.groups), 1) // self.n_global) * self.n_global
+        self.per_proc = n_rows // num_processes
+        n_local = self.n_global // num_processes
+        kw = self.kernel_window
+        self.step_rows_local = n_local * max(
+            1, chunk_bytes // ((kw + PAD) * max(n_local, 1))
+        )
+        if self.per_proc:
+            self.step_rows_local = min(self.step_rows_local, self.per_proc)
+        self.with_truth = with_truth
 
         self.row_sharding = NamedSharding(self.mesh, P(self.axis))
         repl = NamedSharding(self.mesh, P())
         self.lengths_d = jax.device_put(jnp.asarray(self.lengths), repl)
         self.nc = jnp.int32(self.num_contigs)
 
-        kw = self.kernel_window
-        self.ws = np.zeros((self.n_dev, kw + PAD), dtype=np.uint8)
-        self.ns = np.zeros(self.n_dev, dtype=np.int32)
-        self.eofs = np.zeros(self.n_dev, dtype=bool)
-        self.los = np.zeros(self.n_dev, dtype=np.int32)
-        self.owns = np.zeros(self.n_dev, dtype=np.int32)
-        self.truth = (
-            np.zeros((self.n_dev, kw), dtype=bool) if with_truth else None
+    # ------------------------------------------------------------- assembly
+    def _row(self, ch, g: int):
+        """Inflate global row ``g``: returns (buf, n, at_eof, own, base)."""
+        b0 = int(self.first_block[g])
+        b1 = b0 + len(self.groups[g])
+        extra = 0
+        while b1 < len(self.metas) and extra < self.halo:
+            extra += self.metas[b1].uncompressed_size
+            b1 += 1
+        run = self.metas[b0:b1]
+        view = None
+        if self.device_inflate:
+            try:
+                view = inflate_group_device(ch, run)
+            except Exception:
+                view = None  # host zlib is the permanent fallback
+        if view is None:
+            view = inflate_blocks(ch, run, threads=8)
+        at_eof = b1 == len(self.metas)
+        own = (
+            view.size
+            if at_eof and g == len(self.groups) - 1
+            else int(self.sizes[g])
         )
+        return view.data, view.size, at_eof, own, int(self.flat_starts[g])
 
-    def zero_tail_rows(self, k_rows: int):
-        """Blank rows ≥ k_rows so a stale previous batch can't leak in."""
-        self.ws[k_rows:] = 0
-        self.ns[k_rows:] = 0
-        self.eofs[k_rows:] = False
-        self.los[k_rows:] = 0
-        self.owns[k_rows:] = 0
-        if self.truth is not None:
-            self.truth[k_rows:] = False
+    def _assemble(self, ch, c0: int, header_clamp: bool, fill_row):
+        """One step's process-local arrays (fixed shapes; padding rows are
+        all-zero and own nothing)."""
+        kw = self.kernel_window
+        k = self.step_rows_local
+        ws = np.zeros((k, kw + PAD), dtype=np.uint8)
+        ns = np.zeros(k, dtype=np.int32)
+        eofs = np.zeros(k, dtype=bool)
+        los = np.zeros(k, dtype=np.int32)
+        owns = np.zeros(k, dtype=np.int32)
+        truth = np.zeros((k, kw), dtype=bool) if self.with_truth else None
+        he = self.header_end if header_clamp else 0
+        for j in range(k):
+            g = self.process_id * self.per_proc + c0 + j
+            if c0 + j >= self.per_proc or g >= len(self.groups):
+                continue
+            buf, n, at_eof, own, base = self._row(ch, g)
+            ws[j, :n] = buf
+            ns[j] = n
+            eofs[j] = at_eof
+            owns[j] = own
+            los[j] = min(max(he - base, 0), own)
+            if fill_row is not None:
+                fill_row(truth[j], buf, base, n)
+        return ws, ns, eofs, los, owns, truth
 
     def batches(self, header_clamp: bool, fill_row=None):
-        """Yield ``(k_rows, positions_done)`` after filling each batch of up
-        to ``n_dev`` rows. ``fill_row(k, buf, base, n)`` fills aligned
-        per-row extras (e.g. truth masks). ``header_clamp=False`` counts
-        header bytes in owned spans (check-bam considers every position)."""
-        he = self.header_end if header_clamp else 0
-        k = 0
-        done = 0
-        for buf, base, own_end, lo, at_eof in halo_windows(
-            self.pipeline, self.halo, he
-        ):
-            n = len(buf)
-            self.ws[k, :n] = buf
-            self.ws[k, n:] = 0
-            self.ns[k] = n
-            self.eofs[k] = at_eof
-            self.los[k] = lo
-            self.owns[k] = own_end
-            if fill_row is not None:
-                fill_row(k, buf, base, n)
-            done = base + own_end
-            k += 1
-            if k == self.n_dev:
-                yield k, done
-                k = 0
-        if k:
-            yield k, done
+        """Yield ``(sharded_args, positions_done)`` per step, assembling the
+        next step's rows while the caller's device work runs (one step of
+        lookahead — the double-buffering the single-host pipeline had)."""
+        if not self.per_proc:
+            return
+        steps = list(range(0, self.per_proc, self.step_rows_local))
+        with open_channel(self.path) as ch, ThreadPoolExecutor(1) as pool:
+            pending = pool.submit(
+                self._assemble, ch, steps[0], header_clamp, fill_row
+            )
+            for i, c0 in enumerate(steps):
+                arrays = pending.result()
+                if i + 1 < len(steps):
+                    pending = pool.submit(
+                        self._assemble, ch, steps[i + 1], header_clamp, fill_row
+                    )
+                # Highest global row completed this step (process-major row
+                # order: the last process owns the file's final groups).
+                g_hi = min(
+                    (self.num_processes - 1) * self.per_proc
+                    + c0 + self.step_rows_local,
+                    len(self.groups),
+                ) - 1
+                done = int(self.flat_starts[g_hi] + self.sizes[g_hi])
+                yield self._sharded_args(arrays), done
 
-    def sharded_args(self):
-        put = jax.device_put
+    def _sharded_args(self, arrays):
+        ws, ns, eofs, los, owns, truth = arrays
         rs = self.row_sharding
-        args = [
-            put(jnp.asarray(self.ws), rs),
-            put(jnp.asarray(self.ns), rs),
-            put(jnp.asarray(self.eofs), rs),
-        ]
-        if self.truth is not None:
-            args.append(put(jnp.asarray(self.truth), rs))
-        args += [put(jnp.asarray(self.los), rs), put(jnp.asarray(self.owns), rs)]
+
+        def put(a):
+            return jax.make_array_from_process_local_data(rs, a)
+
+        args = [put(ws), put(ns), put(eofs)]
+        if truth is not None:
+            args.append(put(truth))
+        args += [put(los), put(owns)]
         return args + [self.lengths_d, self.nc]
 
 
@@ -174,15 +251,21 @@ def count_reads_sharded(
     metas: list | None = None,
     progress: Callable[[int, int, int], None] | None = None,
     stats_out: dict | None = None,
+    num_processes: int = 1,
+    process_id: int = 0,
+    chunk_bytes: int = 192 << 20,
 ) -> int:
     """Record count of ``path`` computed across ``mesh`` (default: all
-    devices). ``progress(steps_done, positions_done, total_positions)``
-    fires after each sharded step. ``stats_out``, when given, receives
-    ``{"steps", "escapes", "fallback"}`` — callers that must know whether
-    the mesh pass itself produced the count (vs the escape fallback)
-    read ``fallback`` (e.g. hardware smoke tests)."""
+    devices; multi-host callers pass their process coordinates and get the
+    globally reduced count on every process). ``progress(steps_done,
+    positions_done, total_positions)`` fires after each sharded step.
+    ``stats_out``, when given, receives ``{"steps", "escapes", "fallback"}``
+    — callers that must know whether the mesh pass itself produced the
+    count (vs the escape fallback) read ``fallback``."""
     st = _ShardedStream(
-        path, config, mesh, window_uncompressed, halo, metas
+        path, config, mesh, window_uncompressed, halo, metas,
+        num_processes=num_processes, process_id=process_id,
+        chunk_bytes=chunk_bytes,
     )
     step = make_shard_map_count_step(
         st.mesh, reads_to_check=config.reads_to_check, axis=st.axis,
@@ -190,13 +273,12 @@ def count_reads_sharded(
     )
     count = escapes = steps = 0
     # Closing the batch generator on early exit (escape break, error)
-    # propagates into the pipeline iterator's finally, shutting down its
-    # inflate pool and channel before any fallback reopens the file.
+    # shuts down the assembly pool and channel before any fallback
+    # reopens the file.
     batches = st.batches(header_clamp=True)
     try:
-        for k_rows, done in batches:
-            st.zero_tail_rows(k_rows)
-            totals = np.asarray(step(*st.sharded_args()))
+        for args, done in batches:
+            totals = np.asarray(step(*args))
             count += int(totals[0])
             escapes += int(totals[1])
             steps += 1
@@ -209,15 +291,18 @@ def count_reads_sharded(
 
     if stats_out is not None:
         stats_out.update(
-            steps=steps, escapes=escapes, fallback=bool(escapes)
+            steps=steps, escapes=escapes, fallback=bool(escapes),
+            rows=len(st.groups),
         )
     if escapes:
         # Ultra-long chains outran the halo: resolve bit-exactly through
-        # the single-device deferral path (reusing the sharded pass's
-        # block-metadata scan, not a second whole-file walk).
+        # the single-device deferral path (reusing this pass's block-
+        # metadata scan). Multi-host: every process computes the same
+        # exact count — redundant but correct, and only on pathological
+        # inputs.
         return StreamChecker(
             path, config, window_uncompressed=st.fresh, halo=st.halo,
-            metas=st.pipeline.metas,
+            metas=st.metas,
         ).count_reads()
     return count
 
@@ -257,6 +342,8 @@ def check_bam_sharded(
     halo: int | None = None,
     metas: list | None = None,
     progress: Callable[[int, int, int], None] | None = None,
+    num_processes: int = 1,
+    process_id: int = 0,
 ) -> dict:
     """check-bam across the mesh: the vectorized checker's verdict vs the
     ``.records`` indexed ground truth at **every uncompressed position** of
@@ -270,19 +357,16 @@ def check_bam_sharded(
     always exact.
     """
     st = _ShardedStream(
-        path, config, mesh, window_uncompressed, halo, metas, with_truth=True
+        path, config, mesh, window_uncompressed, halo, metas,
+        with_truth=True, num_processes=num_processes, process_id=process_id,
     )
-    # The pipeline already walked every block header; reuse its scan for
-    # the truth table instead of a second whole-file metadata walk.
-    truth_flats = _truth_flats(path, records_path, st.pipeline.metas)
+    truth_flats = _truth_flats(path, records_path, st.metas)
     step = make_shard_map_confusion_step(
         st.mesh, reads_to_check=config.reads_to_check, axis=st.axis,
         flags_impl=config.flags_impl,
     )
 
-    def fill_row(k, buf, base, n):
-        row = st.truth[k]
-        row[:] = False
+    def fill_row(row, buf, base, n):
         i0, i1 = np.searchsorted(truth_flats, (base, base + n))
         row[truth_flats[i0:i1] - base] = True
 
@@ -293,9 +377,8 @@ def check_bam_sharded(
     steps = 0
     batches = st.batches(header_clamp=False, fill_row=fill_row)
     try:
-        for k_rows, done in batches:
-            st.zero_tail_rows(k_rows)
-            agg += np.asarray(step(*st.sharded_args()), dtype=np.int64)
+        for args, done in batches:
+            agg += np.asarray(step(*args), dtype=np.int64)
             steps += 1
             if progress is not None:
                 progress(steps, done, st.total)
@@ -306,7 +389,7 @@ def check_bam_sharded(
 
     if agg[3]:
         stats = _check_bam_exact(
-            path, config, st.fresh, st.halo, st.pipeline.metas, truth_flats,
+            path, config, st.fresh, st.halo, st.metas, truth_flats,
             st.total,
         )
         stats["devices"] = 1  # the exact fallback is single-device
@@ -318,7 +401,7 @@ def check_bam_sharded(
         "false_negatives": fn,
         "true_negatives": st.total - tp - fp - fn,
         "positions": st.total,
-        "devices": st.n_dev,
+        "devices": st.n_global,
     }
 
 

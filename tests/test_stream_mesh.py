@@ -53,15 +53,39 @@ def test_sharded_count_single_batch_small_file():
     assert got == 2500
 
 
-def test_sharded_count_escape_falls_back_exact():
-    # A 1 KiB halo is shorter than a 10-record chain's span, so owned
+import pytest
+
+
+@pytest.fixture(scope="module")
+def longread_bam(tmp_path_factory):
+    """A small long-read BAM whose ultra records (~2.25 MB encoded) outrun
+    any sub-MB halo even after the engine's block-granular halo extension —
+    the escape-forcing input (2.bam's ~150 B records can't force escapes
+    any more: one 64 KiB halo block always covers their chains)."""
+    from spark_bam_tpu.bam.index_records import index_records
+    from spark_bam_tpu.benchmarks.synth import synth_longread_bam
+
+    p = tmp_path_factory.mktemp("lr") / "lr.bam"
+    manifest = synth_longread_bam(
+        p, 2 << 20, read_lens=(30_000, 60_000), reads_per_rep=6,
+        ultra_seq_len=1_500_000,
+    )
+    index_records(p)
+    return str(p), manifest
+
+
+def test_sharded_count_escape_falls_back_exact(longread_bam):
+    # A 256 KiB halo is far shorter than an ultra record's span, so owned
     # positions near every seam escape; the device pass must abort and the
     # single-device deferral-exact path must still land the right count.
+    path, manifest = longread_bam
+    stats = {}
     got = count_reads_sharded(
-        BAM2, Config(), mesh=_mesh(),
-        window_uncompressed=128 << 10, halo=1 << 10,
+        path, Config(), mesh=_mesh(),
+        window_uncompressed=1 << 20, halo=256 << 10, stats_out=stats,
     )
-    assert got == 2500
+    assert got == manifest["reads"]
+    assert stats["escapes"] > 0 and stats["fallback"]
 
 
 def test_check_bam_sharded_bam2_all_match():
@@ -99,18 +123,20 @@ def test_check_bam_sharded_bam1():
     assert stats["positions"] == 1_608_257
 
 
-def test_check_bam_sharded_escape_fallback_matches_device_pass():
-    # Tiny halo forces escapes; the exact set-arithmetic fallback must
-    # produce the same matrix the device pass produces with a real halo.
+def test_check_bam_sharded_escape_fallback_matches_device_pass(longread_bam):
+    # A halo too small for the ultra records forces escapes; the exact
+    # set-arithmetic fallback must produce the same matrix the device pass
+    # produces with a halo that covers every chain.
     from spark_bam_tpu.parallel.stream_mesh import check_bam_sharded
 
+    path, _ = longread_bam
     via_fallback = check_bam_sharded(
-        BAM2, Config(), mesh=_mesh(),
-        window_uncompressed=128 << 10, halo=1 << 10,
+        path, Config(), mesh=_mesh(),
+        window_uncompressed=1 << 20, halo=256 << 10,
     )
     via_device = check_bam_sharded(
-        BAM2, Config(), mesh=_mesh(),
-        window_uncompressed=128 << 10, halo=32 << 10,
+        path, Config(), mesh=_mesh(),
+        window_uncompressed=8 << 20, halo=4 << 20,
     )
     assert via_fallback.pop("devices") == 1  # the exact fallback path ran
     assert via_device.pop("devices") == 8
@@ -160,10 +186,31 @@ def test_stats_out_reports_fallback():
         window_uncompressed=128 << 10, halo=32 << 10, stats_out=stats,
     )
     assert stats["fallback"] is False and stats["steps"] > 0
+    assert stats["rows"] > 1  # multiple block groups actually sharded
 
-    stats = {}
-    count_reads_sharded(
-        BAM2, Config(), mesh=_mesh(),
-        window_uncompressed=128 << 10, halo=1 << 10, stats_out=stats,
+
+def test_process_slicing_covers_every_group_once():
+    """The multi-host row split: across processes, each global row index
+    maps to exactly one process's slice, padding rows own nothing, and the
+    per-process step counts are identical — the collective's shape
+    contract. (The cross-process psum itself is proven by
+    tests/test_multihost.py's 2-process run through this same engine.)"""
+    from spark_bam_tpu.parallel.stream_mesh import _ShardedStream
+
+    st_all = _ShardedStream(
+        BAM2, Config(), _mesh(), 128 << 10, 32 << 10, None
     )
-    assert stats["fallback"] is True and stats["escapes"] > 0
+    owned = []
+    for pid in range(2):
+        st = _ShardedStream(
+            BAM2, Config(), _mesh(), 128 << 10, 32 << 10, None,
+            num_processes=2, process_id=pid,
+        )
+        assert st.per_proc == st_all.per_proc // 2 or st.per_proc * 2 == -(
+            -len(st.groups) // st.n_global
+        ) * st.n_global
+        for local in range(st.per_proc):
+            g = pid * st.per_proc + local
+            if g < len(st.groups):
+                owned.append(g)
+    assert sorted(owned) == list(range(len(st_all.groups)))
