@@ -1,0 +1,119 @@
+"""In-process fake object store: ranged GETs + injected latency.
+
+One implementation for every consumer that needs a stand-in GCS/S3/HTTP
+origin — the bench's remote-latency leg and the cloud/remote test suites —
+so Range-handling fixes land once. Serves a single object at any path
+ending in the registered key; everything else 404s (sidecar probes must
+read as absent)."""
+
+from __future__ import annotations
+
+import http.server
+import threading
+import time
+
+
+class FakeObjectStore:
+    """``with FakeObjectStore(data, key="obj.bam", latency_s=0.1) as s:``
+    exposes ``s.url_base`` (http://127.0.0.1:port) and live ``s.stats``
+    (``requests``, ``auth_failures``)."""
+
+    def __init__(
+        self,
+        data: bytes,
+        key: str = "remote.bam",
+        latency_s: float = 0.0,
+        require_bearer: str | None = None,
+    ):
+        self.data = data
+        self.key = key
+        self.latency_s = latency_s
+        self.require_bearer = require_bearer
+        self.stats = {"requests": 0, "auth_failures": 0}
+        store = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def _empty(self, status: int):
+                self.send_response(status)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+            def _gate(self) -> bool:
+                store.stats["requests"] += 1
+                if store.latency_s:
+                    time.sleep(store.latency_s)
+                if not self.path.endswith("/" + store.key):
+                    self._empty(404)
+                    return False
+                if store.require_bearer is not None:
+                    ok = (
+                        self.headers.get("Authorization")
+                        == f"Bearer {store.require_bearer}"
+                    )
+                    if not ok:
+                        store.stats["auth_failures"] += 1
+                        self._empty(403)
+                        return False
+                return True
+
+            def do_HEAD(self):
+                if not self._gate():
+                    return
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(store.data)))
+                self.send_header("Accept-Ranges", "bytes")
+                self.end_headers()
+
+            def do_GET(self):
+                if not self._gate():
+                    return
+                data = store.data
+                rng = self.headers.get("Range")
+                if rng:
+                    lo_s, _, hi_s = rng.split("=")[1].partition("-")
+                    lo = int(lo_s)
+                    # RFC 9110: an open-ended "bytes=lo-" runs to the end.
+                    hi = int(hi_s) if hi_s else len(data) - 1
+                    hi = min(hi, len(data) - 1)
+                    if lo >= len(data):
+                        self.send_response(416)
+                        self.send_header(
+                            "Content-Range", f"bytes */{len(data)}"
+                        )
+                        self.send_header("Content-Length", "0")
+                        self.end_headers()
+                        return
+                    body = data[lo:hi + 1]
+                    self.send_response(206)
+                    self.send_header(
+                        "Content-Range",
+                        f"bytes {lo}-{lo + len(body) - 1}/{len(data)}",
+                    )
+                else:
+                    body = data
+                    self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        class _Server(http.server.ThreadingHTTPServer):
+            daemon_threads = True
+
+        self._srv = _Server(("127.0.0.1", 0), Handler)
+        self.url_base = f"http://127.0.0.1:{self._srv.server_port}"
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def close(self):
+        self._srv.shutdown()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

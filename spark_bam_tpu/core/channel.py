@@ -209,6 +209,13 @@ def register_scheme(scheme: str, factory) -> None:
     _SCHEMES[scheme] = factory
 
 
+def _ensure_builtin_scheme(scheme: str) -> None:
+    """Lazy-load the built-in cloud backends on first gs://-or-s3:// use
+    (core.cloud registers both on import; explicit registrations win)."""
+    if scheme in ("gs", "s3") and scheme not in _SCHEMES:
+        import spark_bam_tpu.core.cloud  # noqa: F401  (registers schemes)
+
+
 def is_url(path) -> bool:
     return bool(_URL_RE.match(str(path)))
 
@@ -217,6 +224,7 @@ def _raw_url_channel(url: str) -> ByteChannel:
     """One-shot metadata channel for a URL: the bare backend, no prefetch
     pool (a HEAD or single ranged GET doesn't want read-ahead)."""
     scheme = _URL_RE.match(url).group(1)
+    _ensure_builtin_scheme(scheme)
     if scheme in _SCHEMES:
         return _SCHEMES[scheme](url)
     if scheme in ("http", "https"):
@@ -269,6 +277,7 @@ def open_channel(path, cached: bool = False) -> ByteChannel:
     m = _URL_RE.match(s)
     if m:
         scheme = m.group(1)
+        _ensure_builtin_scheme(scheme)
         if scheme in _SCHEMES:  # registrations override built-ins
             ch: ByteChannel = _SCHEMES[scheme](s)
         elif scheme in ("http", "https"):

@@ -59,7 +59,7 @@ class HttpRangeChannel(ByteChannel):
     #: transient statuses worth retrying (GCS/S3 throttling + 5xx blips)
     RETRY_STATUSES = (429, 500, 502, 503, 504)
 
-    def __init__(self, url: str, headers: dict | None = None,
+    def __init__(self, url: str, headers=None,
                  timeout: float = 30.0, retries: int = 3):
         super().__init__()
         self._retries = max(0, retries)
@@ -73,7 +73,11 @@ class HttpRangeChannel(ByteChannel):
         self._path = u.path or "/"
         if u.query:
             self._path += "?" + u.query
-        self._headers = dict(headers or {})
+        # ``headers`` may be a dict (static) or a callable
+        # ``headers(method) -> dict`` evaluated per request — auth schemes
+        # that sign the method + a timestamp (S3 SigV4, expiring bearer
+        # tokens) need fresh headers on every attempt.
+        self._headers = headers if callable(headers) else dict(headers or {})
         self._timeout = timeout
         self._local = threading.local()
         self._conns: list[http.client.HTTPConnection] = []
@@ -98,11 +102,15 @@ class HttpRangeChannel(ByteChannel):
 
     def _request(self, method: str, extra_headers: dict):
         """One request with a single retry on a stale keep-alive socket."""
+        base = (
+            self._headers(method) if callable(self._headers)
+            else self._headers
+        )
         for attempt in (0, 1):
             conn = self._conn()
             try:
                 conn.request(
-                    method, self._path, headers={**self._headers, **extra_headers}
+                    method, self._path, headers={**base, **extra_headers}
                 )
                 return conn.getresponse()
             except (http.client.HTTPException, ConnectionError, OSError):
