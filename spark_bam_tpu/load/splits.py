@@ -1,13 +1,24 @@
-"""File splits and resolved record-boundary splits.
+"""File splits and resolved record-boundary splits — plus split locality.
 
 Reference: hadoop ``FileSplits`` → ``SplitRDD`` byte ranges
 (load/.../load/SplitRDD.scala:37-79) and the resolved
 ``Split(start: Pos, end: Pos)`` (check/.../bam/spark/Split.scala:80-104).
+
+Locality: the reference's ``SplitRDD.preferredLocations`` surfaces HDFS
+block hosts so Spark schedules tasks data-local. There is no HDFS here;
+the analog is a pluggable provider — ``set_locality_provider`` registers
+``fn(path, start, end) -> list[str]`` (e.g. a cache-affinity map for
+remote objects, or a parallel-FS topology query) and
+``preferred_hosts(split)`` consults it. The multi-host mesh analog is
+``parallel.stream_mesh.host_shard_plan``: the exact per-host contiguous
+block ranges the unified sharding engine will read, for co-locating
+processes with data before bring-up.
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass
+from typing import Callable
 
 from spark_bam_tpu.core.channel import path_size
 from spark_bam_tpu.core.pos import Pos
@@ -44,3 +55,23 @@ def file_splits(path, split_size: int) -> list[FileSplit]:
         FileSplit(str(path), start, min(start + split_size, size))
         for start in range(0, size, split_size)
     ]
+
+
+# ------------------------------------------------------------------ locality
+
+_LOCALITY_PROVIDER: Callable[[str, int, int], list] | None = None
+
+
+def set_locality_provider(fn: Callable[[str, int, int], list] | None) -> None:
+    """Register ``fn(path, start, end) -> [host, ...]`` (or None to clear)
+    — the ``SplitRDD.preferredLocations`` analog for whatever storage
+    topology the deployment has (reference SplitRDD.scala:43-79)."""
+    global _LOCALITY_PROVIDER
+    _LOCALITY_PROVIDER = fn
+
+
+def preferred_hosts(split: FileSplit) -> list:
+    """Hosts that hold (or cache) ``split``'s byte range; empty = anywhere."""
+    if _LOCALITY_PROVIDER is None:
+        return []
+    return list(_LOCALITY_PROVIDER(split.path, split.start, split.end))

@@ -307,6 +307,72 @@ def count_reads_sharded(
     return count
 
 
+def host_shard_plan(
+    path,
+    num_hosts: int,
+    devices_per_host: int,
+    config: Config = Config(),
+    window_uncompressed: int | None = None,
+    halo: int | None = None,
+    metas: list | None = None,
+) -> list[dict]:
+    """The per-host IO footprint of a ``num_hosts × devices_per_host``
+    sharded run BEFORE any backend comes up — the scheduler-facing
+    locality surface (reference ``SplitRDD.preferredLocations``,
+    load/.../SplitRDD.scala:43-79: tell the scheduler where the bytes are;
+    here: tell it which bytes each process will read, so it can place
+    processes near data or pre-warm caches).
+
+    Returns one dict per host: ``host`` (process id), ``groups`` (owned
+    block-group index range, end-exclusive), ``compressed_range`` (the
+    [lo, hi) file byte range the host reads, INCLUDING its trailing halo
+    overlap), ``uncompressed`` (owned flat bytes). Owned group ranges
+    partition the file exactly; compressed ranges overlap by ≤ halo + one
+    block at each seam. Uses the same row arithmetic as the sharded
+    engine, so the plan is exact, not an estimate."""
+    from spark_bam_tpu.bgzf.index_blocks import blocks_metadata
+
+    fresh = window_uncompressed or config.window_size
+    h = config.halo_size if halo is None else halo
+    h = min(h, fresh // 2)
+    metas = list(blocks_metadata(path)) if metas is None else metas
+    groups = window_plan(metas, fresh)
+    first_block = np.zeros(len(groups), dtype=np.int64)
+    if len(groups):
+        np.cumsum([len(g) for g in groups[:-1]], out=first_block[1:])
+    sizes = [sum(m.uncompressed_size for m in g) for g in groups]
+    n_global = num_hosts * devices_per_host
+    n_rows = -(-max(len(groups), 1) // n_global) * n_global
+    per_proc = n_rows // num_hosts
+
+    plan = []
+    for p in range(num_hosts):
+        g0 = min(p * per_proc, len(groups))
+        g1 = min((p + 1) * per_proc, len(groups))
+        if g0 == g1:
+            plan.append({
+                "host": p, "groups": (g0, g0),
+                "compressed_range": (0, 0), "uncompressed": 0,
+            })
+            continue
+        b0 = int(first_block[g0])
+        b1 = b0 + sum(len(groups[g]) for g in range(g0, g1))
+        # Trailing halo overlap: the last owned row reads past its span.
+        extra = 0
+        while b1 < len(metas) and extra < h:
+            extra += metas[b1].uncompressed_size
+            b1 += 1
+        lo = metas[b0].start
+        hi = metas[b1 - 1].start + metas[b1 - 1].compressed_size
+        plan.append({
+            "host": p,
+            "groups": (g0, g1),
+            "compressed_range": (int(lo), int(hi)),
+            "uncompressed": int(sum(sizes[g0:g1])),
+        })
+    return plan
+
+
 def _truth_flats(path, records_path, metas) -> np.ndarray:
     """The ``.records`` ground truth as sorted absolute flat offsets."""
     from spark_bam_tpu.bam.index_records import read_records_index

@@ -214,3 +214,58 @@ def test_process_slicing_covers_every_group_once():
             if g < len(st.groups):
                 owned.append(g)
     assert sorted(owned) == list(range(len(st_all.groups)))
+
+
+def test_host_shard_plan_partitions_exactly():
+    """The scheduler-facing locality surface: owned group ranges partition
+    the file, compressed ranges tile it with only halo-sized seam overlap,
+    and the arithmetic matches the engine's own row slicing."""
+    from spark_bam_tpu.parallel.stream_mesh import (
+        _ShardedStream,
+        host_shard_plan,
+    )
+    from spark_bam_tpu.core.channel import path_size
+
+    plan = host_shard_plan(
+        BAM2, num_hosts=2, devices_per_host=4,
+        window_uncompressed=128 << 10, halo=32 << 10,
+    )
+    assert [p["host"] for p in plan] == [0, 1]
+    st = _ShardedStream(
+        BAM2, Config(), _mesh(), 128 << 10, 32 << 10, None,
+        num_processes=2, process_id=0,
+    )
+    # Group ranges: contiguous, non-overlapping, covering every group.
+    assert plan[0]["groups"][0] == 0
+    assert plan[0]["groups"][1] == plan[1]["groups"][0] == st.per_proc
+    assert plan[1]["groups"][1] == len(st.groups)
+    assert sum(p["uncompressed"] for p in plan) == st.total
+    # Compressed ranges: within the file; host 0's halo overlap reaches
+    # into host 1's range but no further than halo + one block.
+    size = path_size(BAM2)
+    for p in plan:
+        lo, hi = p["compressed_range"]
+        assert 0 <= lo < hi <= size
+    assert plan[0]["compressed_range"][1] > plan[1]["compressed_range"][0]
+
+
+def test_locality_provider_hook():
+    """SplitRDD.preferredLocations analog: a registered provider surfaces
+    hosts per split; unregistered means 'anywhere'."""
+    from spark_bam_tpu.load.splits import (
+        file_splits,
+        preferred_hosts,
+        set_locality_provider,
+    )
+
+    splits = file_splits(BAM2, 256 << 10)
+    assert preferred_hosts(splits[0]) == []
+    try:
+        set_locality_provider(
+            lambda path, start, end: [f"host{start // (256 << 10) % 2}"]
+        )
+        assert preferred_hosts(splits[0]) == ["host0"]
+        assert preferred_hosts(splits[1]) == ["host1"]
+    finally:
+        set_locality_provider(None)
+    assert preferred_hosts(splits[0]) == []
