@@ -23,6 +23,31 @@ def _print_splits(p, splits: list[Split], ratio: float) -> None:
     p.echo("")
 
 
+def print_host_plan(ctx: CheckerContext, num_hosts: int, devices_per_host: int) -> None:
+    """The N-host sharded-run IO plan: per-host compressed byte ranges
+    (incl. halo seam overlap) and owned uncompressed spans — what a
+    scheduler needs to place processes near data (the reference's
+    ``SplitRDD.preferredLocations`` role, SplitRDD.scala:43-79)."""
+    from spark_bam_tpu.core.config import format_bytes
+    from spark_bam_tpu.parallel.stream_mesh import host_shard_plan
+
+    plan = host_shard_plan(
+        ctx.path, num_hosts, devices_per_host, config=ctx.config
+    )
+    p = ctx.printer
+    p.echo(f"{num_hosts}-host plan ({devices_per_host} devices/host):")
+    for row in plan:
+        lo, hi = row["compressed_range"]
+        g0, g1 = row["groups"]
+        p.echo(
+            f"\thost {row['host']}: bytes [{lo}, {hi}) "
+            f"({format_bytes(hi - lo)} read, "
+            f"{format_bytes(row['uncompressed'])} owned uncompressed, "
+            f"rows {g0}-{g1})"
+        )
+    p.echo("")
+
+
 def run(
     ctx: CheckerContext,
     split_size: int,

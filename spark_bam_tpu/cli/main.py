@@ -22,6 +22,13 @@ from spark_bam_tpu.cli.output import UsageError
 from spark_bam_tpu.core.config import Config, parse_bytes
 
 
+def _positive_int(s: str) -> int:
+    v = int(s)
+    if v <= 0:
+        raise argparse.ArgumentTypeError(f"must be a positive integer: {s}")
+    return v
+
+
 def _add_common(sub, split_default=None):
     sub.add_argument("-m", "--max-split-size", default=split_default,
                      help="split size (byte shorthand like 2MB ok)")
@@ -73,6 +80,15 @@ def build_parser() -> argparse.ArgumentParser:
     _add_common(sub)
     sub.add_argument("-s", "--spark-bam", action="store_true")
     sub.add_argument("-u", "--upstream", action="store_true")
+    sub.add_argument(
+        "--plan-hosts", type=_positive_int, default=0, metavar="N",
+        help="also print the N-host sharded-run IO plan (per-host "
+             "compressed byte ranges — the preferredLocations analog)",
+    )
+    sub.add_argument(
+        "--devices-per-host", type=_positive_int, default=8, metavar="D",
+        help="devices per host for --plan-hosts (default 8)",
+    )
     sub.add_argument("path")
 
     sub = sp.add_parser("compare-splits")
@@ -180,6 +196,10 @@ def main(argv=None) -> int:
                     args.spark_bam,
                     args.upstream,
                 )
+                if args.plan_hosts:
+                    compute_splits.print_host_plan(
+                        ctx, args.plan_hosts, args.devices_per_host
+                    )
             elif cmd == "time-load":
                 from spark_bam_tpu.cli import time_load
 

@@ -121,6 +121,11 @@ def _load_native_locked():
         c_i32p, ctypes.c_int32, ctypes.c_int32, ctypes.c_int64,
         ctypes.c_int32, c_i64p,
     ]
+    lib.sbt_eager_check_window.restype = None
+    lib.sbt_eager_check_window.argtypes = [
+        c_u8p, ctypes.c_int64, c_i64p, ctypes.c_int64,
+        c_i32p, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, c_u8p,
+    ]
     lib.sbt_tokenize_deflate.restype = ctypes.c_long
     lib.sbt_tokenize_deflate.argtypes = [
         c_u8p, c_i64p, c_i64p, ctypes.c_int64,
@@ -187,6 +192,34 @@ def find_record_start_native(
             reads_to_check, max_read_size,
         )
     )
+
+
+def eager_check_window_native(
+    buf: np.ndarray,
+    candidates: np.ndarray,
+    contig_lengths: np.ndarray,
+    reads_to_check: int = 10,
+    exact_eof: bool = False,
+) -> np.ndarray | None:
+    """Tri-state verdicts per candidate over a bounded window: 0/1 =
+    certain fail/pass (chain resolved on in-window bytes), 2 = the verdict
+    depended on the window edge (retry with more lookahead). ``None`` if
+    the native library is unavailable."""
+    lib = load_native()
+    if lib is None:
+        return None
+    buf = np.ascontiguousarray(buf, dtype=np.uint8)
+    cand = np.ascontiguousarray(candidates, dtype=np.int64)
+    lens = np.ascontiguousarray(contig_lengths, dtype=np.int32)
+    out = np.zeros(len(cand), dtype=np.uint8)
+    lib.sbt_eager_check_window(
+        _ptr(buf, ctypes.c_uint8), len(buf),
+        _ptr(cand, ctypes.c_int64), len(cand),
+        _ptr(lens, ctypes.c_int32), len(lens),
+        reads_to_check, 1 if exact_eof else 0,
+        _ptr(out, ctypes.c_uint8),
+    )
+    return out
 
 
 def find_record_start_window_native(

@@ -171,6 +171,25 @@ int64_t sbt_find_record_start(
   return -1;
 }
 
+// Tri-state verdicts for `m` candidates over a bounded window: out[i] is
+// 0/1 when the chain resolved on in-window bytes alone (certain — exact
+// regardless of what lies beyond), 2 when the verdict depended on the
+// window edge (caller must retry with more lookahead). exact_eof nonzero
+// = the window end IS the file end (classic semantics, never 2). The
+// streaming deferral path resolves escaped candidates with this instead
+// of re-running a whole-buffer flag pass per window.
+void sbt_eager_check_window(
+    const uint8_t* buf, int64_t n, const int64_t* candidates, int64_t m,
+    const int32_t* contig_lengths, int32_t num_contigs,
+    int32_t reads_to_check, int32_t exact_eof, uint8_t* out) {
+  for (int64_t i = 0; i < m; ++i) {
+    int touched = 0;
+    int ok = eager_ok_ex(buf, n, candidates[i], contig_lengths, num_contigs,
+                         reads_to_check, &touched);
+    out[i] = (touched && !exact_eof) ? (uint8_t)2 : (uint8_t)ok;
+  }
+}
+
 // Tri-state scan for bounded windows whose end is NOT the file's EOF
 // (split-boundary resolution over a partial inflate — load/api.py).
 // Returns the first position in [start, start+max_read_size) ∩ [0, n)

@@ -72,6 +72,42 @@ from spark_bam_tpu.tpu.stream_check import (
 )
 
 
+def _plan_rows(metas: list, fresh: int, n_global: int, num_processes: int):
+    """The row-planning arithmetic shared by the sharded engine and
+    ``host_shard_plan`` (one implementation — a scheduler plan must match
+    what the engine actually reads BY CONSTRUCTION): block groups, each
+    group's first block index and uncompressed size/flat start, and the
+    per-process row count (global rows padded to a multiple of the device
+    count so every process loops identical step counts)."""
+    groups = window_plan(metas, fresh)
+    sizes = np.array(
+        [sum(m.uncompressed_size for m in g) for g in groups], dtype=np.int64
+    )
+    flat_starts = np.zeros(len(groups), dtype=np.int64)
+    first_block = np.zeros(len(groups), dtype=np.int64)
+    if len(groups):
+        np.cumsum(sizes[:-1], out=flat_starts[1:])
+        np.cumsum([len(g) for g in groups[:-1]], out=first_block[1:])
+    n_rows = -(-max(len(groups), 1) // n_global) * n_global
+    per_proc = n_rows // num_processes
+    return groups, sizes, flat_starts, first_block, per_proc
+
+
+def _halo_block_range(
+    metas: list, groups: list, first_block, g0: int, g1: int, halo: int
+) -> tuple[int, int]:
+    """Block index range [b0, b1) covering groups [g0, g1) plus trailing
+    blocks until ≥ ``halo`` lookahead bytes — the engine's row extension
+    and the plan's per-host read range, one implementation."""
+    b0 = int(first_block[g0])
+    b1 = b0 + sum(len(groups[g]) for g in range(g0, g1))
+    extra = 0
+    while b1 < len(metas) and extra < halo:
+        extra += metas[b1].uncompressed_size
+        b1 += 1
+    return b0, b1
+
+
 class _ShardedStream:
     """Shared plumbing: plan the block groups, assemble this process's row
     slice into mesh-wide batches (double-buffered), build sharded args."""
@@ -109,19 +145,10 @@ class _ShardedStream:
         halo = config.halo_size if halo is None else halo
         self.halo = min(halo, self.fresh // 2)
         self.metas = list(blocks_metadata(path)) if metas is None else metas
-        self.groups = window_plan(self.metas, self.fresh)
-        self.sizes = np.array(
-            [sum(m.uncompressed_size for m in g) for g in self.groups],
-            dtype=np.int64,
-        )
-        self.flat_starts = np.zeros(len(self.groups), dtype=np.int64)
-        if len(self.groups):
-            np.cumsum(self.sizes[:-1], out=self.flat_starts[1:])
-        self.first_block = np.zeros(len(self.groups), dtype=np.int64)
-        if len(self.groups):
-            np.cumsum(
-                [len(g) for g in self.groups[:-1]], out=self.first_block[1:]
-            )
+        (
+            self.groups, self.sizes, self.flat_starts, self.first_block,
+            self.per_proc,
+        ) = _plan_rows(self.metas, self.fresh, self.n_global, num_processes)
         self.total = int(self.sizes.sum())
         # Row buffer bound: owned span (≤ fresh, or one oversized block) +
         # halo + ≤ one block of halo-extension overshoot.
@@ -131,10 +158,6 @@ class _ShardedStream:
         )
         self.device_inflate = resolve_device_inflate(config)
 
-        # Global rows padded so every process loops identical step counts
-        # with identical shapes (the collective's requirement).
-        n_rows = -(-max(len(self.groups), 1) // self.n_global) * self.n_global
-        self.per_proc = n_rows // num_processes
         n_local = self.n_global // num_processes
         kw = self.kernel_window
         self.step_rows_local = n_local * max(
@@ -152,12 +175,9 @@ class _ShardedStream:
     # ------------------------------------------------------------- assembly
     def _row(self, ch, g: int):
         """Inflate global row ``g``: returns (buf, n, at_eof, own, base)."""
-        b0 = int(self.first_block[g])
-        b1 = b0 + len(self.groups[g])
-        extra = 0
-        while b1 < len(self.metas) and extra < self.halo:
-            extra += self.metas[b1].uncompressed_size
-            b1 += 1
+        b0, b1 = _halo_block_range(
+            self.metas, self.groups, self.first_block, g, g + 1, self.halo
+        )
         run = self.metas[b0:b1]
         view = None
         if self.device_inflate:
@@ -336,14 +356,12 @@ def host_shard_plan(
     h = config.halo_size if halo is None else halo
     h = min(h, fresh // 2)
     metas = list(blocks_metadata(path)) if metas is None else metas
-    groups = window_plan(metas, fresh)
-    first_block = np.zeros(len(groups), dtype=np.int64)
-    if len(groups):
-        np.cumsum([len(g) for g in groups[:-1]], out=first_block[1:])
-    sizes = [sum(m.uncompressed_size for m in g) for g in groups]
     n_global = num_hosts * devices_per_host
-    n_rows = -(-max(len(groups), 1) // n_global) * n_global
-    per_proc = n_rows // num_hosts
+    # The engine's own planning arithmetic (_plan_rows/_halo_block_range):
+    # the plan matches what the engine reads by construction.
+    groups, sizes, _flat_starts, first_block, per_proc = _plan_rows(
+        metas, fresh, n_global, num_hosts
+    )
 
     plan = []
     for p in range(num_hosts):
@@ -355,20 +373,14 @@ def host_shard_plan(
                 "compressed_range": (0, 0), "uncompressed": 0,
             })
             continue
-        b0 = int(first_block[g0])
-        b1 = b0 + sum(len(groups[g]) for g in range(g0, g1))
-        # Trailing halo overlap: the last owned row reads past its span.
-        extra = 0
-        while b1 < len(metas) and extra < h:
-            extra += metas[b1].uncompressed_size
-            b1 += 1
+        b0, b1 = _halo_block_range(metas, groups, first_block, g0, g1, h)
         lo = metas[b0].start
         hi = metas[b1 - 1].start + metas[b1 - 1].compressed_size
         plan.append({
             "host": p,
             "groups": (g0, g1),
             "compressed_range": (int(lo), int(hi)),
-            "uncompressed": int(sum(sizes[g0:g1])),
+            "uncompressed": int(sizes[g0:g1].sum()),
         })
     return plan
 

@@ -288,6 +288,19 @@ class StreamChecker:
                 self.buf = win_buf[self.base - win_base:].copy()
             self.pending = np.concatenate([self.pending, positions])
 
+        def _retire(self, done: np.ndarray) -> np.ndarray:
+            """Drop resolved pendings; trim the buffer to the earliest
+            survivor. Returns the retired positions."""
+            positions = self.pending[done]
+            self.pending = self.pending[~done]
+            if not len(self.pending):
+                self.buf = np.empty(0, dtype=np.uint8)
+            else:
+                lo = int(self.pending.min())
+                self.buf = self.buf[lo - self.base:]
+                self.base = lo
+            return positions
+
         def _resolve_chains(self, at_eof: bool):
             """One sequential-exact pass over pendings; returns (positions
             resolved, their ChainResult rows) and retires them.
@@ -303,27 +316,43 @@ class StreamChecker:
                 at_eof=at_eof, reads_to_check=self.rtc,
             )
             done = (~res.escaped) & res.exact
-            positions = self.pending[done]
-            self.pending = self.pending[~done]
-            if not len(self.pending):
-                self.buf = np.empty(0, dtype=np.uint8)
-            else:
-                lo = int(self.pending.min())
-                self.buf = self.buf[lo - self.base:]
-                self.base = lo
-            return positions, res, done
+            return self._retire(done), res, done
 
-        def resolve(self, at_eof: bool):
+        def resolve(self, at_eof: bool, fields: tuple[str, ...]):
             """Re-check pendings against the grown stream; yield
-            ``(pos, chain_result, row)`` for each one now fully resolved —
-            callers project whichever ChainResult fields they stream."""
+            ``(pos, row)`` — ``row`` holds a length-1 array per projected
+            field — for each pending now resolved with certainty.
+
+            The verdict-only projection (spans/count) resolves through the
+            native tri-state chain walk when built: it touches only the
+            ~``reads_to_check`` records each chain actually visits, where
+            the NumPy engine recomputes a whole-buffer flag pass per window
+            (the dominant cost of long-read streaming before this — the
+            flag projections still use it, their masks need the full
+            pass)."""
             if not len(self.pending):
                 return
+            if fields == ("verdict",):
+                from spark_bam_tpu.native.build import eager_check_window_native
+
+                tri = eager_check_window_native(
+                    self.buf, self.pending - self.base, self.lengths,
+                    reads_to_check=self.rtc, exact_eof=at_eof,
+                )
+                if tri is not None:
+                    positions = self._retire(tri != 2)
+                    for pos, v in zip(
+                        positions.tolist(), tri[tri != 2].tolist()
+                    ):
+                        yield int(pos), (np.array([v == 1], dtype=bool),)
+                    return
             positions, res, done = self._resolve_chains(at_eof)
             for pos, k in zip(
                 positions.tolist(), np.flatnonzero(done).tolist()
             ):
-                yield int(pos), res, int(k)
+                yield int(pos), tuple(
+                    np.asarray(getattr(res, f))[k: k + 1] for f in fields
+                )
 
     # ------------------------------------------------------------- consumers
     def _stream(
@@ -353,11 +382,7 @@ class StreamChecker:
                     s[bad_idx] = 0  # re-emitted by the deferral path
                 deferred.add(base + bad_idx, buf, base)
             yield (base, *spans, buf) if with_buf else (base, *spans)
-            for pos, chain_res, k in deferred.resolve(at_eof):
-                row = tuple(
-                    np.asarray(getattr(chain_res, f))[k: k + 1]
-                    for f in fields
-                )
+            for pos, row in deferred.resolve(at_eof, fields):
                 yield (pos, *row, None) if with_buf else (pos, *row)
             windows += 1
             if self.progress is not None:
@@ -408,6 +433,15 @@ class StreamChecker:
             chunk += 1
             if self.progress is not None:
                 self.progress(windows, base + own_end, self.total)
+            # One early escape checkpoint (window 4): escape-prone inputs
+            # (ultra-long reads vs this halo) abort to the exact path after
+            # ~4 windows instead of after a whole flush interval (up to
+            # 2^30 positions of doomed device work). Costs a single extra
+            # device sync per file; the steady-state policy stays
+            # flush-aligned so tunnelled devices aren't synced per window.
+            if windows == 4 and int(dev_esc):
+                escaped = True
+                break
             if chunk >= flush_every:
                 # Escape checkpoint rides the flush: abort to the exact
                 # path early instead of finishing a doomed device pass.

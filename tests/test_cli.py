@@ -83,6 +83,23 @@ def test_compute_splits_eager_230k(bam1, tmp_path):
     ]
 
 
+def test_compute_splits_host_plan(bam1, tmp_path, monkeypatch):
+    """--plan-hosts renders the per-host sharded-run IO plan (byte ranges
+    partitioning the file with a halo seam overlap)."""
+    monkeypatch.setenv("SPARK_BAM_WINDOW_SIZE", "256KB")
+    monkeypatch.setenv("SPARK_BAM_HALO_SIZE", "64KB")
+    got = run_cli(
+        ["compute-splits", "-s", "-m", "230k", "--plan-hosts", "2",
+         "--devices-per-host", "4", str(bam1)],
+        tmp_path,
+    )
+    assert "2-host plan (4 devices/host):" in got
+    lines = [l for l in got.splitlines() if l.startswith("\thost ")]
+    assert len(lines) == 2
+    assert lines[0].startswith("\thost 0: bytes [0, ")
+    assert "owned uncompressed" in lines[0]
+
+
 def test_compute_splits_seqdoop_230k(bam1, tmp_path):
     got = run_cli(["compute-splits", "-u", "-m", "230k", str(bam1)], tmp_path)
     lines = got.splitlines()

@@ -108,3 +108,38 @@ def test_window_scan_exact_eof_matches_classic(native, bam2):
         )
         assert uncertain_at == -1
         assert found == classic
+
+
+def test_eager_check_window_certain_verdicts_are_truth(native, bam1):
+    """The deferral resolver's safety property: any verdict the tri-state
+    candidate checker marks *certain* on a truncated buffer must equal the
+    full-file truth at that position; uncertain (2) positions are exactly
+    the ones it may not judge yet."""
+    from spark_bam_tpu.native.build import eager_check_window_native
+
+    flat = flatten_file(bam1)
+    lens = np.array(contig_lengths(bam1).lengths_list(), dtype=np.int32)
+    truth = eager_check_native(
+        flat.data, np.arange(flat.size, dtype=np.int64), lens
+    )
+    rng = np.random.default_rng(31)
+    for _ in range(60):
+        cut = int(rng.integers(1 << 10, flat.size))
+        cand = np.unique(rng.integers(0, cut, 200))
+        tri = eager_check_window_native(
+            flat.data[:cut], cand, lens, exact_eof=False
+        )
+        certain = tri != 2
+        np.testing.assert_array_equal(
+            tri[certain].astype(bool), truth[cand[certain]].astype(bool)
+        )
+    # exact_eof: never uncertain, classic semantics on the real tail.
+    tri = eager_check_window_native(
+        flat.data, np.arange(0, flat.size, 997, dtype=np.int64), lens,
+        exact_eof=True,
+    )
+    assert (tri != 2).all()
+    np.testing.assert_array_equal(
+        tri.astype(bool),
+        truth[np.arange(0, flat.size, 997)].astype(bool),
+    )
