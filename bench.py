@@ -168,7 +168,8 @@ def _child_device_all(window_mb: int, platform: str, iters: int,
     # inflate (config auto default); the quick leg runs host inflate (the
     # r3-proven configuration) so the guaranteed artifact takes no new risk.
     prod_device_inflate = backend != "cpu" and _device_inflate_available()
-    if quick_path:
+
+    def run_quick_leg():
         try:
             _run_e2e_once(
                 window_mb, quick_path, quick_reads, backend,
@@ -178,6 +179,13 @@ def _child_device_all(window_mb: int, platform: str, iters: int,
             _emit_stage(
                 "e2e_quick_error:" + f"{type(e).__name__}: {e}"[:200].replace("\n", " ")
             )
+
+    # On a device backend the quick leg leads (guaranteed artifact before
+    # anything can burn the window). On the CPU fallback the steady kernel
+    # IS the guarantee — the quick leg (∼100× slower there, unguarded by
+    # the projection abort) runs after it, below.
+    if quick_path and backend != "cpu":
+        run_quick_leg()
     big_metas = None
     quiet_pipeline = False
     if big_path and backend != "cpu":
@@ -277,6 +285,9 @@ def _child_device_all(window_mb: int, platform: str, iters: int,
         "backend": backend,
         "window_mb": window_mb,
     })
+
+    if quick_path and backend == "cpu":
+        run_quick_leg()
 
     # ---- e2e A/B leg: the 1 GB file in the OTHER inflate mode (host zlib
     # when the production default was device inflate, and vice versa) — the
@@ -1080,10 +1091,12 @@ def _main_measure(record, warnings, errors):
     steady = results.get("steady")
     if not results:
         # Last resort: the same kernel on the CPU backend — a real number
-        # with the failure recorded, never a blank. (No e2e: the CPU-backend
-        # kernel would take hours on 1 GB.)
+        # with the failure recorded, never a blank. No BIG e2e (the
+        # CPU-backend kernel would take hours on 1 GB), but the quick leg
+        # is affordable and keeps whole-pipeline evidence in the artifact.
         results, stages, err = _run_child(
-            ["--child-all", "8", "cpu", "3", "", "0", "", "0"],
+            ["--child-all", "8", "cpu", "3", "", "0",
+             quick_path, str(quick_manifest["reads"] if quick_manifest else 0)],
             CHILD_TIMEOUT_S,
         )
         steady = results.get("steady")
@@ -1151,6 +1164,14 @@ def _main_measure(record, warnings, errors):
         record["e2e_quick_pps"] = round(e2e_quick["pps"])
         record["e2e_quick_count_ok"] = e2e_quick["count_ok"]
         record["e2e_quick_file_bytes"] = e2e_quick["file_bytes"]
+    elif quick_path and results:
+        # The quick leg was dispatched but produced no artifact — surface
+        # the child's stage marker instead of dropping it silently.
+        detail = next(
+            (s for s in stages if s.startswith("e2e_quick_error:")),
+            "no e2e_quick result (child killed mid-leg?)",
+        )
+        warnings.append(f"quick e2e leg missing: {detail}")
 
     # Headline: the e2e number IS the metric on device runs (the north star
     # is vs_baseline(e2e) ≥ 10× the native CPU eager kernel). Prefer the
