@@ -109,14 +109,17 @@ def _render_report(p, crit_idx, crit_masks, two_idx, two_masks,
     p.echo("")
 
 
-def run_streaming(ctx: CheckerContext) -> None:
+def run_streaming(ctx: CheckerContext, sharded: bool = False) -> None:
     """The WGS-scale face: same aggregations via ``full_spans`` in
     O(window) host memory. Mask-derived sections render through the same
     code as the in-memory report (byte-identical); position lists print
     as ``block:offset`` without the record annotations (those need
     per-hit record decodes, which the default in-memory path provides).
     The device/NumPy engine choice honors ``spark.bam.backend`` through
-    the same hang-proof probe as the in-memory path."""
+    the same hang-proof probe as the in-memory path. ``sharded`` runs the
+    scan across every device on the mesh
+    (``parallel.stream_mesh.full_check_summary_sharded`` — identical
+    output; deferred lanes fall back to this single-device path)."""
     from spark_bam_tpu.bgzf.flat import metas_block_table, pos_of_flat_tables
     from spark_bam_tpu.bgzf.index_blocks import blocks_metadata
     from spark_bam_tpu.cli.output import UsageError
@@ -131,13 +134,24 @@ def run_streaming(ctx: CheckerContext) -> None:
 
     p = ctx.printer
     metas = list(blocks_metadata(ctx.path))  # one scan: summary + pos tables
+    mode = "--streaming --sharded" if sharded else "--streaming"
     with heartbeat_progress(
-        f"full-check --streaming {ctx.path}", unit="window"
+        f"full-check {mode} {ctx.path}", unit="window"
     ) as progress:
-        s = full_check_summary_streaming(
-            ctx.path, ctx.config, use_device=ctx._use_tpu_backend(),
-            metas=metas, progress=progress,
-        )
+        if sharded:
+            from spark_bam_tpu.parallel.stream_mesh import (
+                full_check_summary_sharded,
+            )
+
+            s = full_check_summary_sharded(
+                ctx.path, ctx.config, metas=metas, progress=progress,
+                fallback_use_device=ctx._use_tpu_backend(),
+            )
+        else:
+            s = full_check_summary_streaming(
+                ctx.path, ctx.config, use_device=ctx._use_tpu_backend(),
+                metas=metas, progress=progress,
+            )
     block_starts, block_flat = metas_block_table(metas)
 
     def pos_str(i: int) -> str:

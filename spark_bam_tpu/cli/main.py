@@ -74,6 +74,11 @@ def build_parser() -> argparse.ArgumentParser:
         help="WGS-scale O(window)-memory scan; mask-derived sections match"
              " the default report, position lists print unannotated",
     )
+    sub.add_argument(
+        "--sharded", action="store_true",
+        help="with --streaming: run the scan across every device on the "
+             "mesh (flag totals psum'd over ICI)",
+    )
     sub.add_argument("path")
 
     sub = sp.add_parser("compute-splits")
@@ -183,8 +188,13 @@ def main(argv=None) -> int:
             elif cmd == "full-check":
                 from spark_bam_tpu.cli import full_check
 
+                if args.sharded and not args.streaming:
+                    raise UsageError(
+                        "full-check --sharded requires --streaming (the "
+                        "in-memory report has no mesh mode)"
+                    )
                 if args.streaming:
-                    full_check.run_streaming(ctx)
+                    full_check.run_streaming(ctx, sharded=args.sharded)
                 else:
                     full_check.run(ctx)
             elif cmd == "compute-splits":

@@ -299,6 +299,105 @@ def make_shard_map_confusion_step(
     )
 
 
+def make_shard_map_full_step(
+    mesh: Mesh, reads_to_check: int = 10, axis: str = "data",
+    flags_impl: str = "xla", k_positions: int = 4096,
+):
+    """Sharded full-check step (the third mesh workload, after count-reads
+    and check-bam): every owned position's 19-flag mask, reduced to the
+    FullCheck report's aggregations (reference FullCheck.scala:112-417)
+    in one mesh-partitioned unit.
+
+    Returns ``(totals, crit_idx, crit_mask, two_idx, two_mask)``:
+
+    - ``totals`` (replicated, ``psum`` over ICI): ``[passes, bare_eof,
+      crit_ct, two_ct, defer_ct, per_flag[0..18]]``. ``passes`` (mask==0
+      record starts) and ``bare_eof`` (the lone at-EOF marker rule) let
+      the caller derive the position-scale ``considered`` total from its
+      owned spans without a position-scale device counter. The per-flag
+      counts ARE position-scale per step — int32 stays safe because one
+      step's positions are bounded by the host chunk budget (≪ 2^31);
+      callers accumulate across steps in int64.
+    - ``crit_idx``/``crit_mask`` (row-sharded, (B, K)): per-row compacted
+      window-relative positions (fill −1) and masks where exactly one
+      check failed — the report's "critical" sites; ``two_*`` likewise
+      for two-check sites. A row with more than K sites under-reports the
+      compaction vs its count — callers detect the mismatch and fall back
+      to the exact single-device path (same policy as escapes).
+    - ``defer_ct``: owned lanes whose masks are not yet exact (escaped or
+      edge-inexact — the lanes the streaming engine defers); any nonzero
+      means the device pass must be abandoned for the deferral-exact path.
+    """
+    from spark_bam_tpu.check.flags import BIT, FLAG_NAMES
+
+    shard_map = _shard_map_compat()
+    bit0 = int(BIT["tooFewFixedBlockBytes"])
+    n_flags = len(FLAG_NAMES)
+    pallas_interpret = (
+        flags_impl == "pallas"
+        and mesh.devices.flat[0].platform != "tpu"
+    )
+
+    def one(window, n, at_eof, lo, own, lengths, num_contigs):
+        res = check_window(
+            window, lengths, num_contigs, n, at_eof,
+            reads_to_check=reads_to_check, flags_impl=flags_impl,
+            pallas_interpret=pallas_interpret,
+        )
+        w = window.shape[0] - PAD
+        i = jnp.arange(w, dtype=jnp.int32)
+        m = (i >= lo) & (i < own)
+        fm = jnp.where(m, res["fail_mask"], 0)
+        rb = jnp.where(m, res["reads_before"], 0)
+        passes = jnp.sum((m & (fm == 0)).astype(jnp.int32))
+        bare_eof = jnp.sum((m & (fm == bit0) & (rb == 0)).astype(jnp.int32))
+        considered = m & (fm != 0) & ~((fm == bit0) & (rb == 0))
+        pop = jnp.zeros_like(fm)
+        for b in range(n_flags):
+            pop = pop + ((fm >> b) & 1)
+        nf = pop + (rb > 0).astype(jnp.int32)
+        crit = considered & (nf == 1)
+        two = considered & (nf == 2)
+        defer = m & (res["escaped"] | ~res["exact"])
+        per_flag = jnp.stack([
+            jnp.sum((considered & (((fm >> b) & 1) == 1)).astype(jnp.int32))
+            for b in range(n_flags)
+        ])
+        head = jnp.stack([
+            passes,
+            bare_eof,
+            jnp.sum(crit.astype(jnp.int32)),
+            jnp.sum(two.astype(jnp.int32)),
+            jnp.sum(defer.astype(jnp.int32)),
+        ])
+        (crit_idx,) = jnp.nonzero(crit, size=k_positions, fill_value=-1)
+        (two_idx,) = jnp.nonzero(two, size=k_positions, fill_value=-1)
+        crit_mask = jnp.where(crit_idx >= 0, fm[jnp.clip(crit_idx, 0)], 0)
+        two_mask = jnp.where(two_idx >= 0, fm[jnp.clip(two_idx, 0)], 0)
+        return (
+            jnp.concatenate([head, per_flag]),
+            crit_idx.astype(jnp.int32), crit_mask,
+            two_idx.astype(jnp.int32), two_mask,
+        )
+
+    def local_step(windows, ns, at_eofs, los, owns, lengths, nc):
+        stats, ci, cm, ti, tm = jax.vmap(
+            lambda wd, n, e, lo, ow: one(wd, n, e, lo, ow, lengths, nc)
+        )(windows, ns, at_eofs, los, owns)
+        totals = jax.lax.psum(jnp.sum(stats, axis=0), axis)  # ← ICI
+        return totals, ci, cm, ti, tm
+
+    return jax.jit(
+        shard_map(
+            local_step,
+            mesh=mesh,
+            in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis), P(), P()),
+            out_specs=(P(), P(axis), P(axis), P(axis), P(axis)),
+            check_rep=False,
+        )
+    )
+
+
 def batch_windows(
     buf: np.ndarray,
     window: int,

@@ -222,7 +222,9 @@ class _ShardedStream:
         return ws, ns, eofs, los, owns, truth
 
     def batches(self, header_clamp: bool, fill_row=None):
-        """Yield ``(sharded_args, positions_done)`` per step, assembling the
+        """Yield ``(sharded_args, positions_done, c0)`` per step (``c0`` =
+        the step's first process-local row index — row ``j`` of the step is
+        global group ``process_id * per_proc + c0 + j``), assembling the
         next step's rows while the caller's device work runs (one step of
         lookahead — the double-buffering the single-host pipeline had)."""
         if not self.per_proc:
@@ -246,7 +248,7 @@ class _ShardedStream:
                     len(self.groups),
                 ) - 1
                 done = int(self.flat_starts[g_hi] + self.sizes[g_hi])
-                yield self._sharded_args(arrays), done
+                yield self._sharded_args(arrays), done, c0
 
     def _sharded_args(self, arrays):
         ws, ns, eofs, los, owns, truth = arrays
@@ -297,7 +299,7 @@ def count_reads_sharded(
     # reopens the file.
     batches = st.batches(header_clamp=True)
     try:
-        for args, done in batches:
+        for args, done, _c0 in batches:
             totals = np.asarray(step(*args))
             count += int(totals[0])
             escapes += int(totals[1])
@@ -325,6 +327,122 @@ def count_reads_sharded(
             metas=st.metas,
         ).count_reads()
     return count
+
+
+def full_check_summary_sharded(
+    path,
+    config: Config = Config(),
+    mesh=None,
+    window_uncompressed: int | None = None,
+    halo: int | None = None,
+    metas: list | None = None,
+    progress: Callable[[int, int, int], None] | None = None,
+    k_positions: int = 4096,
+    fallback_use_device: bool = True,
+) -> dict:
+    """The full-check workload's aggregations across the mesh — the third
+    sharded workload (reference FullCheck.scala:112-417 as a Spark job;
+    here one ``shard_map`` step per row batch): per-flag totals,
+    considered-position count, and the critical / two-check sites with
+    their masks. Same return shape as
+    ``tpu.stream_check.full_check_summary_streaming`` plus ``devices``.
+
+    Exactness policy mirrors the other sharded workloads: any deferred
+    lane (escaped or edge-inexact mask) or a per-row compaction overflow
+    (> ``k_positions`` sites in one row) abandons the device pass and the
+    file re-runs through the single-device deferral-exact streaming
+    summary (``devices`` = 1 then; ``fallback_use_device`` selects its
+    engine — the CLI passes its hang-proof backend probe's verdict).
+    Single-process only (the compacted site arrays are row-sharded device
+    outputs; multi-host full-check would need an all-gather of variable
+    site lists)."""
+    from spark_bam_tpu.check.flags import FLAG_NAMES
+    from spark_bam_tpu.parallel.mesh import make_shard_map_full_step
+
+    if jax.process_count() > 1:
+        raise NotImplementedError(
+            "full_check_summary_sharded is single-process only (row-sharded "
+            "site outputs are not multi-host addressable); run it on one "
+            "host or use the single-device streaming summary"
+        )
+    st = _ShardedStream(
+        path, config, mesh, window_uncompressed, halo, metas
+    )
+    step = make_shard_map_full_step(
+        st.mesh, reads_to_check=config.reads_to_check, axis=st.axis,
+        flags_impl=config.flags_impl, k_positions=k_positions,
+    )
+    n_flags = len(FLAG_NAMES)
+    agg = np.zeros(5 + n_flags, dtype=np.int64)
+    crit_pos: list[np.ndarray] = []
+    crit_mask: list[np.ndarray] = []
+    two_pos: list[np.ndarray] = []
+    two_mask: list[np.ndarray] = []
+    fallback = False
+    steps = 0
+    batches = st.batches(header_clamp=False)
+    try:
+        for args, done, c0 in batches:
+            totals, ci, cm, ti, tm = step(*args)
+            totals = np.asarray(totals).astype(np.int64)
+            agg += totals
+            steps += 1
+            if totals[4]:  # deferred lanes: device masks not exact
+                fallback = True
+                break
+            ci, cm, ti, tm = (np.asarray(a) for a in (ci, cm, ti, tm))
+            for j in range(ci.shape[0]):
+                g = c0 + j
+                if g >= len(st.groups):
+                    continue  # padding row: no sites by construction
+                base = int(st.flat_starts[g])
+                for idx, masks, acc_p, acc_m in (
+                    (ci[j], cm[j], crit_pos, crit_mask),
+                    (ti[j], tm[j], two_pos, two_mask),
+                ):
+                    sel = idx >= 0
+                    if sel.any():
+                        acc_p.append(base + idx[sel].astype(np.int64))
+                        acc_m.append(masks[sel].astype(np.int32))
+            if progress is not None:
+                progress(steps, done, st.total)
+    finally:
+        batches.close()
+
+    n_crit = sum(map(len, crit_pos))
+    n_two = sum(map(len, two_pos))
+    if not fallback and (n_crit != int(agg[2]) or n_two != int(agg[3])):
+        fallback = True  # a row overflowed the compaction buffer
+    if fallback:
+        from spark_bam_tpu.tpu.stream_check import (
+            full_check_summary_streaming,
+        )
+
+        out = full_check_summary_streaming(
+            path, config, window_uncompressed=st.fresh, halo=st.halo,
+            use_device=fallback_use_device, metas=st.metas,
+        )
+        out["devices"] = 1
+        return out
+
+    def cat(parts, dtype):
+        return np.concatenate(parts) if parts else np.empty(0, dtype=dtype)
+
+    return {
+        "per_flag": {
+            name: int(agg[5 + i]) for i, name in enumerate(FLAG_NAMES)
+        },
+        # passes (mask==0) and the bare at-EOF markers are the only owned
+        # positions NOT considered; the total is host-derived so no
+        # position-scale counter rides the collective.
+        "considered": st.total - int(agg[0]) - int(agg[1]),
+        "critical_positions": cat(crit_pos, np.int64),
+        "critical_masks": cat(crit_mask, np.int32),
+        "two_check_positions": cat(two_pos, np.int64),
+        "two_check_masks": cat(two_mask, np.int32),
+        "positions": st.total,
+        "devices": st.n_global,
+    }
 
 
 def host_shard_plan(
@@ -455,7 +573,7 @@ def check_bam_sharded(
     steps = 0
     batches = st.batches(header_clamp=False, fill_row=fill_row)
     try:
-        for args, done in batches:
+        for args, done, _c0 in batches:
             agg += np.asarray(step(*args), dtype=np.int64)
             steps += 1
             if progress is not None:

@@ -269,3 +269,72 @@ def test_locality_provider_hook():
     finally:
         set_locality_provider(None)
     assert preferred_hosts(splits[0]) == []
+
+
+def test_full_check_sharded_matches_streaming():
+    """The third mesh workload: full-check aggregations across the mesh
+    must equal the single-device streaming summary exactly — per-flag
+    totals, considered count, and every critical/two-check site+mask."""
+    import numpy as np
+
+    from spark_bam_tpu.parallel.stream_mesh import full_check_summary_sharded
+    from spark_bam_tpu.tpu.stream_check import full_check_summary_streaming
+
+    a = full_check_summary_sharded(
+        BAM2, Config(), mesh=_mesh(),
+        window_uncompressed=256 << 10, halo=64 << 10,
+    )
+    b = full_check_summary_streaming(
+        BAM2, Config(), window_uncompressed=256 << 10, halo=64 << 10,
+    )
+    assert a.pop("devices") == 8
+    assert a["per_flag"] == b["per_flag"]
+    assert a["considered"] == b["considered"]
+    assert a["positions"] == b["positions"]
+    for key in (
+        "critical_positions", "critical_masks",
+        "two_check_positions", "two_check_masks",
+    ):
+        np.testing.assert_array_equal(a[key], b[key])
+
+
+def test_full_check_sharded_defer_falls_back_exact(longread_bam):
+    """Ultra records force deferred lanes: the sharded pass must abandon
+    the device run and the single-device deferral-exact summary must come
+    back (devices == 1), still matching a direct streaming run."""
+    from spark_bam_tpu.parallel.stream_mesh import full_check_summary_sharded
+    from spark_bam_tpu.tpu.stream_check import full_check_summary_streaming
+
+    path, _ = longread_bam
+    a = full_check_summary_sharded(
+        path, Config(), mesh=_mesh(),
+        window_uncompressed=1 << 20, halo=256 << 10,
+    )
+    assert a.pop("devices") == 1
+    b = full_check_summary_streaming(
+        path, Config(), window_uncompressed=1 << 20, halo=256 << 10,
+    )
+    assert a["per_flag"] == b["per_flag"]
+    assert a["considered"] == b["considered"]
+
+
+def test_full_check_sharded_compaction_overflow_falls_back():
+    """A 16-site compaction buffer overflows on 2.bam's thousands of
+    two-check sites: the mismatch must be detected and the exact fallback
+    must deliver the full site lists anyway."""
+    import numpy as np
+
+    from spark_bam_tpu.parallel.stream_mesh import full_check_summary_sharded
+    from spark_bam_tpu.tpu.stream_check import full_check_summary_streaming
+
+    a = full_check_summary_sharded(
+        BAM2, Config(), mesh=_mesh(),
+        window_uncompressed=256 << 10, halo=64 << 10, k_positions=16,
+    )
+    assert a.pop("devices") == 1  # overflow → exact fallback
+    b = full_check_summary_streaming(
+        BAM2, Config(), window_uncompressed=256 << 10, halo=64 << 10,
+    )
+    np.testing.assert_array_equal(
+        a["two_check_positions"], b["two_check_positions"]
+    )
