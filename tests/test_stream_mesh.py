@@ -338,3 +338,39 @@ def test_full_check_sharded_compaction_overflow_falls_back():
     np.testing.assert_array_equal(
         a["two_check_positions"], b["two_check_positions"]
     )
+
+
+def test_full_check_sharded_matches_streaming_fuzz(tmp_path):
+    """Randomized differential for the mesh full-check: generated BAMs
+    (varied record shapes, unmapped rates, block sizes) must produce
+    identical aggregations through the sharded and single-device paths —
+    catches derivation edges (bare-EOF rule, considered arithmetic) the
+    fixtures might not cover."""
+    import numpy as np
+
+    from bam_factories import random_bam
+    from spark_bam_tpu.parallel.stream_mesh import full_check_summary_sharded
+    from spark_bam_tpu.tpu.stream_check import full_check_summary_streaming
+
+    for seed in (3, 11):
+        p = tmp_path / f"fz{seed}.bam"
+        random_bam(
+            p, seed=seed, n_records=(200, 400), read_len=(10, 6000),
+            mapped_rate=0.7,
+        )
+        a = full_check_summary_sharded(
+            str(p), Config(), mesh=_mesh(),
+            window_uncompressed=128 << 10, halo=32 << 10,
+        )
+        b = full_check_summary_streaming(
+            str(p), Config(), window_uncompressed=128 << 10, halo=32 << 10,
+        )
+        a.pop("devices")
+        assert a["per_flag"] == b["per_flag"], seed
+        assert a["considered"] == b["considered"], seed
+        assert a["positions"] == b["positions"], seed
+        for key in (
+            "critical_positions", "critical_masks",
+            "two_check_positions", "two_check_masks",
+        ):
+            np.testing.assert_array_equal(a[key], b[key], err_msg=str(seed))
