@@ -352,6 +352,43 @@ def _child_device_all(window_mb: int, platform: str, iters: int,
             _emit_stage(
                 "sharded_error:" + f"{type(e).__name__}: {e}"[:300].replace("\n", " ")
             )
+        # Third sharded workload on the real chip (fixture-sized, tail
+        # zone): mesh full-check totals must equal the single-device
+        # streaming summary.
+        try:
+            from spark_bam_tpu.parallel.stream_mesh import (
+                full_check_summary_sharded,
+            )
+            from spark_bam_tpu.tpu.stream_check import (
+                full_check_summary_streaming,
+            )
+
+            t0 = time.perf_counter()
+            fstats = {}
+            fa = full_check_summary_sharded(FIXTURE, _Cfg(), stats_out=fstats)
+            fb = full_check_summary_streaming(FIXTURE, _Cfg())
+            _emit_result("full_check_smoke", {
+                # ok requires the MESH pass itself to have produced the
+                # summary (a silent fallback to the single-device path
+                # compared against itself proves nothing — same policy as
+                # sharded_smoke above).
+                "ok": (
+                    not fstats.get("fallback")
+                    and fa["per_flag"] == fb["per_flag"]
+                    and fa["considered"] == fb["considered"]
+                ),
+                "fallback": bool(fstats.get("fallback")),
+                "considered": int(fa["considered"]),
+                "devices": int(fa["devices"]),
+                "wall_s": round(time.perf_counter() - t0, 2),
+                "backend": backend,
+            })
+            _emit_stage("full_check_done")
+        except Exception as e:
+            _emit_stage(
+                "full_check_error:"
+                + f"{type(e).__name__}: {e}"[:300].replace("\n", " ")
+            )
 
     # ---- Pallas on-TPU probe (last: compile risk must not cost the
     # artifacts above; VERDICT r3 item 4's on-TPU timing) ------------------
@@ -1201,6 +1238,9 @@ def _main_measure(record, warnings, errors):
     sh = results.get("sharded_smoke")
     if sh is not None:
         record["sharded_smoke_ok"] = sh["ok"]
+    fc = results.get("full_check_smoke")
+    if fc is not None:
+        record["full_check_sharded_ok"] = fc["ok"]
     f64 = results.get("fused64")
     if f64 is not None:
         record["steady_fused64_count_pps"] = round(f64["fused64_pps"])

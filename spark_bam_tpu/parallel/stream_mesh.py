@@ -339,6 +339,7 @@ def full_check_summary_sharded(
     progress: Callable[[int, int, int], None] | None = None,
     k_positions: int = 4096,
     fallback_use_device: bool = True,
+    stats_out: dict | None = None,
 ) -> dict:
     """The full-check workload's aggregations across the mesh — the third
     sharded workload (reference FullCheck.scala:112-417 as a Spark job;
@@ -413,6 +414,12 @@ def full_check_summary_sharded(
     n_two = sum(map(len, two_pos))
     if not fallback and (n_crit != int(agg[2]) or n_two != int(agg[3])):
         fallback = True  # a row overflowed the compaction buffer
+    if stats_out is not None:
+        # ``fallback`` tells hardware smokes whether the MESH pass itself
+        # produced the summary (same contract as count_reads_sharded).
+        stats_out.update(
+            steps=steps, fallback=fallback, defers=int(agg[4]),
+        )
     if fallback:
         from spark_bam_tpu.tpu.stream_check import (
             full_check_summary_streaming,
