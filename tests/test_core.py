@@ -71,3 +71,28 @@ def test_probe_default_backend_never_hangs():
         assert probe_default_backend() == "sentinel"
     finally:
         _PROBED_BACKEND.clear()
+
+
+def test_config_env_skips_cloud_namespaces(monkeypatch):
+    """SPARK_BAM_GS_* / SPARK_BAM_S3_* / SPARK_BAM_PROFILE_* are backend
+    and profiler namespaces, not Config knobs — from_env must skip them
+    instead of raising (a set SPARK_BAM_PROFILE_DIR used to break every
+    CLI invocation that called Config.from_env)."""
+    from spark_bam_tpu.core.config import Config
+
+    monkeypatch.setenv("SPARK_BAM_GS_ENDPOINT", "http://localhost:1")
+    monkeypatch.setenv("SPARK_BAM_GS_TOKEN", "tok")
+    monkeypatch.setenv("SPARK_BAM_S3_ENDPOINT", "http://localhost:2")
+    monkeypatch.setenv("SPARK_BAM_PROFILE_DIR", "/tmp/prof")
+    monkeypatch.setenv("SPARK_BAM_READS_TO_CHECK", "7")
+    cfg = Config.from_env()
+    assert cfg.reads_to_check == 7  # real knobs still apply
+
+
+def test_config_unknown_key_still_rejected():
+    import pytest
+
+    from spark_bam_tpu.core.config import Config
+
+    with pytest.raises(KeyError):
+        Config.from_dict({"spark.bam.not.a.knob": 1})

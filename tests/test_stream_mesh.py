@@ -374,3 +374,28 @@ def test_full_check_sharded_matches_streaming_fuzz(tmp_path):
             "two_check_positions", "two_check_masks",
         ):
             np.testing.assert_array_equal(a[key], b[key], err_msg=str(seed))
+
+
+def test_host_shard_plan_four_hosts_and_tiny_file():
+    """Plan arithmetic edges: more host slots than groups leaves trailing
+    hosts empty (never mis-assigned), and every owned group appears in
+    exactly one host's range."""
+    from spark_bam_tpu.parallel.stream_mesh import host_shard_plan
+
+    plan = host_shard_plan(
+        BAM2, num_hosts=4, devices_per_host=2,
+        window_uncompressed=512 << 10, halo=64 << 10,
+    )
+    assert [p["host"] for p in plan] == [0, 1, 2, 3]
+    covered = []
+    for p in plan:
+        g0, g1 = p["groups"]
+        covered.extend(range(g0, g1))
+        if g0 == g1:
+            assert p["uncompressed"] == 0 and p["compressed_range"] == (0, 0)
+    assert covered == sorted(set(covered))  # disjoint, ordered
+    total = sum(p["uncompressed"] for p in plan)
+    from spark_bam_tpu.parallel.stream_mesh import _ShardedStream
+
+    st = _ShardedStream(BAM2, Config(), _mesh(), 512 << 10, 64 << 10, None)
+    assert total == st.total
