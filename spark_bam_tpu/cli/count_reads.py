@@ -20,11 +20,41 @@ def run(
     iterations: int = 1,
     reference=None,
     sharded: bool = False,
+    resident: bool = False,
 ) -> None:
+    def timed_loop(count_fn):
+        """The no-competitor output shape shared by every standalone mode
+        (resident / sharded / CRAM): N timed counts, no hadoop-bam leg."""
+        for _ in range(max(iterations, 1)):
+            t0 = time.perf_counter()
+            count = count_fn()
+            ms = int((time.perf_counter() - t0) * 1000)
+            p.echo(f"spark-bam read-count time: {ms}")
+            p.echo(f"Read count: {count}", "")
+
+    is_cram = str(path).endswith(".cram")
+    if resident and sharded:
+        raise UsageError("--resident and --sharded are mutually exclusive")
+    if resident and is_cram:
+        raise UsageError(
+            "--resident supports BAM only: CRAM has no BGZF block "
+            "structure to window (use the default count-reads path)"
+        )
+    if (resident or config.resident_scan) and not is_cram and not sharded:
+        # Single-device streaming count in resident-scan mode: windows
+        # packed into HBM chunks, one dispatch per chunk — the remote-
+        # device configuration. A config-level opt-in (env/dict) applies
+        # only where the mode exists, so CRAM counting is unaffected.
+        from spark_bam_tpu.tpu.stream_check import StreamChecker
+
+        timed_loop(
+            lambda: StreamChecker(path, config).count_reads_resident()
+        )
+        return
     if sharded:
         # Mesh-scale streaming count across every device (no hadoop-bam
         # leg: this is the scale mode; the comparison mode is the default).
-        if str(path).endswith(".cram"):
+        if is_cram:
             raise UsageError(
                 "--sharded supports BAM only: CRAM has no BGZF block "
                 "structure to window (use the default count-reads path)"
@@ -32,26 +62,23 @@ def run(
         from spark_bam_tpu.parallel.stream_mesh import count_reads_sharded
         from spark_bam_tpu.utils.timer import heartbeat_progress
 
-        for _ in range(max(iterations, 1)):
-            t0 = time.perf_counter()
+        def sharded_once():
             with heartbeat_progress(
                 f"count-reads --sharded {path}"
             ) as progress:
-                count = count_reads_sharded(path, config, progress=progress)
-            ms = int((time.perf_counter() - t0) * 1000)
-            p.echo(f"spark-bam read-count time: {ms}")
-            p.echo(f"Read count: {count}", "")
+                return count_reads_sharded(path, config, progress=progress)
+
+        timed_loop(sharded_once)
         return
-    if str(path).endswith(".cram"):
+    if is_cram:
         # No hadoop-bam leg for CRAM (the reference delegates CRAM entirely;
         # there is no competitor count to diff against). ``reference`` (-F)
         # enables RR=true files with external references.
-        for _ in range(max(iterations, 1)):
-            t0 = time.perf_counter()
-            count = load_reads(path, split_size, config, reference=reference).count()
-            ms = int((time.perf_counter() - t0) * 1000)
-            p.echo(f"spark-bam read-count time: {ms}")
-            p.echo(f"Read count: {count}", "")
+        timed_loop(
+            lambda: load_reads(
+                path, split_size, config, reference=reference
+            ).count()
+        )
         return
 
     def run_once():

@@ -110,6 +110,11 @@ def build_parser() -> argparse.ArgumentParser:
         "--sharded", action="store_true",
         help="mesh-scale streaming count across all devices (no hadoop leg)",
     )
+    sub.add_argument(
+        "--resident", action="store_true",
+        help="resident-scan streaming count: one device dispatch per HBM "
+             "chunk (amortizes dispatch latency on remote devices)",
+    )
     sub.add_argument("path")
 
     sub = sp.add_parser("time-load")
@@ -228,6 +233,7 @@ def main(argv=None) -> int:
                 args.path, p, config.split_size_or(Config.LOAD_SPLIT_SIZE_DEFAULT),
                 config, args.spark_bam_first, args.num_iterations,
                 reference=args.reference, sharded=args.sharded,
+                resident=args.resident,
             )
         elif cmd == "index-blocks":
             from spark_bam_tpu.bgzf.index_blocks import index_blocks

@@ -84,6 +84,14 @@ class Config:
     # wire, so hosts whose device link is the constraint should pin False;
     # either way the pipeline demotes to host zlib per window on failure.
     device_inflate: bool | None = None
+    # Resident-scan counting (tpu/stream_check.count_reads_resident):
+    # windows packed into HBM-resident chunks, ONE dispatch per chunk via
+    # checker.count_scan. Amortizes per-dispatch round-trip latency —
+    # decisive on remote/tunnelled devices (measured ~5 s/dispatch there)
+    # and harmless on-host. Opt-in: the streaming loop stays the default
+    # because resident chunks hold ~1 GiB of HBM and the count is the only
+    # projection the scan kernel serves.
+    resident_scan: bool = False
     # --- misc ---
     warn: bool = False                  # root log-level toggle (args/LogArgs.scala:30-33)
     # Accepted for config-surface parity (PostPartitionArgs -p, default

@@ -54,6 +54,11 @@ def test_config_surface():
     assert c2.split_size == 4 << 20
     c3 = Config.from_env({"SPARK_BAM_CHECKER": "full"})
     assert c3.checker == "full"
+    assert c.resident_scan is False
+    c4 = Config.from_dict({"spark.bam.resident.scan": "true"})
+    assert c4.resident_scan is True
+    c5 = Config.from_env({"SPARK_BAM_RESIDENT_SCAN": "1"})
+    assert c5.resident_scan is True
 
 
 def test_probe_default_backend_never_hangs():
