@@ -20,15 +20,18 @@ Design (the double-buffered halo-carry loop):
   reference bounds a boundary scan by ``maxReadSize`` = 10 MB,
   check/.../package.scala:49-57) *escape*; escaped owned positions are
   deferred into a side buffer of raw bytes that grows until their chains
-  can complete, then resolve through the NumPy engine. Deferred
+  can complete, then resolve through the native tri-state walk (verdict
+  projections) or the NumPy engine (flag projections). Deferred
   positions are reported ``False`` in their covering span and re-emitted
-  as 1-position spans once resolved; every resolution is vectorized —
-  O(pending) per window, never O(pending²).
+  as contiguous-run spans once resolved; every resolution is vectorized
+  — O(pending) per window, never O(pending²).
 
 The span contract: ``spans()`` yields ``(base, verdict)`` pairs whose
-``True`` positions are exactly the record starts of the file. Spans tile
-``[0, total)`` in order, plus rare trailing 1-position spans for deferred
-candidates (whose slot in the covering span is ``False``). The same
+``True`` positions are exactly the record starts of the file. Window
+spans tile ``[0, total)`` in order; deferred candidates (``False`` in
+their covering span) re-emit later as spans whose ``base`` lies strictly
+*behind* the tiling frontier — that, not span length, is how to tell a
+re-emission from a window span. The same
 window loop also projects ``full_spans()`` (all-19-flag masks — the
 full-check workload) and ``read_batches()`` (columnar parses with exact
 spill decode — the load workload).
@@ -266,6 +269,11 @@ class StreamChecker:
             self.pending = np.empty(0, dtype=np.int64)
             self.base = 0
             self.buf = np.empty(0, dtype=np.uint8)
+            # Absolute stream tip at the last whole-buffer chains attempt
+            # (the flags-projection resolver); gates re-attempts so the
+            # O(retained-span) flag recompute runs only after meaningful
+            # growth, not every window.
+            self._gate_tip = 0
 
         def __len__(self):
             return len(self.pending)
@@ -318,18 +326,34 @@ class StreamChecker:
             done = (~res.escaped) & res.exact
             return self._retire(done), res, done
 
+        @staticmethod
+        def _emit_runs(positions: np.ndarray, rows: tuple):
+            """Group ascending resolved positions into contiguous runs and
+            yield span-style ``(run_start, per-field arrays)`` tuples —
+            one emission per run instead of one per position (sub-record
+            windows defer whole windows at a time; per-position tuples
+            were the re-emission half of the long-read perf cliff)."""
+            if not len(positions):
+                return
+            breaks = np.flatnonzero(np.diff(positions) != 1) + 1
+            for seg in np.split(np.arange(len(positions)), breaks):
+                yield int(positions[seg[0]]), tuple(r[seg] for r in rows)
+
         def resolve(self, at_eof: bool, fields: tuple[str, ...]):
             """Re-check pendings against the grown stream; yield
-            ``(pos, row)`` — ``row`` holds a length-1 array per projected
-            field — for each pending now resolved with certainty.
+            ``(pos, row)`` — ``row`` holds one array per projected field
+            covering a contiguous run of positions from ``pos`` — for
+            each pending run now resolved with certainty.
 
             The verdict-only projection (spans/count) resolves through the
             native tri-state chain walk when built: it touches only the
-            ~``reads_to_check`` records each chain actually visits, where
-            the NumPy engine recomputes a whole-buffer flag pass per window
-            (the dominant cost of long-read streaming before this — the
-            flag projections still use it, their masks need the full
-            pass)."""
+            ~``reads_to_check`` records each chain actually visits. The
+            flag projections need a whole-buffer flag pass per attempt
+            (their masks come from the full pass), so attempts are gated:
+            only at EOF or once the stream grew by ≥¼ of the retained
+            span since the last attempt. Ungated, sub-record windows
+            (ultra-long reads) recompute the span every window —
+            O(span²) per record."""
             if not len(self.pending):
                 return
             if fields == ("verdict",):
@@ -340,19 +364,17 @@ class StreamChecker:
                     reads_to_check=self.rtc, exact_eof=at_eof,
                 )
                 if tri is not None:
+                    verdicts = tri[tri != 2] == 1
                     positions = self._retire(tri != 2)
-                    for pos, v in zip(
-                        positions.tolist(), tri[tri != 2].tolist()
-                    ):
-                        yield int(pos), (np.array([v == 1], dtype=bool),)
+                    yield from self._emit_runs(positions, (verdicts,))
                     return
+            tip = self.base + len(self.buf)
+            if not at_eof and tip - self._gate_tip < (tip - self.base) // 4:
+                return
+            self._gate_tip = tip
             positions, res, done = self._resolve_chains(at_eof)
-            for pos, k in zip(
-                positions.tolist(), np.flatnonzero(done).tolist()
-            ):
-                yield int(pos), tuple(
-                    np.asarray(getattr(res, f))[k: k + 1] for f in fields
-                )
+            rows = tuple(np.asarray(getattr(res, f))[done] for f in fields)
+            yield from self._emit_runs(positions, rows)
 
     # ------------------------------------------------------------- consumers
     def _stream(
@@ -364,9 +386,9 @@ class StreamChecker:
         """The shared window loop behind ``spans``/``full_spans``/
         ``read_batches``: project ``fields`` from each window's results,
         defer unresolved owned lanes (escaped chains; plus inexact ones when
-        the projection includes flags), and re-emit them as 1-position spans
-        once exact. ``with_buf`` appends the window's byte buffer to each
-        window tuple (``None`` on deferred re-emissions)."""
+        the projection includes flags), and re-emit them as contiguous-run
+        spans once exact. ``with_buf`` appends the window's byte buffer to
+        each window tuple (``None`` on deferred re-emissions)."""
         deferred = self._Deferred(self.lengths, self.config.reads_to_check)
         windows = 0
         for buf, base, own_end, at_eof, out in self._windows(self._launcher()):
@@ -615,8 +637,8 @@ class StreamChecker:
         Exactness discipline: owned lanes whose masks may be incomplete
         (escaped chains or buffer-edge-inexact failures) defer through the
         same side buffer as ``spans()`` — and stay deferred until a re-check
-        is fully *exact* — then re-emit as 1-position spans (their slot in
-        the covering span carries mask 0 / reads_before 0).
+        is fully *exact* — then re-emit as contiguous-run spans (their
+        slots in the covering span carry mask 0 / reads_before 0).
         """
         yield from self._stream(
             ("fail_mask", "reads_before"), defer_inexact=True
@@ -641,9 +663,9 @@ class StreamChecker:
         for base, verdict, buf in self._stream(
             ("verdict",), defer_inexact=False, with_buf=True
         ):
-            if buf is None:  # a deferred 1-position re-emission
-                if verdict[0] and base >= he:
-                    spill_abs.append(base)
+            if buf is None:  # a deferred contiguous-run re-emission
+                idx = base + np.flatnonzero(verdict)
+                spill_abs.extend(idx[idx >= he].tolist())
             else:
                 starts = np.flatnonzero(verdict)
                 starts = starts[base + starts >= he]
