@@ -816,7 +816,7 @@ def _run_e2e_once(
 
 def _run_e2e_resident(
     window_mb: int, big_path: str, reads: int, backend: str,
-    metas: list, leg: str = "e2e_resident",
+    metas: list, leg: str = "e2e_resident", chunk_windows: int = 0,
 ):
     """The 1 GB count through ``StreamChecker.count_reads_resident``:
     host inflate → windows packed into HBM-resident chunks → ONE
@@ -840,7 +840,9 @@ def _run_e2e_resident(
         progress=progress, metas=metas,
     )
     t0 = time.perf_counter()
-    count = checker.count_reads_resident()
+    count = checker.count_reads_resident(
+        chunk_windows=chunk_windows or None
+    )
     _emit_stage(f"{leg}_sync_done")
     wall = time.perf_counter() - t0
     positions = checker.total
@@ -856,12 +858,15 @@ def _run_e2e_resident(
         "window_mb": window_mb,
         "inflate": "host",
         "mode": "resident",
+        "chunk_windows": chunk_windows or "auto",
         "file_bytes": os.path.getsize(big_path),
     })
     _emit_stage(f"{leg}_done")
 
 
-def _child_resident(window_mb: int, big_path: str, reads: int):
+def _child_resident(
+    window_mb: int, big_path: str, reads: int, chunk_windows: int = 0
+):
     """The resident-scan e2e leg, isolated in its own process: count_scan
     is a brand-new XLA program no other leg compiles, and _run_e2e_resident
     has no projection abort (its device work is per-chunk, not per-window)
@@ -882,7 +887,10 @@ def _child_resident(window_mb: int, big_path: str, reads: int):
     metas = list(blocks_metadata(big_path))
     _emit_stage("metas_done")
     try:
-        _run_e2e_resident(window_mb, big_path, reads, backend, metas)
+        _run_e2e_resident(
+            window_mb, big_path, reads, backend, metas,
+            chunk_windows=chunk_windows,
+        )
     except Exception as e:
         _emit_stage(
             "e2e_resident_error:"
@@ -1078,11 +1086,13 @@ def _device_ladder(big_path: str, reads: int, quick_path: str,
 
 
 def _run_extra_child(mode: str, window_mb: int, big_path: str, reads: int,
-                     budget_s: int):
+                     budget_s: int, extra: tuple = ()):
     """Spawn an isolated new-program child (--child-resident /
     --child-inflate). Seam for tests; SB_BENCH_*_CHILD_S=0 disables."""
     return _run_child(
-        [f"--child-{mode}", str(window_mb), big_path, str(reads)], budget_s
+        [f"--child-{mode}", str(window_mb), big_path, str(reads),
+         *map(str, extra)],
+        budget_s,
     )
 
 
@@ -1189,7 +1199,10 @@ def main():
         _child_inflate(int(sys.argv[2]), sys.argv[3], int(sys.argv[4]))
         return
     if len(sys.argv) > 1 and sys.argv[1] == "--child-resident":
-        _child_resident(int(sys.argv[2]), sys.argv[3], int(sys.argv[4]))
+        _child_resident(
+            int(sys.argv[2]), sys.argv[3], int(sys.argv[4]),
+            int(sys.argv[5]) if len(sys.argv) > 5 else 0,
+        )
         return
 
     record = {
@@ -1327,21 +1340,46 @@ def _main_measure(record, warnings, errors):
         # New-program legs each run in their OWN child: a wedged compile
         # over the tunnel costs only that child's timeout, never the
         # proven legs already in ``results``.
-        for mode, env, default_s in (
-            ("resident", "SB_BENCH_RESIDENT_CHILD_S", 450),
-            ("inflate", "SB_BENCH_INFLATE_CHILD_S", 600),
-        ):
-            budget = int(os.environ.get(env, str(default_s)))
-            if budget <= 0:
-                continue
+        # Resident leg: a chunk-size ladder of isolated children. The
+        # full-HBM chunk (auto = ~1 GiB at 32 MB windows) crashed the TPU
+        # worker in the r05 live window; a crash poisons that child's
+        # client, so each rung is a fresh process. Rung 0 = auto/max,
+        # then smaller chunks that trade dispatch amortization for HBM.
+        budget = int(os.environ.get("SB_BENCH_RESIDENT_CHILD_S", "450"))
+        if budget > 0:
+            for chunk_windows in (0, 8, 2):
+                res2, stages2, err2 = _run_extra_child(
+                    "resident", proven_mb, big_path, manifest["reads"],
+                    budget, extra=(chunk_windows,),
+                )
+                for k, v in res2.items():
+                    results.setdefault(k, v)
+                # Prefix must keep the "<token>_child" shape before the
+                # first ":" — _e2e_forensics filters extra-child stages by
+                # that suffix; a rung marker that breaks it would leak
+                # into main-child stall forensics.
+                stages = stages + [
+                    f"resident_cw{chunk_windows}_child:{s}"
+                    for s in stages2
+                ]
+                if err2:
+                    warnings.append(
+                        f"resident child[cw={chunk_windows}]: {err2}"
+                    )
+                if "e2e_resident" in res2:
+                    break  # landed; no smaller rung needed
+                if not any(s.startswith("backend_ok") for s in stages2):
+                    break  # tunnel dark; rungs are irrelevant
+        budget = int(os.environ.get("SB_BENCH_INFLATE_CHILD_S", "600"))
+        if budget > 0:
             res2, stages2, err2 = _run_extra_child(
-                mode, proven_mb, big_path, manifest["reads"], budget,
+                "inflate", proven_mb, big_path, manifest["reads"], budget,
             )
             for k, v in res2.items():
                 results.setdefault(k, v)
-            stages = stages + [f"{mode}_child:{s}" for s in stages2]
+            stages = stages + [f"inflate_child:{s}" for s in stages2]
             if err2:
-                warnings.append(f"{mode} child: {err2}")
+                warnings.append(f"inflate child: {err2}")
 
     # --- e2e results / forensics -----------------------------------------
     e2e = results.get("e2e")

@@ -1,0 +1,102 @@
+#!/usr/bin/env python3
+"""Tunnel watcher: retry TPU bench captures whenever the chip answers.
+
+The axon TPU tunnel is intermittently dark (r03-r05: most capture
+attempts found it down; the two live windows so far lasted ~15 min).
+This watcher turns that intermittency into artifacts: every
+``--interval`` seconds it probes ``jax.devices()`` in a throwaway
+subprocess (a wedged tunnel hangs the probe — the timeout contains it),
+and when the probe answers it runs ``bench.py`` (which appends every
+capture to BENCH_HISTORY.jsonl itself) and optionally a follow-up
+command (e.g. a resident-scan envelope probe).
+
+Usage:
+    python tools/tpu_watch.py [--interval 300] [--max-captures 2] \
+        [--follow "python tools/resident_envelope.py"]
+
+Runs until ``--max-captures`` benches complete (a capture that reaches
+the TPU backend counts; CPU-fallback runs do not) or until killed.
+"""
+
+import argparse
+import json
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+PROBE = (
+    "import jax; d = jax.devices(); "
+    "print('ALIVE' if d and d[0].platform != 'cpu' else 'CPU')"
+)
+
+
+def tunnel_alive(timeout_s: int = 90) -> bool:
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", PROBE],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+        return "ALIVE" in out.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def run_bench(timeout_s: int) -> dict | None:
+    """One bench.py capture; returns the parsed JSON record (or None)."""
+    try:
+        out = subprocess.run(
+            [sys.executable, str(REPO / "bench.py")],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+        for line in reversed(out.stdout.strip().splitlines()):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    except subprocess.TimeoutExpired:
+        pass
+    return None
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--interval", type=int, default=300)
+    ap.add_argument("--max-captures", type=int, default=2)
+    ap.add_argument("--bench-timeout", type=int, default=4500)
+    ap.add_argument("--follow", default="",
+                    help="shell command to run after each TPU capture")
+    args = ap.parse_args()
+
+    captures = 0
+    while captures < args.max_captures:
+        if tunnel_alive():
+            print(f"[{time.strftime('%H:%M:%S')}] tunnel ALIVE — capturing",
+                  flush=True)
+            rec = run_bench(args.bench_timeout)
+            if rec is not None and rec.get("backend") == "tpu":
+                captures += 1
+                print(f"[{time.strftime('%H:%M:%S')}] capture {captures}: "
+                      f"value={rec.get('value')} "
+                      f"vs_baseline={rec.get('vs_baseline')} "
+                      f"source={rec.get('value_source')}", flush=True)
+                if args.follow:
+                    try:
+                        subprocess.run(args.follow, shell=True,
+                                       timeout=2 * args.bench_timeout)
+                    except subprocess.TimeoutExpired:
+                        print(f"[{time.strftime('%H:%M:%S')}] follow "
+                              "command timed out", flush=True)
+            else:
+                print(f"[{time.strftime('%H:%M:%S')}] capture fell back to "
+                      f"CPU or failed; will retry", flush=True)
+        else:
+            print(f"[{time.strftime('%H:%M:%S')}] tunnel dark", flush=True)
+        time.sleep(args.interval)
+    print("done: capture budget reached", flush=True)
+
+
+if __name__ == "__main__":
+    main()
