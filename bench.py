@@ -141,6 +141,54 @@ def _timed_fused_count(w: int, iters: int, pd, ld, nc, stage: str) -> float:
     return iters * w / (time.perf_counter() - t0)
 
 
+def _timed_repeat_slope(w: int, pd, ld, nc, backend: str) -> float | None:
+    """Chip rate via the two-point slope of ``count_repeat``.
+
+    Each timing is ONE execute containing K on-chip iterations of the
+    fused count kernel; (t(K2) - t(K1)) / (K2 - K1) is the per-iteration
+    kernel time with every per-execute cost (tunnel RPC, H2D of nothing,
+    output sync) cancelled. Best-of-2 per point damps round-trip jitter.
+
+    Sizing: a first short slope (k1 → 2·k1) estimates the *kernel-only*
+    per-iteration time — t(k1)/k1 would fold the round-trip in, and on a
+    ~5 s-RTT tunnel that undersizes the long point to milliseconds of
+    kernel work, leaving the final slope to measure RTT jitter. The long
+    point then targets ~30 s of pure kernel time (capped at 32768 iters;
+    int32 count wrap is harmless — the value only forces the sync), so
+    seconds-scale RTT jitter perturbs the slope by only a few percent.
+    """
+    import jax.numpy as jnp
+
+    from spark_bam_tpu.tpu.checker import make_count_repeat
+
+    kern = make_count_repeat(w, 10)
+    args = (pd, ld, nc, jnp.int32(w), jnp.bool_(False))
+    k1 = 8 if backend != "cpu" else 2
+
+    def timed(iters: int) -> float:
+        int(kern(*args, iters))  # compile (static iters) + warm
+        best = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            int(kern(*args, iters))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t1 = timed(k1)
+    _emit_stage(f"scanrate_k{k1}:{t1:.3f}s")
+    t1b = timed(2 * k1)
+    _emit_stage(f"scanrate_k{2 * k1}:{t1b:.3f}s")
+    # Kernel-only per-iter estimate; if jitter swamps the short slope,
+    # fall back to assuming the point was all RTT (kernel ≤ 2% of t1).
+    per_iter = max((t1b - t1) / k1, t1 / k1 / 50.0, 1e-7)
+    k2 = 2 * k1 + max(8, min(32768, int(30.0 / per_iter)))
+    t2 = timed(k2)
+    _emit_stage(f"scanrate_k{k2}:{t2:.3f}s")
+    if t2 <= t1b:
+        return None  # jitter swamped the slope; no number is honest
+    return (k2 - 2 * k1) * w / (t2 - t1b)
+
+
 def _child_device_all(window_mb: int, platform: str, iters: int,
                       big_path: str, reads: int,
                       quick_path: str = "", quick_reads: int = 0):
@@ -387,6 +435,23 @@ def _child_device_all(window_mb: int, platform: str, iters: int,
                 "full_check_error:"
                 + f"{type(e).__name__}: {e}"[:300].replace("\n", " ")
             )
+
+    # ---- slope-measured chip rate (late: count_repeat is a NEW XLA
+    # program; a wedged compile here costs nothing already emitted). The
+    # two-point slope cancels the per-execute round-trip, so this measures
+    # the CHIP even through a tunnel that serializes executes at seconds
+    # each (r05 live window: steady_pps collapsed to ~7 M pos/s there
+    # while the chip itself was provably ~3 orders faster). -------------
+    try:
+        scan_pps = _timed_repeat_slope(w, pd, ld, nc, backend)
+        if scan_pps is not None:
+            _emit_result("steady_scan", {
+                "steady_scan_pps": scan_pps,
+                "backend": backend,
+                "window_mb": window_mb,
+            })
+    except Exception as e:
+        _emit_stage("scanrate_error:" + f"{type(e).__name__}: {e}"[:200])
 
     # ---- Pallas on-TPU probe (last: compile risk must not cost the
     # artifacts above; VERDICT r3 item 4's on-TPU timing) ------------------
@@ -1368,8 +1433,10 @@ def _main_measure(record, warnings, errors):
                     )
                 if "e2e_resident" in res2:
                     break  # landed; no smaller rung needed
-                if not any(s.startswith("backend_ok") for s in stages2):
-                    break  # tunnel dark; rungs are irrelevant
+                if not any(s.startswith("backend_ok:") and
+                           not s.startswith("backend_ok:cpu")
+                           for s in stages2):
+                    break  # tunnel dark or CPU fallback; rungs moot
         budget = int(os.environ.get("SB_BENCH_INFLATE_CHILD_S", "600"))
         if budget > 0:
             res2, stages2, err2 = _run_extra_child(
@@ -1481,6 +1548,16 @@ def _main_measure(record, warnings, errors):
     f64 = results.get("fused64")
     if f64 is not None:
         record["steady_fused64_count_pps"] = round(f64["fused64_pps"])
+    # The slope-measured on-chip kernel rate (per-execute round-trip
+    # cancelled) and its ratio to the CPU baseline — the chip-capability
+    # fact, valid even when the tunnel serializes executes and
+    # steady_pps collapses to the RPC rate.
+    sc = results.get("steady_scan")
+    if sc is not None:
+        record["chip_scan_pps"] = round(sc["steady_scan_pps"])
+        record["chip_scan_vs_baseline"] = round(
+            sc["steady_scan_pps"] / base, 2
+        )
     dinf = results.get("device_inflate")
     if dinf is not None:
         record["device_inflate_Bps"] = dinf["device_two_phase_Bps"]

@@ -382,6 +382,65 @@ def count_window(
     }
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "window", "reads_to_check", "iters", "flags_impl", "pallas_interpret"
+    ),
+)
+def count_repeat(
+    padded, lengths, num_contigs, n, at_eof,
+    *,
+    window: int,
+    iters: int,
+    reads_to_check: int = 10,
+    flags_impl: str = "xla",
+    pallas_interpret: bool = False,
+):
+    """The fused count kernel repeated ``iters`` times in ONE dispatch.
+
+    The chip-rate measurement instrument: through a tunnel whose every
+    execute blocks for seconds (observed ~4.9 s/call in the r05 live
+    window, async dispatch notwithstanding), per-call timing measures the
+    tunnel, not the chip. Timing this program at two ``iters`` values and
+    taking the slope cancels the round-trip entirely — two executes
+    total, any tunnel.
+
+    The body carries a value-neutral data dependency on the running count
+    (``n`` is bumped by a predicate that is always false, which XLA
+    cannot prove), so the loop cannot be collapsed by loop-invariant
+    code motion or CSE into a single evaluation.
+    """
+    def body(carry, _):
+        n_eff = n + jnp.where(carry < 0, _I32(1), _I32(0))
+        r = count_window(
+            padded, lengths, num_contigs, n_eff, at_eof,
+            _I32(0), n_eff,
+            reads_to_check=reads_to_check, window=window,
+            flags_impl=flags_impl, pallas_interpret=pallas_interpret,
+        )
+        return carry + r["count"], None
+
+    total, _ = lax.scan(body, _I32(0), None, length=iters)
+    return total
+
+
+def make_count_repeat(
+    window: int, reads_to_check: int = 10, flags_impl: str = "xla"
+):
+    """A jit-compiled ``count_repeat`` for fixed window/iteration count."""
+    pallas_interpret = _pallas_interpret_for(flags_impl)
+
+    def run(padded, lengths, num_contigs, n, at_eof, iters: int):
+        return count_repeat(
+            padded, lengths, num_contigs, n, at_eof,
+            window=window, iters=iters, reads_to_check=reads_to_check,
+            flags_impl=flags_impl, pallas_interpret=pallas_interpret,
+        )
+
+    return run
+
+
 def _pallas_interpret_for(flags_impl: str) -> bool:
     """Pallas kernels compile via Mosaic only on real TPUs; everywhere else
     (tests' virtual CPU mesh) they run in interpret mode."""

@@ -2,75 +2,65 @@
 """Find the largest working resident-scan configuration on the live TPU.
 
 The r05 live window showed the auto (max-HBM) resident chunk crashes the
-TPU worker at 32 MB windows x 32-window chunks; this probe walks a
-ladder of (window_mb, chunk_windows) configurations from large to small,
-each in its own ``bench.py --child-resident`` subprocess (a worker crash
-poisons the client process, never the ladder), and reports the first
+TPU worker at 32 MB windows; this probe walks a ladder of
+(window_mb, chunk_windows) configurations from large to small, each in
+its own ``bench.py --child-resident`` subprocess (a worker crash poisons
+the client process, never the ladder), and reports the first
 configuration that completes with an exact count plus its throughput.
+
+Child management (spawn, dead-tunnel init kill, ##STAGE/##RESULT
+parsing) reuses ``bench._run_child`` so marker changes can't desync.
 
 Results append to ``RESIDENT_ENVELOPE.jsonl`` at the repo root so live
 windows accumulate evidence across sessions.
 """
 
 import json
-import subprocess
 import sys
 import time
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
-BENCH_DIR = Path("/tmp/spark_bam_bench")
+sys.path.insert(0, str(REPO))
+
+import bench  # noqa: E402
+from spark_bam_tpu.benchmarks.synth import ensure_big_bam  # noqa: E402
 
 LADDER = ((32, 32), (32, 8), (16, 8), (8, 8), (8, 2))
 
 
 def main():
-    big = BENCH_DIR / "big_64mb.bam"
-    manifest_path = BENCH_DIR / "big_64mb.manifest.json"
-    if not big.exists():
-        sys.path.insert(0, str(REPO))
-        from spark_bam_tpu.benchmarks.synth import ensure_big_bam
-
-        p, man = ensure_big_bam(64 << 20)
-        big, reads = Path(p), man["reads"]
-    else:
-        reads = json.loads(manifest_path.read_text())["reads"]
+    # ensure_big_bam reuses a valid cached file and re-synthesizes a
+    # missing/stale one — no hand-rolled cache checks here.
+    big, manifest = ensure_big_bam(64 << 20)
+    reads = manifest["reads"]
 
     out_path = REPO / "RESIDENT_ENVELOPE.jsonl"
     for window_mb, chunk_windows in LADDER:
-        t0 = time.time()
         entry = {
-            "ts": t0, "window_mb": window_mb,
+            "ts": time.time(), "window_mb": window_mb,
             "chunk_windows": chunk_windows, "file": str(big),
         }
-        try:
-            proc = subprocess.run(
-                [sys.executable, str(REPO / "bench.py"), "--child-resident",
-                 str(window_mb), str(big), str(reads), str(chunk_windows)],
-                capture_output=True, text=True, timeout=900,
-            )
-            result = None
-            for line in proc.stdout.splitlines():
-                if line.startswith("##RESULT "):
-                    payload = json.loads(line[len("##RESULT "):])
-                    if payload.get("leg") == "e2e_resident":
-                        result = payload
-            stages = [ln for ln in proc.stdout.splitlines()
-                      if ln.startswith("##STAGE")]
-            if result is not None:
-                entry.update(ok=True, **{
-                    k: result[k] for k in
-                    ("pps", "wall_s", "count_ok", "positions")
-                })
-            else:
-                entry.update(ok=False, stages=stages[-3:])
-        except subprocess.TimeoutExpired:
-            entry.update(ok=False, stages=["timeout"])
+        results, stages, err = bench._run_child(
+            ["--child-resident", str(window_mb), str(big), str(reads),
+             str(chunk_windows)],
+            900,
+        )
+        result = results.get("e2e_resident")
+        if result is not None:
+            entry.update(ok=True, **{
+                k: result[k]
+                for k in ("pps", "wall_s", "count_ok", "positions")
+            })
+        else:
+            entry.update(ok=False, stages=stages[-3:], err=err)
         print(json.dumps(entry), flush=True)
         with open(out_path, "a") as f:
             f.write(json.dumps(entry) + "\n")
         if entry["ok"]:
             break  # largest working configuration found
+        if not any(s.startswith("backend_ok:tpu") for s in stages):
+            break  # tunnel dark or CPU fallback; rungs are irrelevant
 
 
 if __name__ == "__main__":
