@@ -166,3 +166,42 @@ def test_truncation_fuzz_device_vs_numpy_engines(tmp_path, seed):
 
         dev, host = run(True), run(False)
         assert dev == host, (cut, dev, host)
+
+
+@pytest.mark.parametrize("seed", range(2))
+def test_subrecord_window_projections_match_whole_file(tmp_path, seed):
+    """Windows far smaller than one record: every owned position defers
+    (the regime where ungated flags-path resolution was O(span^2) and
+    re-emissions were per-position). The gated, run-batched deferral
+    path must still reassemble every projection bit-for-bit."""
+    from spark_bam_tpu.benchmarks.synth import synth_longread_bam
+
+    path = tmp_path / f"lrfuzz{seed}.bam"
+    synth_longread_bam(
+        path, target_bytes=2 << 20, seed=seed,
+        read_lens=(60_000, 140_000), ultra_seq_len=200_000,
+    )
+    cfg = dict(window_uncompressed=64 << 10, halo=32 << 10)
+
+    flat = flatten_file(path)
+    hdr = read_header(path)
+    lens = np.array(hdr.contig_lengths.lengths_list(), dtype=np.int32)
+    want = check_flat(flat.data, lens, at_eof=True)
+    he = hdr.uncompressed_size
+
+    got_v = np.zeros(flat.size, dtype=bool)
+    for base, v in StreamChecker(path, Config(), **cfg).spans():
+        got_v[base: base + len(v)] |= v
+    np.testing.assert_array_equal(got_v, want.verdict)
+
+    got_fm = np.full(flat.size, -1, dtype=np.int64)
+    got_rb = np.full(flat.size, -1, dtype=np.int64)
+    for base, fm, rb in StreamChecker(path, Config(), **cfg).full_spans():
+        got_fm[base: base + len(fm)] = fm
+        got_rb[base: base + len(rb)] = rb
+    np.testing.assert_array_equal(got_fm, want.fail_mask)
+    np.testing.assert_array_equal(got_rb, want.reads_before)
+
+    assert StreamChecker(path, Config(), **cfg).count_reads() == int(
+        want.verdict[he:].sum()
+    )
