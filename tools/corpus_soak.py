@@ -1,0 +1,101 @@
+#!/usr/bin/env python3
+"""Corpus-scale soak: a multi-GB PacBio-class BAM through the sharded engines.
+
+The reference's correctness story rests on ~20 TB of corpus runs
+(reference docs/benchmarks.md:5-15); this repo's equivalent evidence is
+synthesized corpora validated end-to-end. This soak builds (or reuses) a
+multi-GB long-read BAM whose ultra records exceed the streaming halo —
+the regime where hadoop-bam mis-split GiaB PacBio data
+(docs/benchmarks.md:24-38) — and validates it through the PRODUCTION
+sharded paths on the virtual 8-device CPU mesh:
+
+1. ``count_reads_sharded``  == the synth manifest's exact read count;
+2. ``index_records`` (sequential truth walk) → ``check_bam_sharded``
+   vs that sidecar == zero false positives / zero false negatives at
+   every uncompressed position.
+
+Writes one JSON line to ``CORPUS_SOAK.jsonl`` at the repo root.
+
+Usage: python tools/corpus_soak.py [gigabytes]   (default 4)
+"""
+
+import json
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from spark_bam_tpu.core.platform import force_cpu_devices  # noqa: E402
+
+force_cpu_devices(8)
+
+import jax  # noqa: E402
+
+from spark_bam_tpu.bam.index_records import index_records  # noqa: E402
+from spark_bam_tpu.benchmarks.synth import synth_longread_bam  # noqa: E402
+from spark_bam_tpu.core.config import Config  # noqa: E402
+from spark_bam_tpu.parallel.mesh import make_mesh  # noqa: E402
+from spark_bam_tpu.parallel.stream_mesh import (  # noqa: E402
+    check_bam_sharded,
+    count_reads_sharded,
+)
+
+
+def main():
+    gb = int(sys.argv[1]) if len(sys.argv) > 1 else 4
+    path = Path(f"/tmp/spark_bam_bench/longread_{gb}gb.bam")
+    manifest_path = path.with_suffix(".manifest.json")
+    t0 = time.time()
+    if path.exists() and manifest_path.exists():
+        manifest = json.loads(manifest_path.read_text())
+    else:
+        path.parent.mkdir(parents=True, exist_ok=True)
+        manifest = synth_longread_bam(path, target_bytes=gb << 30, seed=11)
+        manifest_path.write_text(json.dumps(manifest))
+    synth_s = time.time() - t0
+    entry = {
+        "ts": time.time(), "file": str(path), "gb": gb,
+        "reads": manifest["reads"],
+        "compressed_bytes": path.stat().st_size,
+        "synth_s": round(synth_s, 1),
+    }
+
+    mesh = make_mesh(jax.devices("cpu")[:8])
+    cfg = Config()
+
+    t0 = time.time()
+    stats: dict = {}
+    count = count_reads_sharded(path, cfg, mesh=mesh, stats_out=stats)
+    entry["count_s"] = round(time.time() - t0, 1)
+    entry["count"] = count
+    entry["count_ok"] = count == manifest["reads"]
+    entry["count_stats"] = stats
+
+    t0 = time.time()
+    sidecar, n_indexed = index_records(path)
+    entry["index_records_s"] = round(time.time() - t0, 1)
+    entry["indexed_records"] = n_indexed
+
+    t0 = time.time()
+    cb = check_bam_sharded(path, cfg, mesh=mesh, records_path=sidecar)
+    entry["check_bam_s"] = round(time.time() - t0, 1)
+    entry["check_bam"] = {
+        k: int(cb[k]) for k in
+        ("true_positives", "false_positives", "false_negatives", "positions")
+    }
+    entry["check_ok"] = (
+        cb["false_positives"] == 0 and cb["false_negatives"] == 0
+        and cb["true_positives"] == manifest["reads"]
+    )
+
+    entry["ok"] = bool(entry["count_ok"] and entry["check_ok"])
+    print(json.dumps(entry), flush=True)
+    with open(REPO / "CORPUS_SOAK.jsonl", "a") as f:
+        f.write(json.dumps(entry) + "\n")
+    sys.exit(0 if entry["ok"] else 1)
+
+
+if __name__ == "__main__":
+    main()
