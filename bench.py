@@ -1226,6 +1226,65 @@ def remote_latency_leg(path: str, latency_s: float = 0.1):
                 os.environ["SPARK_BAM_GS_ENDPOINT"] = old
 
 
+def split_resolution_leg(split_size: int = 2 << 20):
+    """The load-path split-resolution A/B (host-side): split boundaries
+    resolved via the native tri-state window scan vs the Python streaming
+    oracle (reference CanLoadBam.scala:173-243 does this per split on
+    every executor — the per-task startup cost of every distributed
+    load). Measured on a long-read BAM because that is where the scan
+    cost lives: splits landing inside multi-hundred-kbp records force
+    multi-MB scans (the regime that drowned hadoop-bam's guesser,
+    reference docs/benchmarks.md:24-38). The oracle side runs on an
+    evenly-spaced sample of splits (it is the slow side by design);
+    sampled positions must agree exactly (VERDICT r4 item 4)."""
+    from spark_bam_tpu.bam.header import read_header
+    from spark_bam_tpu.benchmarks.synth import synth_longread_bam
+    from spark_bam_tpu.core.config import Config as C
+    from spark_bam_tpu.load.api import _resolve_split_start
+    from spark_bam_tpu.load.splits import file_splits
+
+    path = Path("/tmp/spark_bam_bench/splitres_32mb.bam")
+    if not path.exists():
+        path.parent.mkdir(parents=True, exist_ok=True)
+        synth_longread_bam(
+            path, target_bytes=32 << 20, seed=5, ultra_seq_len=1_000_000
+        )
+    from spark_bam_tpu.native.build import load_native
+
+    if load_native() is None:
+        # Without the native library both sides would run the Python
+        # checker and the "speedup" would be a lie; skip loudly instead.
+        raise RuntimeError("native library unavailable; leg skipped")
+    header = read_header(path)
+    splits = file_splits(path, split_size)
+    # Both sides time the SAME evenly-spaced sample: per-split scan cost
+    # is heavy-tailed here (ultra-record splits force multi-MB scans), so
+    # full-set-vs-sample averages would mix split composition into the
+    # backend ratio.
+    sample = list(range(0, len(splits), max(1, len(splits) // 8)))
+    t0 = time.perf_counter()
+    native = [
+        _resolve_split_start(path, splits[i], header, C()) for i in sample
+    ]
+    native_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    python = [
+        _resolve_split_start(path, splits[i], header, C(backend="python"))
+        for i in sample
+    ]
+    python_s = time.perf_counter() - t0
+    if python != native:
+        raise AssertionError("native/python split resolutions disagree")
+    per_native = native_s / len(sample)
+    per_python = python_s / len(sample)
+    return {
+        "split_resolution_splits": len(sample),
+        "split_resolution_native_s_per_split": round(per_native, 4),
+        "split_resolution_python_s_per_split": round(per_python, 4),
+        "split_resolution_speedup": round(per_python / max(per_native, 1e-9), 1),
+    }
+
+
 def cpu_e2e_rate(path: Path, cap_bytes: int = CPU_E2E_CAP_BYTES):
     """The same count-reads workload on the native CPU checker: pipelined
     host inflate + sequential native eager check of every position.
@@ -1569,6 +1628,12 @@ def _main_measure(record, warnings, errors):
             record.update(remote_latency_leg(quick_path))
         except Exception as e:
             warnings.append(f"remote latency leg: {type(e).__name__}: {e}")
+    # Load-path split resolution A/B (host-side, self-contained fixture,
+    # sampled-equality gated).
+    try:
+        record.update(split_resolution_leg())
+    except Exception as e:
+        warnings.append(f"split resolution leg: {type(e).__name__}: {e}")
 
     pallas = results.get("pallas")
     if pallas is not None:
