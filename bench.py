@@ -1352,13 +1352,42 @@ def main():
         )
     record["error"] = "; ".join(errors) if errors else None
     record["warnings"] = "; ".join(warnings) if warnings else None
+    if record.get("backend") != "tpu":
+        # A dark tunnel at capture time must not erase hardware evidence:
+        # surface the most recent TPU capture from the in-repo history so
+        # this record is self-contained (full entries remain in
+        # BENCH_HISTORY.jsonl).
+        try:
+            hist = Path(__file__).resolve().parent / "BENCH_HISTORY.jsonl"
+            hist_lines = reversed(hist.read_text().splitlines())
+        except OSError:
+            hist_lines = []
+        for line in hist_lines:
+            try:
+                e = json.loads(line)
+            except ValueError:
+                continue  # a torn trailing line must not hide older entries
+            if e.get("backend") == "tpu":
+                record["last_tpu_capture"] = {
+                    k: e[k] for k in (
+                        "ts", "value", "vs_baseline", "value_source",
+                        "steady_pps", "chip_scan_pps", "e2e_device_pps",
+                        "e2e_count_ok", "e2e_resident_pps",
+                    ) if e.get(k) is not None
+                }
+                break
     print(json.dumps(record))
     # Every run (driver or opportunistic) appends to the in-repo history so
     # captures from brief tunnel-attach windows accumulate automatically.
     try:
         hist = Path(__file__).resolve().parent / "BENCH_HISTORY.jsonl"
+        # History holds raw observations only: the convenience snapshot of
+        # an OLDER capture must not be re-persisted into every entry.
+        persisted = {
+            k: v for k, v in record.items() if k != "last_tpu_capture"
+        }
         with open(hist, "a") as f:
-            f.write(json.dumps({"ts": time.time(), **record}) + "\n")
+            f.write(json.dumps({"ts": time.time(), **persisted}) + "\n")
     except OSError:
         pass
 
@@ -1471,7 +1500,28 @@ def _main_measure(record, warnings, errors):
         # then smaller chunks that trade dispatch amortization for HBM.
         budget = int(os.environ.get("SB_BENCH_RESIDENT_CHILD_S", "450"))
         if budget > 0:
-            for chunk_windows in (0, 8, 2):
+            rungs = [0, 8, 2]
+            # A configuration the envelope prober already landed on this
+            # chip leads the ladder (dedup keeps the list short).
+            try:
+                env_lines = (
+                    Path(__file__).resolve().parent / "RESIDENT_ENVELOPE.jsonl"
+                ).read_text().splitlines()
+            except OSError:
+                env_lines = []
+            for line in env_lines:
+                try:
+                    e = json.loads(line)
+                    # count_ok too: a configuration that completed but
+                    # miscounted must not lead (and then short-circuit)
+                    # the ladder.
+                    if (e.get("ok") and e.get("count_ok")
+                            and e.get("window_mb") == proven_mb):
+                        cw = int(e["chunk_windows"])
+                        rungs = [cw] + [r for r in rungs if r != cw]
+                except (ValueError, TypeError, KeyError):
+                    continue
+            for chunk_windows in rungs:
                 res2, stages2, err2 = _run_extra_child(
                     "resident", proven_mb, big_path, manifest["reads"],
                     budget, extra=(chunk_windows,),
