@@ -72,7 +72,15 @@ def main():
 
     captures = 0
     while captures < args.max_captures:
-        if tunnel_alive():
+        # Never contend with an already-running bench (e.g. the driver's
+        # round-end capture) for the single chip — both would degrade.
+        busy = subprocess.run(
+            ["pgrep", "-f", "bench.py"], capture_output=True
+        ).returncode == 0
+        if busy:
+            print(f"[{time.strftime('%H:%M:%S')}] bench already running; "
+                  "standing down", flush=True)
+        elif tunnel_alive():
             print(f"[{time.strftime('%H:%M:%S')}] tunnel ALIVE — capturing",
                   flush=True)
             rec = run_bench(args.bench_timeout)
