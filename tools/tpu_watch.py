@@ -20,6 +20,7 @@ the TPU backend counts; CPU-fallback runs do not) or until killed.
 
 import argparse
 import json
+import os
 import subprocess
 import sys
 import time
@@ -31,6 +32,25 @@ PROBE = (
     "import jax; d = jax.devices(); "
     "print('ALIVE' if d and d[0].platform != 'cpu' else 'CPU')"
 )
+
+
+def _bench_running() -> bool:
+    """True iff some OTHER process is executing bench.py (an interpreter
+    whose script argument is bench.py — not a process that merely mentions
+    it in some argument string)."""
+    me = str(os.getpid())
+    for pid in os.listdir("/proc"):
+        if not pid.isdigit() or pid == me:
+            continue
+        try:
+            argv = Path(f"/proc/{pid}/cmdline").read_bytes().split(b"\0")
+        except OSError:
+            continue
+        if any(
+            a.endswith(b"/bench.py") or a == b"bench.py" for a in argv[:3]
+        ):
+            return True
+    return False
 
 
 def tunnel_alive(timeout_s: int = 90) -> bool:
@@ -74,9 +94,9 @@ def main():
     while captures < args.max_captures:
         # Never contend with an already-running bench (e.g. the driver's
         # round-end capture) for the single chip — both would degrade.
-        busy = subprocess.run(
-            ["pgrep", "-f", "bench.py"], capture_output=True
-        ).returncode == 0
+        # argv-precise: a plain `pgrep -f bench.py` also matches unrelated
+        # processes that merely MENTION bench.py in an argument string.
+        busy = _bench_running()
         if busy:
             print(f"[{time.strftime('%H:%M:%S')}] bench already running; "
                   "standing down", flush=True)
