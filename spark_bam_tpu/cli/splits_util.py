@@ -1,9 +1,14 @@
 """Split computation for the CLI comparison commands.
 
-``spark_bam_splits`` resolves every raw split boundary through the
-vectorized eager engine of a ``CheckerContext`` (one flag pass serves all
-boundaries); ends tile to the next start (reference
-cli/.../spark/LoadReads.scala:164-174, CanLoadBam.scala:262-274).
+``spark_bam_splits`` resolves each raw split boundary through the load
+path's native tri-state window scan when built (bounded inflate per
+boundary — the same engine ``load_bam`` uses; a whole-file pass for a
+handful of boundaries is the wrong altitude at GB scale), else through
+the vectorized eager engine of a ``CheckerContext`` (one flag pass
+serves all boundaries — right for fixture-sized files and the only
+option without the native library). Ends tile to the next start
+(reference cli/.../spark/LoadReads.scala:164-174,
+CanLoadBam.scala:262-274).
 """
 
 from __future__ import annotations
@@ -18,27 +23,58 @@ from spark_bam_tpu.core.pos import Pos
 from spark_bam_tpu.load.splits import Split
 
 
+def _splits_native(ctx: CheckerContext, split_size: int) -> list[Pos] | None:
+    """Per-boundary resolution via ``load.api._resolve_split_start``
+    (native scan + exact confirmation; individual boundaries may demote
+    to the Python oracle, staying correct). None when the native library
+    is unavailable or the config pins ``backend=python`` — those callers
+    get the vectorized whole-file pass instead."""
+    from spark_bam_tpu.check.checker import NoReadFoundException
+    from spark_bam_tpu.load.api import _resolve_split_start
+    from spark_bam_tpu.load.splits import FileSplit
+    from spark_bam_tpu.native.build import load_native
+
+    if ctx.config.backend == "python" or load_native() is None:
+        return None
+    size = ctx.compressed_size
+    header = ctx.header
+    starts: list[Pos] = []
+    for s in range(0, size, split_size):
+        fs = FileSplit(str(ctx.path), s, min(s + split_size, size))
+        try:
+            pos = _resolve_split_start(ctx.path, fs, header, ctx.config)
+        except NoReadFoundException:
+            continue  # no read within max_read_size of this boundary
+        if pos is None:
+            continue  # split owns no blocks, or clean EOF
+        if not starts or starts[-1] != pos:
+            starts.append(pos)
+    return starts
+
+
 def spark_bam_splits(ctx: CheckerContext, split_size: int) -> list[Split]:
     size = ctx.compressed_size
-    true_flat = ctx.true_flat_eager
-    starts: list[Pos] = []
-    with open_channel(ctx.path) as ch:
-        for s in range(0, size, split_size):
-            e = min(s + split_size, size)
-            block = find_block_start(
-                ch, s, ctx.config.bgzf_blocks_to_check, path=ctx.path
-            )
-            if block >= e:
-                continue
-            flat = ctx.view.flat_of_pos(block, 0)
-            j = int(np.searchsorted(true_flat, flat))
-            if j >= len(true_flat):
-                continue
-            if true_flat[j] - flat >= ctx.config.max_read_size:
-                continue
-            start = Pos(*ctx.view.pos_of_flat(int(true_flat[j])))
-            if not starts or starts[-1] != start:
-                starts.append(start)
+    starts = _splits_native(ctx, split_size)
+    if starts is None:
+        true_flat = ctx.true_flat_eager
+        starts = []
+        with open_channel(ctx.path) as ch:
+            for s in range(0, size, split_size):
+                e = min(s + split_size, size)
+                block = find_block_start(
+                    ch, s, ctx.config.bgzf_blocks_to_check, path=ctx.path
+                )
+                if block >= e:
+                    continue
+                flat = ctx.view.flat_of_pos(block, 0)
+                j = int(np.searchsorted(true_flat, flat))
+                if j >= len(true_flat):
+                    continue
+                if true_flat[j] - flat >= ctx.config.max_read_size:
+                    continue
+                start = Pos(*ctx.view.pos_of_flat(int(true_flat[j])))
+                if not starts or starts[-1] != start:
+                    starts.append(start)
     eof = Pos(size, 0)
     return [
         Split(start, starts[i + 1] if i + 1 < len(starts) else eof)

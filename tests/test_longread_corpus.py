@@ -182,3 +182,52 @@ def test_truncated_corpus_differential(corpus, tmp_path):
     # pass than records the tolerant walk stepped over, and far fewer than
     # the full corpus.
     assert 0 < counted <= walked < manifest["reads"]
+
+
+def test_compare_splits_reproduces_hadoop_bam_longread_failure(tmp_path):
+    """The founding-problem demonstration on our own corpus (reference
+    docs/benchmarks.md:24-38: hadoop-bam's guesser fails on GiaB PacBio
+    long reads): on a long-read BAM, every split start our engine
+    produces is a true record start, while the seqdoop emulation —
+    bounded to its upstream 256 KB guess window — loses split points
+    inside ultra records (the incorrect-split/false-negative class).
+    Also pins the native CLI splits path == the vectorized whole-file
+    path."""
+    from spark_bam_tpu.benchmarks.synth import synth_longread_bam
+    from spark_bam_tpu.bgzf.flat import flatten_file
+    from spark_bam_tpu.check.vectorized import check_flat
+    from spark_bam_tpu.cli.app import CheckerContext
+    from spark_bam_tpu.cli.splits_util import spark_bam_splits
+    from spark_bam_tpu.load.hadoop import hadoop_bam_splits
+
+    p = tmp_path / "lr.bam"
+    synth_longread_bam(p, target_bytes=8 << 20, seed=3, ultra_seq_len=600_000)
+    flat = flatten_file(p)
+    hdr = read_header(p)
+    lens = np.array(hdr.contig_lengths.lengths_list(), dtype=np.int32)
+    truth = set(
+        np.flatnonzero(check_flat(flat.data, lens, at_eof=True).verdict)
+        .tolist()
+    )
+
+    def start_flat(s):
+        return int(flat.flat_of_pos(s.start.block_pos, s.start.offset))
+
+    cfg = Config()
+    ours = spark_bam_splits(CheckerContext(p, cfg), 512 << 10)
+    assert all(start_flat(s) in truth for s in ours)
+
+    theirs = hadoop_bam_splits(p, 512 << 10, config=cfg)
+    missed = {start_flat(s) for s in ours} - {start_flat(s) for s in theirs}
+    assert missed, "emulated guesser must lose split points on ultra reads"
+
+    # Native per-boundary path == vectorized whole-file path (vacuous
+    # without the native library — both sides would take the fallback).
+    from spark_bam_tpu.native.build import load_native
+
+    if load_native() is None:
+        pytest.skip("native library unavailable")
+    ours_py = spark_bam_splits(
+        CheckerContext(p, Config(backend="python")), 512 << 10
+    )
+    assert ours == ours_py
