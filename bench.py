@@ -1238,18 +1238,13 @@ def split_resolution_leg(split_size: int = 2 << 20):
     evenly-spaced sample of splits (it is the slow side by design);
     sampled positions must agree exactly (VERDICT r4 item 4)."""
     from spark_bam_tpu.bam.header import read_header
-    from spark_bam_tpu.benchmarks.synth import synth_longread_bam
+    from spark_bam_tpu.benchmarks.synth import ensure_longread_bam
     from spark_bam_tpu.core.config import Config as C
     from spark_bam_tpu.load.api import _resolve_split_start
     from spark_bam_tpu.load.splits import file_splits
-
-    path = Path("/tmp/spark_bam_bench/splitres_32mb.bam")
-    if not path.exists():
-        path.parent.mkdir(parents=True, exist_ok=True)
-        synth_longread_bam(
-            path, target_bytes=32 << 20, seed=5, ultra_seq_len=1_000_000
-        )
     from spark_bam_tpu.native.build import load_native
+
+    path, _ = ensure_longread_bam(32 << 20)
 
     if load_native() is None:
         # Without the native library both sides would run the Python

@@ -34,7 +34,7 @@ force_cpu_devices(8)
 import jax  # noqa: E402
 
 from spark_bam_tpu.bam.index_records import index_records  # noqa: E402
-from spark_bam_tpu.benchmarks.synth import synth_longread_bam  # noqa: E402
+from spark_bam_tpu.benchmarks.synth import ensure_longread_bam  # noqa: E402
 from spark_bam_tpu.core.config import Config  # noqa: E402
 from spark_bam_tpu.parallel.mesh import make_mesh  # noqa: E402
 from spark_bam_tpu.parallel.stream_mesh import (  # noqa: E402
@@ -45,15 +45,8 @@ from spark_bam_tpu.parallel.stream_mesh import (  # noqa: E402
 
 def main():
     gb = int(sys.argv[1]) if len(sys.argv) > 1 else 4
-    path = Path(f"/tmp/spark_bam_bench/longread_{gb}gb.bam")
-    manifest_path = path.with_suffix(".manifest.json")
     t0 = time.time()
-    if path.exists() and manifest_path.exists():
-        manifest = json.loads(manifest_path.read_text())
-    else:
-        path.parent.mkdir(parents=True, exist_ok=True)
-        manifest = synth_longread_bam(path, target_bytes=gb << 30, seed=11)
-        manifest_path.write_text(json.dumps(manifest))
+    path, manifest = ensure_longread_bam(gb << 30, seed=11)
     synth_s = time.time() - t0
     entry = {
         "ts": time.time(), "file": str(path), "gb": gb,
