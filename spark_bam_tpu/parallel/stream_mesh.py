@@ -264,6 +264,83 @@ class _ShardedStream:
         return args + [self.lengths_d, self.nc]
 
 
+def _mostly_dirty(dirty: list, steps: int) -> bool:
+    """The escape-everywhere guard: stop burning device work when the
+    input is dirty nearly everywhere (undersized halo) — all-dirty early,
+    or ≥90% dirty once enough steps have run (a lone clean step must not
+    disable the guard)."""
+    return (steps >= 4 and len(dirty) == steps) or (
+        steps >= 8 and len(dirty) * 10 >= steps * 9
+    )
+
+
+def _exact_row_true_positions(
+    st: "_ShardedStream", g: int, lo_clamp: int, ch
+):
+    """Exact absolute record-start positions inside global row ``g``'s
+    owned span, via the native tri-state walk over a geometrically-grown
+    buffer (only still-uncertain candidates re-check per growth round);
+    ``ch`` is an open channel on ``st.path`` (callers patch many rows —
+    one open serves them all).
+
+    The escape-localized patch primitive: a row whose device verdicts
+    escaped (ultra chains beyond the halo) is re-derived from
+    ``(path, metas)`` alone — the row discipline — without touching any
+    other row. Returns None when the native library is unavailable or
+    the lookahead outgrows ``(reads_to_check + 2) x max_read_size``
+    (adversarial size fields); callers fall back to the whole-file
+    deferral-exact path, which bounds memory by construction."""
+    from spark_bam_tpu.native.build import eager_check_window_native
+
+    lo_abs = int(st.flat_starts[g])
+    hi_abs = lo_abs + int(st.sizes[g])
+    lo_eval = max(lo_abs, lo_clamp)
+    if lo_eval >= hi_abs:
+        return np.empty(0, dtype=np.int64)
+    b0 = int(st.first_block[g])
+    b_end = (
+        int(st.first_block[g + 1]) if g + 1 < len(st.groups)
+        else len(st.metas)
+    )
+    nblocks = len(st.metas)
+    lens = st.lengths[: st.num_contigs]
+    cap_bytes = (st.config.reads_to_check + 2) * st.config.max_read_size
+    b1 = min(b_end + max(1, st.halo // MAX_BLOCK_SIZE + 1), nblocks)
+    cand_abs = np.arange(lo_eval, hi_abs, dtype=np.int64)
+    res = np.full(len(cand_abs), 2, dtype=np.uint8)
+    while True:
+        view = inflate_blocks(ch, st.metas[b0:b1], threads=8)
+        at_eof = b1 == nblocks
+        unc = np.flatnonzero(res == 2)
+        tri = eager_check_window_native(
+            view.data, cand_abs[unc] - lo_abs, lens,
+            reads_to_check=st.config.reads_to_check, exact_eof=at_eof,
+        )
+        if tri is None:
+            return None
+        res[unc] = tri
+        if at_eof or not (res == 2).any():
+            break
+        if view.size - (hi_abs - lo_abs) > cap_bytes:
+            return None
+        b1 = min(b0 + 2 * (b1 - b0), nblocks)
+    return cand_abs[res == 1]
+
+
+def _step_global_rows(st: "_ShardedStream", c0: int) -> list[int]:
+    """Global group indices a sharded step at local row offset ``c0``
+    covered, across ALL processes (fill rows excluded) — the rows a
+    dirty-step patch must recompute so every process lands the same
+    global result."""
+    rows = []
+    for p in range(st.num_processes):
+        for j in range(c0, min(c0 + st.step_rows_local, st.per_proc)):
+            g = p * st.per_proc + j
+            if g < len(st.groups):
+                rows.append(g)
+    return rows
+
+
 def count_reads_sharded(
     path,
     config: Config = Config(),
@@ -281,9 +358,12 @@ def count_reads_sharded(
     devices; multi-host callers pass their process coordinates and get the
     globally reduced count on every process). ``progress(steps_done,
     positions_done, total_positions)`` fires after each sharded step.
-    ``stats_out``, when given, receives ``{"steps", "escapes", "fallback"}``
-    — callers that must know whether the mesh pass itself produced the
-    count (vs the escape fallback) read ``fallback``."""
+    ``stats_out``, when given, receives ``{"steps", "escapes", "fallback",
+    "patched_steps"}`` — escaped steps are normally re-derived exactly on
+    host (``patched_steps`` counts them; the other steps' device totals
+    stand); ``fallback`` is True only when the whole-file exact path ran
+    instead (no native library, adversarial lookahead growth, or an
+    escape-everywhere input)."""
     st = _ShardedStream(
         path, config, mesh, window_uncompressed, halo, metas,
         num_processes=num_processes, process_id=process_id,
@@ -294,39 +374,69 @@ def count_reads_sharded(
         flags_impl=config.flags_impl,
     )
     count = escapes = steps = 0
+    dirty: list[int] = []  # local row offsets (c0) of escaped steps
+    whole_file = False
     # Closing the batch generator on early exit (escape break, error)
     # shuts down the assembly pool and channel before any fallback
     # reopens the file.
     batches = st.batches(header_clamp=True)
     try:
-        for args, done, _c0 in batches:
+        for args, done, c0 in batches:
             totals = np.asarray(step(*args))
-            count += int(totals[0])
-            escapes += int(totals[1])
+            esc = int(totals[1])
             steps += 1
+            if esc:
+                # Escape-localized handling: the dirty STEP's device
+                # totals are untrusted (an escaped chain's verdict can be
+                # wrong in either direction), but every other step stands.
+                # Record the step for a host-side exact patch instead of
+                # discarding the whole device pass.
+                escapes += esc
+                dirty.append(c0)
+            else:
+                count += int(totals[0])
             if progress is not None:
                 progress(steps, done, st.total)
-            if escapes:
+            # Pathological guard (mirrors count_reads' window-4 escape
+            # checkpoint): if nearly every step escapes, the halo is
+            # undersized for this input — stop burning device work and
+            # take the whole-file exact path.
+            if _mostly_dirty(dirty, steps):
+                whole_file = True
                 break
     finally:
         batches.close()
 
+    patched = None
+    if dirty and not whole_file:
+        patched = 0
+        rows = {g for c0 in dirty for g in _step_global_rows(st, c0)}
+        with open_channel(path) as ch:
+            for g in rows:
+                pos = _exact_row_true_positions(st, g, st.header_end, ch)
+                if pos is None:
+                    patched = None  # no native lib / adversarial growth
+                    break
+                patched += len(pos)
+
     if stats_out is not None:
         stats_out.update(
-            steps=steps, escapes=escapes, fallback=bool(escapes),
+            steps=steps, escapes=escapes,
+            fallback=bool(escapes) and patched is None,
+            patched_steps=0 if patched is None else len(dirty),
             rows=len(st.groups),
         )
-    if escapes:
-        # Ultra-long chains outran the halo: resolve bit-exactly through
-        # the single-device deferral path (reusing this pass's block-
-        # metadata scan). Multi-host: every process computes the same
-        # exact count — redundant but correct, and only on pathological
-        # inputs.
+    if escapes and patched is None:
+        # Whole-file exact fallback (no native library, adversarial
+        # lookahead growth, or an escape-everywhere input): resolve
+        # through the single-device deferral path (reusing this pass's
+        # block-metadata scan). Multi-host: every process computes the
+        # same exact count — redundant but correct.
         return StreamChecker(
             path, config, window_uncompressed=st.fresh, halo=st.halo,
             metas=st.metas,
         ).count_reads()
-    return count
+    return count + (patched or 0)
 
 
 def full_check_summary_sharded(
@@ -578,19 +688,45 @@ def check_bam_sharded(
     # exactly), which keeps the device reduction int32-safe at mesh scale.
     agg = np.zeros(4, dtype=np.int64)
     steps = 0
+    dirty: list[int] = []  # local row offsets (c0) of escaped steps
+    whole_file = False
     batches = st.batches(header_clamp=False, fill_row=fill_row)
     try:
-        for args, done, _c0 in batches:
-            agg += np.asarray(step(*args), dtype=np.int64)
+        for args, done, c0 in batches:
+            totals = np.asarray(step(*args), dtype=np.int64)
             steps += 1
+            if totals[3]:
+                # Escape-localized handling (see count_reads_sharded):
+                # the dirty step's confusion counters are untrusted and
+                # its rows re-derive exactly on host below.
+                dirty.append(c0)
+            else:
+                agg += totals
             if progress is not None:
                 progress(steps, done, st.total)
-            if agg[3]:
+            if _mostly_dirty(dirty, steps):
+                whole_file = True
                 break
     finally:
         batches.close()
 
-    if agg[3]:
+    if dirty and not whole_file:
+        rows = {g for c0 in dirty for g in _step_global_rows(st, c0)}
+        with open_channel(path) as ch:
+            for g in rows:
+                pos = _exact_row_true_positions(st, g, 0, ch)
+                if pos is None:
+                    whole_file = True  # no native lib / adversarial growth
+                    break
+                lo = int(st.flat_starts[g])
+                hi = lo + int(st.sizes[g])
+                i0, i1 = np.searchsorted(truth_flats, (lo, hi))
+                t = truth_flats[i0:i1]
+                tp_g = int(np.isin(pos, t).sum())
+                agg[0] += tp_g
+                agg[1] += len(pos) - tp_g
+                agg[2] += len(t) - tp_g
+    if whole_file:
         stats = _check_bam_exact(
             path, config, st.fresh, st.halo, st.metas, truth_flats,
             st.total,

@@ -77,6 +77,9 @@ def test_spans_deferral_coverage(corpus):
 
 
 def test_sharded_count_escapes_then_exact(corpus):
+    """Ultra chains escape the device pass; the escaped steps re-derive
+    exactly on host (escape-localized patch) while every clean step's
+    device total stands — no whole-file fallback."""
     path, manifest, _ = corpus
     from spark_bam_tpu.parallel.stream_mesh import count_reads_sharded
 
@@ -86,7 +89,8 @@ def test_sharded_count_escapes_then_exact(corpus):
         stats_out=stats,
     )
     assert n == manifest["reads"]
-    assert stats["escapes"] > 0 and stats["fallback"], stats
+    assert stats["escapes"] > 0, stats
+    assert stats["patched_steps"] > 0 and not stats["fallback"], stats
 
 
 def test_sharded_check_bam_zero_miscalls(corpus):
@@ -231,3 +235,45 @@ def test_compare_splits_reproduces_hadoop_bam_longread_failure(tmp_path):
         CheckerContext(p, Config(backend="python")), 512 << 10
     )
     assert ours == ours_py
+
+
+def test_exact_row_positions_match_truth(corpus):
+    """The escape-localized patch primitive: every row's exact positions
+    (native tri-state over a grown buffer) must equal the whole-file
+    engine's record starts restricted to that row's owned span."""
+    import jax
+
+    from spark_bam_tpu.bgzf.flat import flatten_file
+    from spark_bam_tpu.check.vectorized import check_flat
+    from spark_bam_tpu.parallel.mesh import make_mesh
+    from spark_bam_tpu.parallel.stream_mesh import (
+        _exact_row_true_positions,
+        _ShardedStream,
+    )
+
+    from spark_bam_tpu.core.channel import open_channel
+    from spark_bam_tpu.native.build import load_native
+
+    if load_native() is None:
+        pytest.skip("native library unavailable")
+    path, manifest, _ = corpus
+    st = _ShardedStream(
+        path, Config(), make_mesh(jax.devices("cpu")[:8]), WINDOW, HALO,
+        None,
+    )
+    flat = flatten_file(path)
+    header = read_header(path)
+    lens = np.array(header.contig_lengths.lengths_list(), dtype=np.int32)
+    truth = np.flatnonzero(check_flat(flat.data, lens, at_eof=True).verdict)
+
+    seen = 0
+    with open_channel(path) as ch:
+        for g in range(len(st.groups)):
+            lo = max(int(st.flat_starts[g]), st.header_end)
+            hi = int(st.flat_starts[g]) + int(st.sizes[g])
+            want = truth[(truth >= lo) & (truth < hi)]
+            got = _exact_row_true_positions(st, g, st.header_end, ch)
+            assert got is not None
+            np.testing.assert_array_equal(got, want)
+            seen += len(got)
+    assert seen == manifest["reads"]
