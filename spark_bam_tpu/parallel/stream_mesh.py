@@ -274,6 +274,46 @@ def _mostly_dirty(dirty: list, steps: int) -> bool:
     )
 
 
+class _RowGrowth:
+    """The shared grown-buffer protocol of the escape-localized patch
+    primitives: global row ``g``'s block range extended with halo
+    lookahead, re-inflated at geometrically-doubled spans until the
+    resolver is satisfied, with one adversarial-growth cap at
+    ``(reads_to_check + 2) x max_read_size`` of lookahead."""
+
+    def __init__(self, st: "_ShardedStream", g: int):
+        self.st = st
+        self.lo_abs = int(st.flat_starts[g])
+        self.hi_abs = self.lo_abs + int(st.sizes[g])
+        self.b0 = int(st.first_block[g])
+        b_end = (
+            int(st.first_block[g + 1]) if g + 1 < len(st.groups)
+            else len(st.metas)
+        )
+        self.nblocks = len(st.metas)
+        self.cap_bytes = (
+            (st.config.reads_to_check + 2) * st.config.max_read_size
+        )
+        self.b1 = min(
+            b_end + max(1, st.halo // MAX_BLOCK_SIZE + 1), self.nblocks
+        )
+
+    def view(self, ch):
+        return inflate_blocks(ch, self.st.metas[self.b0: self.b1], threads=8)
+
+    @property
+    def at_eof(self) -> bool:
+        return self.b1 == self.nblocks
+
+    def grow(self, view_size: int) -> bool:
+        """Double the block span; False once lookahead exceeds the cap
+        (adversarial size fields — callers bail to the whole-file path)."""
+        if view_size - (self.hi_abs - self.lo_abs) > self.cap_bytes:
+            return False
+        self.b1 = min(self.b0 + 2 * (self.b1 - self.b0), self.nblocks)
+        return True
+
+
 def _exact_row_true_positions(
     st: "_ShardedStream", g: int, lo_clamp: int, ch
 ):
@@ -287,44 +327,66 @@ def _exact_row_true_positions(
     escaped (ultra chains beyond the halo) is re-derived from
     ``(path, metas)`` alone — the row discipline — without touching any
     other row. Returns None when the native library is unavailable or
-    the lookahead outgrows ``(reads_to_check + 2) x max_read_size``
-    (adversarial size fields); callers fall back to the whole-file
-    deferral-exact path, which bounds memory by construction."""
+    the lookahead outgrows the adversarial cap; callers fall back to the
+    whole-file deferral-exact path, which bounds memory by
+    construction."""
     from spark_bam_tpu.native.build import eager_check_window_native
 
-    lo_abs = int(st.flat_starts[g])
-    hi_abs = lo_abs + int(st.sizes[g])
-    lo_eval = max(lo_abs, lo_clamp)
-    if lo_eval >= hi_abs:
+    rg = _RowGrowth(st, g)
+    lo_eval = max(rg.lo_abs, lo_clamp)
+    if lo_eval >= rg.hi_abs:
         return np.empty(0, dtype=np.int64)
-    b0 = int(st.first_block[g])
-    b_end = (
-        int(st.first_block[g + 1]) if g + 1 < len(st.groups)
-        else len(st.metas)
-    )
-    nblocks = len(st.metas)
     lens = st.lengths[: st.num_contigs]
-    cap_bytes = (st.config.reads_to_check + 2) * st.config.max_read_size
-    b1 = min(b_end + max(1, st.halo // MAX_BLOCK_SIZE + 1), nblocks)
-    cand_abs = np.arange(lo_eval, hi_abs, dtype=np.int64)
+    cand_abs = np.arange(lo_eval, rg.hi_abs, dtype=np.int64)
     res = np.full(len(cand_abs), 2, dtype=np.uint8)
     while True:
-        view = inflate_blocks(ch, st.metas[b0:b1], threads=8)
-        at_eof = b1 == nblocks
+        view = rg.view(ch)
         unc = np.flatnonzero(res == 2)
         tri = eager_check_window_native(
-            view.data, cand_abs[unc] - lo_abs, lens,
-            reads_to_check=st.config.reads_to_check, exact_eof=at_eof,
+            view.data, cand_abs[unc] - rg.lo_abs, lens,
+            reads_to_check=st.config.reads_to_check, exact_eof=rg.at_eof,
         )
         if tri is None:
             return None
         res[unc] = tri
-        if at_eof or not (res == 2).any():
-            break
-        if view.size - (hi_abs - lo_abs) > cap_bytes:
+        if rg.at_eof or not (res == 2).any():
+            return cand_abs[res == 1]
+        if not rg.grow(view.size):
             return None
-        b1 = min(b0 + 2 * (b1 - b0), nblocks)
-    return cand_abs[res == 1]
+
+
+def _exact_row_flags(st: "_ShardedStream", g: int, ch):
+    """Exact (fail_mask, reads_before) for global row ``g``'s owned span
+    via the NumPy engine over a geometrically-grown buffer — the
+    flags-projection counterpart of ``_exact_row_true_positions`` (the
+    native tri-state walk yields verdicts only; full-check patches need
+    the complete 19-flag masks, which only the full flag pass produces).
+    Grows until every owned candidate is exact and unescaped (or EOF);
+    returns None past the adversarial-growth cap."""
+    from spark_bam_tpu.check.vectorized import check_flat
+
+    rg = _RowGrowth(st, g)
+    if rg.lo_abs >= rg.hi_abs:
+        return np.empty(0, np.int32), np.empty(0, np.int32)
+    span = rg.hi_abs - rg.lo_abs
+    lens = st.lengths[: st.num_contigs]
+    while True:
+        view = rg.view(ch)
+        # candidates=None takes the survivor-compaction fast path (~99%
+        # of positions resolve elementwise from the flag pass); the
+        # owned span is a slice of the all-position result.
+        res = check_flat(
+            view.data, lens, at_eof=rg.at_eof,
+            reads_to_check=st.config.reads_to_check,
+        )
+        need = (res.escaped | ~res.exact)[:span]
+        if rg.at_eof or not need.any():
+            return (
+                np.asarray(res.fail_mask[:span], dtype=np.int32),
+                np.asarray(res.reads_before[:span], dtype=np.int32),
+            )
+        if not rg.grow(view.size):
+            return None
 
 
 def _step_global_rows(st: "_ShardedStream", c0: int) -> list[int]:
@@ -458,12 +520,16 @@ def full_check_summary_sharded(
     their masks. Same return shape as
     ``tpu.stream_check.full_check_summary_streaming`` plus ``devices``.
 
-    Exactness policy mirrors the other sharded workloads: any deferred
-    lane (escaped or edge-inexact mask) or a per-row compaction overflow
-    (> ``k_positions`` sites in one row) abandons the device pass and the
-    file re-runs through the single-device deferral-exact streaming
-    summary (``devices`` = 1 then; ``fallback_use_device`` selects its
-    engine — the CLI passes its hang-proof backend probe's verdict).
+    Exactness policy mirrors the other sharded workloads: a step with
+    deferred lanes (escaped or edge-inexact masks) keeps its device
+    results OUT of the aggregation and its rows re-derive exactly on
+    host (the escape-localized patch, via the NumPy engine's full flag
+    pass over grown buffers). The whole-file single-device streaming
+    summary remains the fallback for nearly-all-dirty inputs,
+    adversarial lookahead growth, and per-row compaction overflow
+    (> ``k_positions`` sites in one row) — ``devices`` = 1 then;
+    ``fallback_use_device`` selects its engine (the CLI passes its
+    hang-proof backend probe's verdict).
     Single-process only (the compacted site arrays are row-sharded device
     outputs; multi-host full-check would need an all-gather of variable
     site lists)."""
@@ -490,17 +556,28 @@ def full_check_summary_sharded(
     two_pos: list[np.ndarray] = []
     two_mask: list[np.ndarray] = []
     fallback = False
+    defers = 0
+    dirty: list[int] = []  # local row offsets (c0) of deferred steps
     steps = 0
     batches = st.batches(header_clamp=False)
     try:
         for args, done, c0 in batches:
             totals, ci, cm, ti, tm = step(*args)
             totals = np.asarray(totals).astype(np.int64)
-            agg += totals
             steps += 1
-            if totals[4]:  # deferred lanes: device masks not exact
-                fallback = True
-                break
+            if totals[4]:
+                # Deferred lanes: the device masks for this STEP are not
+                # exact — skip its totals/sites and patch its rows on
+                # host below (escape-localized, like count/check-bam).
+                defers += int(totals[4])
+                dirty.append(c0)
+                if _mostly_dirty(dirty, steps):
+                    fallback = True
+                    break
+                if progress is not None:
+                    progress(steps, done, st.total)
+                continue
+            agg += totals
             ci, cm, ti, tm = (np.asarray(a) for a in (ci, cm, ti, tm))
             for j in range(ci.shape[0]):
                 g = c0 + j
@@ -520,6 +597,41 @@ def full_check_summary_sharded(
     finally:
         batches.close()
 
+    if dirty and not fallback:
+        from spark_bam_tpu.check.flags import (
+            BIT,
+            considered_mask,
+            num_failing_fields,
+        )
+
+        bit0 = int(BIT["tooFewFixedBlockBytes"])
+        rows = {g for c0 in dirty for g in _step_global_rows(st, c0)}
+        with open_channel(path) as ch:
+            for g in rows:
+                out = _exact_row_flags(st, g, ch)
+                if out is None:
+                    fallback = True  # adversarial lookahead growth
+                    break
+                fm, rb = out
+                base = int(st.flat_starts[g])
+                agg[0] += int((fm == 0).sum())
+                agg[1] += int(((fm == bit0) & (rb == 0)).sum())
+                considered = considered_mask(fm, rb)
+                masked = fm[considered]
+                for i in range(n_flags):
+                    agg[5 + i] += int(((masked >> i) & 1).sum())
+                nf = num_failing_fields(fm, rb)
+                ones = np.flatnonzero(considered & (nf == 1))
+                twos = np.flatnonzero(considered & (nf == 2))
+                agg[2] += len(ones)
+                agg[3] += len(twos)
+                if len(ones):
+                    crit_pos.append(base + ones)
+                    crit_mask.append(fm[ones].astype(np.int32))
+                if len(twos):
+                    two_pos.append(base + twos)
+                    two_mask.append(fm[twos].astype(np.int32))
+
     n_crit = sum(map(len, crit_pos))
     n_two = sum(map(len, two_pos))
     if not fallback and (n_crit != int(agg[2]) or n_two != int(agg[3])):
@@ -528,7 +640,8 @@ def full_check_summary_sharded(
         # ``fallback`` tells hardware smokes whether the MESH pass itself
         # produced the summary (same contract as count_reads_sharded).
         stats_out.update(
-            steps=steps, fallback=fallback, defers=int(agg[4]),
+            steps=steps, fallback=fallback, defers=defers,
+            patched_steps=0 if fallback else len(dirty),
         )
     if fallback:
         from spark_bam_tpu.tpu.stream_check import (
@@ -545,6 +658,16 @@ def full_check_summary_sharded(
     def cat(parts, dtype):
         return np.concatenate(parts) if parts else np.empty(0, dtype=dtype)
 
+    cp, cm = cat(crit_pos, np.int64), cat(crit_mask, np.int32)
+    tp_, tm_ = cat(two_pos, np.int64), cat(two_mask, np.int32)
+    if dirty:
+        # Patched rows appended their sites after the clean steps'; the
+        # report (and the streaming path it must match byte-for-byte)
+        # lists sites in ascending file order — restore it.
+        o = np.argsort(cp, kind="stable")
+        cp, cm = cp[o], cm[o]
+        o = np.argsort(tp_, kind="stable")
+        tp_, tm_ = tp_[o], tm_[o]
     return {
         "per_flag": {
             name: int(agg[5 + i]) for i, name in enumerate(FLAG_NAMES)
@@ -553,10 +676,10 @@ def full_check_summary_sharded(
         # positions NOT considered; the total is host-derived so no
         # position-scale counter rides the collective.
         "considered": st.total - int(agg[0]) - int(agg[1]),
-        "critical_positions": cat(crit_pos, np.int64),
-        "critical_masks": cat(crit_mask, np.int32),
-        "two_check_positions": cat(two_pos, np.int64),
-        "two_check_masks": cat(two_mask, np.int32),
+        "critical_positions": cp,
+        "critical_masks": cm,
+        "two_check_positions": tp_,
+        "two_check_masks": tm_,
         "positions": st.total,
         "devices": st.n_global,
     }

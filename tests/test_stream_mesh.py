@@ -306,24 +306,40 @@ def test_full_check_sharded_matches_streaming():
         np.testing.assert_array_equal(a[key], b[key])
 
 
-def test_full_check_sharded_defer_falls_back_exact(longread_bam):
-    """Ultra records force deferred lanes: the sharded pass must abandon
-    the device run and the single-device deferral-exact summary must come
-    back (devices == 1), still matching a direct streaming run."""
+def test_full_check_sharded_defer_patches_exact(longread_bam):
+    """Ultra records force deferred lanes: the deferred steps' rows
+    re-derive exactly on host (escape-localized patch — the mesh pass
+    stays on 8 devices) and every aggregation still matches a direct
+    streaming run, sites and masks included."""
+    import numpy as np
+
     from spark_bam_tpu.parallel.stream_mesh import full_check_summary_sharded
     from spark_bam_tpu.tpu.stream_check import full_check_summary_streaming
 
     path, _ = longread_bam
+    stats = {}
     a = full_check_summary_sharded(
         path, Config(), mesh=_mesh(),
-        window_uncompressed=1 << 20, halo=256 << 10,
+        window_uncompressed=1 << 20, halo=256 << 10, stats_out=stats,
     )
-    assert a.pop("devices") == 1
+    assert a.pop("devices") == 8
+    assert stats["patched_steps"] > 0 and not stats["fallback"], stats
     b = full_check_summary_streaming(
         path, Config(), window_uncompressed=1 << 20, halo=256 << 10,
     )
     assert a["per_flag"] == b["per_flag"]
     assert a["considered"] == b["considered"]
+    # Sites may arrive in different orders (patched rows vs deferral
+    # re-emissions); compare as position-sorted (position, mask) pairs.
+    for pk, mk in (
+        ("critical_positions", "critical_masks"),
+        ("two_check_positions", "two_check_masks"),
+    ):
+        ap, am = np.asarray(a[pk]), np.asarray(a[mk])
+        bp, bm = np.asarray(b[pk]), np.asarray(b[mk])
+        ao, bo = np.argsort(ap), np.argsort(bp)
+        np.testing.assert_array_equal(ap[ao], bp[bo])
+        np.testing.assert_array_equal(am[ao], bm[bo])
 
 
 def test_full_check_sharded_compaction_overflow_falls_back():
