@@ -423,3 +423,17 @@ def test_host_shard_plan_four_hosts_and_tiny_file():
 
     st = _ShardedStream(BAM2, Config(), _mesh(), 512 << 10, 64 << 10, None)
     assert total == st.total
+
+
+def test_mostly_dirty_guard_thresholds():
+    """The escape-everywhere guard: all-dirty prefixes trip at 4 steps; a
+    lone clean step no longer disables it past 8 steps (>=90% dirty)."""
+    from spark_bam_tpu.parallel.stream_mesh import _mostly_dirty
+
+    assert not _mostly_dirty([1, 2, 3], 3)          # too early
+    assert _mostly_dirty([1, 2, 3, 4], 4)           # all dirty at 4
+    assert not _mostly_dirty([1, 2, 3], 4)          # one clean step at 4
+    assert not _mostly_dirty([1] * 6, 7)            # 86% at 7: below bar
+    assert _mostly_dirty(list(range(9)), 9)         # 100% at 9
+    assert _mostly_dirty(list(range(9)), 10)        # 90% at 10
+    assert not _mostly_dirty(list(range(8)), 10)    # 80% at 10
