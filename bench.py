@@ -724,6 +724,33 @@ def _device_inflate_available() -> bool:
         return False
 
 
+def _obs_stages(reg) -> dict:
+    """One leg's per-stage breakdown from its obs registry: span totals
+    (count + total_ms per ``layer.stage`` name) and the unlabeled
+    counters. Writes the full JSONL trace when SPARK_BAM_METRICS_OUT is
+    set (tpu_watch points it into the capture dir), then disables the
+    registry so the next leg starts clean."""
+    from spark_bam_tpu import obs
+    from spark_bam_tpu.obs.exporters import stage_totals
+
+    snap = reg.snapshot()
+    stages = {
+        "spans": stage_totals(snap),
+        "counters": {
+            c["name"]: c["value"] for c in snap["counters"]
+            if not c["labels"]
+        },
+    }
+    trace_out = os.environ.get("SPARK_BAM_METRICS_OUT")
+    if trace_out:
+        try:
+            obs.export_jsonl(trace_out)
+        except OSError:
+            pass
+    obs.shutdown()
+    return stages
+
+
 def _run_e2e_leg(
     window_mb: int, big_path: str, reads: int, backend: str,
     quiet_pipeline: bool = False, metas: list | None = None,
@@ -856,6 +883,12 @@ def _run_e2e_once(
         window_uncompressed=w - E2E_HALO, halo=E2E_HALO,
         progress=progress, metas=metas, **pipe_kw,
     )
+    # Per-leg registry: the timed loop records spans/counters into a fresh
+    # store so the artifact's stage breakdown covers exactly this leg.
+    from spark_bam_tpu import obs
+
+    obs.shutdown()
+    reg = obs.configure()
     t0 = time.perf_counter()
     count = checker.count_reads()
     wall = time.perf_counter() - t0
@@ -872,6 +905,7 @@ def _run_e2e_once(
         "window_mb": window_mb,
         "inflate": "device" if device_inflate else "host",
         "file_bytes": os.path.getsize(big_path),
+        "stages": _obs_stages(reg),
     }
     if scaled_from:
         payload["scaled_from"] = scaled_from
@@ -904,6 +938,10 @@ def _run_e2e_resident(
         window_uncompressed=w - E2E_HALO, halo=E2E_HALO,
         progress=progress, metas=metas,
     )
+    from spark_bam_tpu import obs
+
+    obs.shutdown()
+    reg = obs.configure()
     t0 = time.perf_counter()
     count = checker.count_reads_resident(
         chunk_windows=chunk_windows or None
@@ -925,6 +963,7 @@ def _run_e2e_resident(
         "mode": "resident",
         "chunk_windows": chunk_windows or "auto",
         "file_bytes": os.path.getsize(big_path),
+        "stages": _obs_stages(reg),
     })
     _emit_stage(f"{leg}_done")
 

@@ -18,6 +18,7 @@ from dataclasses import dataclass
 
 import numpy as np
 
+from spark_bam_tpu import obs
 from spark_bam_tpu.bgzf.block import Metadata, FOOTER_SIZE
 from spark_bam_tpu.bgzf.header import Header
 from spark_bam_tpu.bgzf.stream import MetadataStream, inflate_block_payload
@@ -181,20 +182,28 @@ def inflate_blocks(
     # block's end (never the allocation); the view handed out is exact.
     out_alloc = np.empty(total + 8, dtype=np.uint8)
     out = out_alloc[:total]
-    if not _inflate_fast_native(
-        ch, metas, out_alloc, block_flat, usizes, threads=threads
-    ):
-        if len(metas) > 1 and threads > 1:
-            with ThreadPoolExecutor(max_workers=threads) as pool:
-                list(
-                    pool.map(
-                        lambda im: _inflate_one(ch, im[1], out, int(block_flat[im[0]])),
-                        enumerate(metas),
+    with obs.span("inflate.window", blocks=len(metas), bytes=total) as sp:
+        native = _inflate_fast_native(
+            ch, metas, out_alloc, block_flat, usizes, threads=threads
+        )
+        if not native:
+            if len(metas) > 1 and threads > 1:
+                with ThreadPoolExecutor(max_workers=threads) as pool:
+                    list(
+                        pool.map(
+                            lambda im: _inflate_one(
+                                ch, im[1], out, int(block_flat[im[0]])
+                            ),
+                            enumerate(metas),
+                        )
                     )
-                )
-        else:
-            for i, m in enumerate(metas):
-                _inflate_one(ch, m, out, int(block_flat[i]))
+            else:
+                for i, m in enumerate(metas):
+                    _inflate_one(ch, m, out, int(block_flat[i]))
+        sp.set(engine="native" if native else "zlib")
+    obs.count("inflate.windows")
+    obs.count("inflate.blocks", len(metas))
+    obs.count("inflate.bytes", total)
     return FlatView(
         out,
         np.array([m.start for m in metas], dtype=np.int64),
@@ -206,7 +215,9 @@ def inflate_blocks(
 
 def flatten_file(path, threads: int = 8) -> FlatView:
     """Inflate an entire BAM into one flat buffer (fixtures / small files)."""
-    with open_channel(path) as ch:
+    with open_channel(path) as ch, obs.span(
+        "bgzf.read", kind="metadata_scan", path=str(path)
+    ):
         metas = list(MetadataStream(ch))
     with open_channel(path) as ch:
         total = sum(m.uncompressed_size for m in metas)

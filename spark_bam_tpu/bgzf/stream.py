@@ -21,6 +21,7 @@ import zlib
 from collections import OrderedDict
 from typing import Iterator, Optional
 
+from spark_bam_tpu import obs
 from spark_bam_tpu.bgzf.block import Block, Metadata, FOOTER_SIZE
 from spark_bam_tpu.bgzf.header import Header
 from spark_bam_tpu.core.channel import ByteChannel
@@ -51,7 +52,14 @@ def read_block(ch: ByteChannel) -> Optional[Block]:
     if data_length == 2:
         # 28-byte empty terminator block (reference Stream.scala:56-58)
         return None
-    data = inflate_block_payload(payload[:data_length], uncompressed_size)
+    # Per-block span only when a registry is live (the stream path's
+    # inflate unit of work is one ~64 KiB block); disabled runs pay one
+    # None-check. Counters track read vs inflate volume either way.
+    with obs.span("inflate.block", start=start):
+        data = inflate_block_payload(payload[:data_length], uncompressed_size)
+    obs.count("bgzf.blocks_read")
+    obs.count("bgzf.bytes_read", header.compressed_size)
+    obs.count("bgzf.bytes_inflated", uncompressed_size)
     return Block(data, start, header.compressed_size)
 
 
@@ -145,6 +153,7 @@ class MetadataStream:
             uncompressed_size = self.ch.read_i32()
             if remaining - FOOTER_SIZE == 2:
                 return  # EOF sentinel block
+            obs.count("bgzf.blocks_scanned")
             yield Metadata(start, header.compressed_size, uncompressed_size)
 
     def close(self) -> None:

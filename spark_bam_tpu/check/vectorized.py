@@ -31,6 +31,7 @@ from dataclasses import dataclass
 
 import numpy as np
 
+from spark_bam_tpu import obs
 from spark_bam_tpu.check.flags import BIT
 
 # Bits that can only fire because the *buffer* ended (escape in windowed mode).
@@ -306,9 +307,11 @@ def check_flat(
     """
     masks = compute_flags(np.asarray(buf, dtype=np.uint8), contig_lengths)
     if candidates is not None:
-        return chain_verdicts(
+        res = chain_verdicts(
             masks, candidates, at_eof=at_eof, reads_to_check=reads_to_check
         )
+        _count_check_result(len(candidates), res)
+        return res
     n = masks.n
     F = masks.F
     nonzero = F != 0
@@ -339,4 +342,26 @@ def check_flat(
         reads_before[surv] = cr.reads_before
         exact[surv] = cr.exact
         escaped[surv] = cr.escaped
-    return ChainResult(verdict, reads_parsed, fail_mask, reads_before, exact, escaped)
+    res = ChainResult(verdict, reads_parsed, fail_mask, reads_before, exact, escaped)
+    _count_check_result(n, res)
+    return res
+
+
+def _count_check_result(n_candidates: int, res: "ChainResult") -> None:
+    """Registry accounting for one NumPy-engine check pass. Every reduction
+    here is an extra O(candidates) array pass, so the whole body is gated on
+    a live registry — disabled runs pay one None-check."""
+    if not obs.enabled():
+        return
+    obs.count("check.candidates", n_candidates)
+    obs.count("check.accepted", int(res.verdict.sum()))
+    fm = res.fail_mask
+    refuted = fm != 0
+    if refuted.any():
+        from spark_bam_tpu.check.flags import FLAG_NAMES
+
+        masked = fm[refuted]
+        for i, name in enumerate(FLAG_NAMES):
+            hits = int(((masked >> i) & 1).sum())
+            if hits:
+                obs.count(f"check.flag_refutations.{name}", hits)

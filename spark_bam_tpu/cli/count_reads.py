@@ -3,12 +3,11 @@
 
 from __future__ import annotations
 
-import time
-
 from spark_bam_tpu.cli.output import Printer, UsageError
 from spark_bam_tpu.core.config import Config
 from spark_bam_tpu.load.api import load_bam, load_reads
 from spark_bam_tpu.load.hadoop import hadoop_bam_count
+from spark_bam_tpu.utils.timer import Timer
 
 
 def run(
@@ -24,12 +23,13 @@ def run(
 ) -> None:
     def timed_loop(count_fn):
         """The no-competitor output shape shared by every standalone mode
-        (resident / sharded / CRAM): N timed counts, no hadoop-bam leg."""
+        (resident / sharded / CRAM): N timed counts, no hadoop-bam leg.
+        The named Timer feeds the ``timer.count_reads.spark_bam``
+        histogram when a registry is live; output format is unchanged."""
         for _ in range(max(iterations, 1)):
-            t0 = time.perf_counter()
-            count = count_fn()
-            ms = int((time.perf_counter() - t0) * 1000)
-            p.echo(f"spark-bam read-count time: {ms}")
+            with Timer("count_reads.spark_bam") as t:
+                count = count_fn()
+            p.echo(f"spark-bam read-count time: {int(t.ms)}")
             p.echo(f"Read count: {count}", "")
 
     is_cram = str(path).endswith(".cram")
@@ -82,14 +82,13 @@ def run(
         return
 
     def run_once():
-        t0 = time.perf_counter()
-        spark_count = load_bam(path, split_size, config).count()
-        spark_ms = int((time.perf_counter() - t0) * 1000)
+        with Timer("count_reads.spark_bam") as t:
+            spark_count = load_bam(path, split_size, config).count()
+        spark_ms = int(t.ms)
         try:
-            t0 = time.perf_counter()
-            hadoop_count = hadoop_bam_count(path, split_size, config)
-            hadoop_ms = int((time.perf_counter() - t0) * 1000)
-            return spark_ms, spark_count, hadoop_ms, hadoop_count, None
+            with Timer("count_reads.hadoop_bam") as t:
+                hadoop_count = hadoop_bam_count(path, split_size, config)
+            return spark_ms, spark_count, int(t.ms), hadoop_count, None
         except Exception as e:
             return spark_ms, spark_count, None, None, e
 

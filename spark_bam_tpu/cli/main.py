@@ -29,7 +29,17 @@ def _positive_int(s: str) -> int:
     return v
 
 
+def _add_metrics(sub):
+    sub.add_argument(
+        "--metrics-out", default=None, metavar="PATH",
+        help="enable observability for this run and write the JSONL "
+             "metrics trace here on exit (SPARK_BAM_METRICS_OUT env var "
+             "works too; render with the metrics-report subcommand)",
+    )
+
+
 def _add_common(sub, split_default=None):
+    _add_metrics(sub)
     sub.add_argument("-m", "--max-split-size", default=split_default,
                      help="split size (byte shorthand like 2MB ok)")
     sub.add_argument("-l", "--print-limit", type=int, default=10)
@@ -122,10 +132,12 @@ def build_parser() -> argparse.ArgumentParser:
     sub.add_argument("path")
 
     sub = sp.add_parser("index-blocks")
+    _add_metrics(sub)
     sub.add_argument("-o", "--out", default=None)
     sub.add_argument("path")
 
     sub = sp.add_parser("index-records")
+    _add_metrics(sub)
     sub.add_argument("-o", "--out", default=None)
     sub.add_argument("-t", "--throw-on-truncation", action="store_true")
     sub.add_argument("path")
@@ -134,10 +146,12 @@ def build_parser() -> argparse.ArgumentParser:
     # built-in .bai writer (the reference consumes .bai but can't produce
     # one; ours can, so indexed interval loads work on any sorted BAM).
     sub = sp.add_parser("index-bam")
+    _add_metrics(sub)
     sub.add_argument("-o", "--out", default=None)
     sub.add_argument("path")
 
     sub = sp.add_parser("htsjdk-rewrite", aliases=["rewrite"])
+    _add_metrics(sub)
     sub.add_argument("-o", "--out", default=None, help="write output to file")
     sub.add_argument("-b", "--block-payload", default="65280")
     sub.add_argument("-i", "--index", action="store_true",
@@ -145,17 +159,25 @@ def build_parser() -> argparse.ArgumentParser:
     sub.add_argument("in_path")
     sub.add_argument("out_path")
 
+    # Render a --metrics-out JSONL trace as the reference stats format.
+    sub = sp.add_parser("metrics-report")
+    sub.add_argument("-o", "--out", default=None, help="write output to file")
+    sub.add_argument("-l", "--print-limit", type=int, default=10)
+    sub.add_argument("trace", help="JSONL trace a --metrics-out run wrote")
+
     return ap
 
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     import logging
+    import os
 
     # --warn: root log level to WARN (reference args/LogArgs.scala:30-33).
     logging.basicConfig(
         level=logging.WARNING if getattr(args, "warn", False) else logging.INFO
     )
+    from spark_bam_tpu import obs
     from spark_bam_tpu.cli.output import Printer
 
     out = open(args.out, "w") if getattr(args, "out", None) else None
@@ -169,8 +191,19 @@ def main(argv=None) -> int:
         if value is not None:
             config = config.replace(**{knob: value})
 
+    # --metrics-out (or the env var) turns the process-wide registry on
+    # for this run; everything below the root ``cli.<command>`` span
+    # records into it and the trace is written on the way out.
+    metrics_out = (
+        getattr(args, "metrics_out", None)
+        or os.environ.get("SPARK_BAM_METRICS_OUT")
+    )
+    if metrics_out:
+        obs.configure()
+    cmd = args.command
+    root_span = obs.span(f"cli.{cmd}")
+    root_span.__enter__()
     try:
-        cmd = args.command
         if cmd in ("check-bam", "check-blocks", "full-check", "compute-splits",
                    "time-load"):
             from spark_bam_tpu.cli.app import CheckerContext
@@ -267,6 +300,10 @@ def main(argv=None) -> int:
                 block_payload=parse_bytes(args.block_payload),
                 reindex=args.index,
             )
+        elif cmd == "metrics-report":
+            from spark_bam_tpu.cli import metrics_report
+
+            metrics_report.run(args.trace, p)
         return 0
     except UsageError as e:
         # Flag-combination errors (e.g. --sharded with -u or CRAM) present
@@ -274,6 +311,13 @@ def main(argv=None) -> int:
         print(f"error: {e}", file=sys.stderr)
         return 2
     finally:
+        root_span.__exit__(None, None, None)
+        if metrics_out:
+            # Export after the root span closes so it lands in the trace;
+            # shutdown so in-process callers (tests) start the next run
+            # from a clean disabled state.
+            obs.export_jsonl(metrics_out)
+            obs.shutdown()
         if out:
             out.close()
 

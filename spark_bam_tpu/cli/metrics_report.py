@@ -1,0 +1,12 @@
+"""metrics-report: render a ``--metrics-out`` JSONL trace as a human
+report in the reference stats format (obs/report.py does the parsing and
+formatting; this is just the CLI face)."""
+
+from __future__ import annotations
+
+from spark_bam_tpu.cli.output import Printer
+from spark_bam_tpu.obs.report import render_report
+
+
+def run(trace_path, p: Printer) -> None:
+    p.echo(render_report(trace_path))

@@ -3,8 +3,6 @@ split-computation latency (reference cli/.../spark/compare/TimeLoad.scala)."""
 
 from __future__ import annotations
 
-import time
-
 from spark_bam_tpu.bam.record import BamRecord
 from spark_bam_tpu.cli.app import CheckerContext
 from spark_bam_tpu.cli.splits_util import spark_bam_splits
@@ -12,30 +10,37 @@ from spark_bam_tpu.load.hadoop import (
     hadoop_bam_read_split,
     hadoop_bam_splits,
 )
+from spark_bam_tpu.utils.timer import Timer
 
 
 def run(ctx: CheckerContext, split_size: int) -> None:
     p = ctx.printer
 
-    t0 = time.perf_counter()
-    our_splits = spark_bam_splits(ctx, split_size)
-    our_first = []
-    for split in our_splits:
-        flat = ctx.view.flat_of_pos(split.start.block_pos, split.start.offset)
-        rec, _ = BamRecord.decode(ctx.view.data, flat)
-        our_first.append(rec.read_name)
-    our_ms = int((time.perf_counter() - t0) * 1000)
+    with Timer("time_load.spark_bam") as t:
+        our_splits = spark_bam_splits(ctx, split_size)
+        our_first = []
+        for split in our_splits:
+            flat = ctx.view.flat_of_pos(
+                split.start.block_pos, split.start.offset
+            )
+            rec, _ = BamRecord.decode(ctx.view.data, flat)
+            our_first.append(rec.read_name)
+    our_ms = int(t.ms)
     p.echo(f"spark-bam first-read collection time: {our_ms}")
 
     try:
-        t0 = time.perf_counter()
-        their_splits = hadoop_bam_splits(ctx.path, split_size, config=ctx.config)
-        their_first = []
-        for split in their_splits:
-            for _, rec in hadoop_bam_read_split(ctx.view, len(ctx.contigs), split):
-                their_first.append(rec.read_name)
-                break
-        their_ms = int((time.perf_counter() - t0) * 1000)
+        with Timer("time_load.hadoop_bam") as t:
+            their_splits = hadoop_bam_splits(
+                ctx.path, split_size, config=ctx.config
+            )
+            their_first = []
+            for split in their_splits:
+                for _, rec in hadoop_bam_read_split(
+                    ctx.view, len(ctx.contigs), split
+                ):
+                    their_first.append(rec.read_name)
+                    break
+        their_ms = int(t.ms)
     except Exception as e:
         p.echo(
             "",

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import os
 
+from spark_bam_tpu import obs
 from spark_bam_tpu.bam.bai import BaiIndex, Chunk
 from spark_bam_tpu.bam.header import BamHeader, read_header
 from spark_bam_tpu.bam.iterators import SeekableRecordStream
@@ -44,28 +45,33 @@ def _resolve_split_start(path, split: FileSplit, header: BamHeader, config: Conf
     checker alone costs thousands of seconds). ``backend="python"`` pins
     the Python oracle; both produce identical positions.
     """
-    with open_channel(path) as ch:
-        block_start = find_block_start(
-            ch, split.start, config.bgzf_blocks_to_check, path=str(path)
-        )
+    with obs.span("bgzf.read", kind="find_block_start", split=split.start):
+        with open_channel(path) as ch:
+            block_start = find_block_start(
+                ch, split.start, config.bgzf_blocks_to_check, path=str(path)
+            )
     if block_start >= split.end:
         return None
-    if config.backend != "python":
-        pos = _native_next_read_start(path, block_start, header, config)
-        if pos is not NotImplemented:
-            return pos
-    checker = EagerChecker(
-        SeekableUncompressedBytes(SeekableBlockStream(open_channel(path))),
-        header.contig_lengths,
-        config.reads_to_check,
-    )
-    try:
-        # None ⇒ EOF reached cleanly: this trailing split owns no record
-        # starts (they all precede it) and loads empty. A mid-file scan that
-        # exhausts max_read_size raises NoReadFoundException from the checker.
-        return checker.next_read_start(Pos(block_start, 0), config.max_read_size)
-    finally:
-        checker.close()
+    with obs.span("check.find_record_start", block=block_start):
+        if config.backend != "python":
+            pos = _native_next_read_start(path, block_start, header, config)
+            if pos is not NotImplemented:
+                return pos
+        checker = EagerChecker(
+            SeekableUncompressedBytes(SeekableBlockStream(open_channel(path))),
+            header.contig_lengths,
+            config.reads_to_check,
+        )
+        try:
+            # None ⇒ EOF reached cleanly: this trailing split owns no record
+            # starts (they all precede it) and loads empty. A mid-file scan
+            # that exhausts max_read_size raises NoReadFoundException from
+            # the checker.
+            return checker.next_read_start(
+                Pos(block_start, 0), config.max_read_size
+            )
+        finally:
+            checker.close()
 
 
 #: Chain-lookahead growth bound: once an uncertain position has this much
@@ -182,20 +188,27 @@ def _native_next_read_start(path, block_start: int, header: BamHeader, config: C
 
 
 def _iter_split_records(path, split: FileSplit, header: BamHeader, config: Config):
-    start_pos = _resolve_split_start(path, split, header, config)
+    with obs.span("load.partition", split=split.start):
+        start_pos = _resolve_split_start(path, split, header, config)
     if start_pos is None:
         return
     stream = SeekableRecordStream(
         SeekableUncompressedBytes(SeekableBlockStream(open_channel(path))), header
     )
+    records = 0
     try:
         stream.seek(start_pos)
         for pos, rec in stream:
             if pos.block_pos >= split.end:
                 break
+            records += 1
             yield pos, rec
     finally:
         stream.close()
+        # One counter bump per partition, not per record — the no-exporter
+        # contract stays allocation-free inside the record loop.
+        obs.count("load.records", records)
+        obs.count("load.partitions")
 
 
 def load_reads_and_positions(

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 from typing import Callable, Generic, Iterable, Iterator, Sequence, TypeVar
 
+from spark_bam_tpu import obs
 from spark_bam_tpu.parallel.executor import ParallelConfig, map_partitions
 
 T = TypeVar("T")
@@ -44,11 +45,14 @@ class Dataset(Generic[P, T]):
         return self.map_partitions(lambda it: (x for x in it if pred(x)))
 
     def count(self) -> int:
-        return sum(
-            map_partitions(
-                lambda p: sum(1 for _ in self.compute(p)), self.partitions, self.parallel
+        with obs.span("load.count", partitions=len(self.partitions)):
+            return sum(
+                map_partitions(
+                    lambda p: sum(1 for _ in self.compute(p)),
+                    self.partitions,
+                    self.parallel,
+                )
             )
-        )
 
     def collect(self) -> list[T]:
         out: list[T] = []

@@ -20,6 +20,7 @@ from dataclasses import dataclass
 
 import numpy as np
 
+from spark_bam_tpu import obs
 from spark_bam_tpu.bam.header import read_header
 from spark_bam_tpu.bgzf.flat import FlatView, flatten_file
 from spark_bam_tpu.core.config import Config
@@ -66,10 +67,12 @@ def record_starts(
             halo=min(config.halo_size, window // 4),
             reads_to_check=config.reads_to_check,
         )
-    res = checker.check_buffer(view.data, at_eof=True)
+    with obs.span("check.window", kind="whole_file", bytes=view.size):
+        res = checker.check_buffer(view.data, at_eof=True)
     header_end = view.flat_of_pos(header.end_pos.block_pos, header.end_pos.offset)
     starts = np.flatnonzero(res.verdict)
     starts = starts[starts >= header_end]
+    obs.count("load.record_starts", len(starts))
     return TpuLoadResult(view, header, starts)
 
 
@@ -179,7 +182,10 @@ def count_reads_tpu(path, config: Config = Config()) -> int:
     the same code path bench.py measures."""
     from spark_bam_tpu.tpu.stream_check import StreamChecker
 
-    return StreamChecker(path, config).count_reads()
+    with obs.span("load.count", path=str(path)):
+        n = StreamChecker(path, config).count_reads()
+    obs.count("load.records", n)
+    return n
 
 
 def load_reads_columnar(
@@ -191,7 +197,8 @@ def load_reads_columnar(
 ) -> ReadBatch:
     """All records of a BAM as columnar arrays; filters applied on device."""
     result = record_starts(path, config)
-    batch = parse_flat_records(result.view.data, result.starts)
+    with obs.span("load.parse", records=len(result.starts)):
+        batch = parse_flat_records(result.view.data, result.starts)
     if loci is None and not flags_required and not flags_forbidden:
         return batch
     return _apply_filter(

@@ -1,0 +1,57 @@
+"""Unified observability: metrics registry, span tracing, exporters.
+
+See ``spark_bam_tpu.obs.registry`` for the design and
+``docs/observability.md`` for usage. Import the package and use the
+module-level entry points::
+
+    from spark_bam_tpu import obs
+
+    with obs.span("inflate.window", blocks=len(metas)):
+        ...
+    obs.count("bgzf.blocks_read", len(metas))
+
+Everything is a shared no-op until ``obs.configure()`` runs (the CLI's
+``--metrics-out`` / the ``SPARK_BAM_METRICS_OUT`` env var does this).
+"""
+
+from spark_bam_tpu.obs.registry import (
+    NOOP,
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    Span,
+    configure,
+    count,
+    counter,
+    enabled,
+    export_jsonl,
+    gauge,
+    histogram,
+    observe,
+    read_jsonl,
+    registry,
+    shutdown,
+    span,
+)
+
+__all__ = [
+    "NOOP",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "Registry",
+    "Span",
+    "configure",
+    "count",
+    "counter",
+    "enabled",
+    "export_jsonl",
+    "gauge",
+    "histogram",
+    "observe",
+    "read_jsonl",
+    "registry",
+    "shutdown",
+    "span",
+]

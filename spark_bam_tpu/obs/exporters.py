@@ -1,0 +1,146 @@
+"""Registry exporters: Prometheus text snapshot + stats-format summary.
+
+The JSONL trace exporter lives with the registry (``obs.export_jsonl`` —
+it needs the event buffer); this module renders *snapshots*:
+
+- ``prometheus_text``: the text exposition format — counters and gauges
+  verbatim, histograms as summaries (quantiles from the retained
+  samples). Metric names sanitize ``layer.stage`` dots to underscores.
+- ``stats_summary``: the reference's descriptive-stats format
+  (``core/stats.py`` — N/μ/σ, med/mad, percentile ladder), one block per
+  histogram series, plus counter/gauge listings. This is the same shape
+  the CLI golden reports use, so per-stage timings read like the rest of
+  the toolkit's output.
+- ``stage_totals``: compact ``{span_name: {count, total_ms}}`` dict —
+  the per-stage breakdown bench.py attaches to BENCH_*.json captures.
+"""
+
+from __future__ import annotations
+
+import re
+
+from spark_bam_tpu.core.stats import Stats, fmt_num
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str) -> str:
+    name = _NAME_RE.sub("_", name)
+    if not name or name[0].isdigit():
+        name = "_" + name
+    return name
+
+
+def _prom_labels(labels: dict) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{_prom_name(str(k))}="{v}"' for k, v in sorted(labels.items())
+    )
+    return "{" + inner + "}"
+
+
+def prometheus_text(snapshot: dict) -> str:
+    """Render a ``Registry.snapshot()`` in Prometheus text format."""
+    out: list[str] = []
+    seen_type: set[str] = set()
+
+    def type_line(name: str, kind: str):
+        if name not in seen_type:
+            seen_type.add(name)
+            out.append(f"# TYPE {name} {kind}")
+
+    for c in snapshot.get("counters", []):
+        name = _prom_name(c["name"])
+        type_line(name, "counter")
+        out.append(f"{name}{_prom_labels(c.get('labels', {}))} {c['value']}")
+    for g in snapshot.get("gauges", []):
+        name = _prom_name(g["name"])
+        type_line(name, "gauge")
+        out.append(f"{name}{_prom_labels(g.get('labels', {}))} {g['value']}")
+    for h in snapshot.get("hists", []):
+        name = _prom_name(h["name"])
+        type_line(name, "summary")
+        labels = h.get("labels", {})
+        values = sorted(h.get("values", []))
+        if values:
+            for q in (0.5, 0.9, 0.99):
+                idx = min(len(values) - 1, int(q * len(values)))
+                ql = dict(labels, quantile=q)
+                out.append(f"{name}{_prom_labels(ql)} {values[idx]}")
+        out.append(f"{name}_sum{_prom_labels(labels)} {h['sum']}")
+        out.append(f"{name}_count{_prom_labels(labels)} {h['count']}")
+    return "\n".join(out) + "\n"
+
+
+def _series_title(name: str, labels: dict) -> str:
+    if not labels:
+        return name
+    inner = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+    return f"{name}[{inner}]"
+
+
+def stats_summary(snapshot: dict, spans_by_name: dict | None = None) -> str:
+    """Human summary in the reference stats format.
+
+    ``spans_by_name`` ({name: [durations_ms]}), when given (the
+    metrics-report path, rebuilt from trace events), replaces histogram
+    series whose name matches — trace events are the full-fidelity
+    source when both exist.
+    """
+    blocks: list[str] = []
+    spans_by_name = dict(spans_by_name or {})
+    hists = list(snapshot.get("hists", []))
+    seen: set[str] = set()
+    for h in hists:
+        name = h["name"]
+        values = spans_by_name.pop(name, None)
+        if values is None:
+            values = h.get("values", [])
+        seen.add(name)
+        title = _series_title(name, h.get("labels", {}))
+        if values:
+            blocks.append(f"{title}:\n{Stats(values).show()}")
+        else:
+            blocks.append(
+                f"{title}:\nN: {h['count']}, sum: {fmt_num(h['sum'])}"
+                f" (samples not retained)"
+            )
+    for name, values in sorted(spans_by_name.items()):
+        blocks.append(f"{name}[unit=ms]:\n{Stats(values).show()}")
+
+    counters = snapshot.get("counters", [])
+    if counters:
+        lines = ["counters:"]
+        for c in sorted(counters, key=lambda c: c["name"]):
+            lines.append(
+                f"\t{_series_title(c['name'], c.get('labels', {}))}:"
+                f" {c['value']}"
+            )
+        blocks.append("\n".join(lines))
+    gauges = snapshot.get("gauges", [])
+    if gauges:
+        lines = ["gauges:"]
+        for g in sorted(gauges, key=lambda g: g["name"]):
+            peak = g.get("max")
+            suffix = f" (peak {fmt_num(peak)})" if peak is not None else ""
+            lines.append(
+                f"\t{_series_title(g['name'], g.get('labels', {}))}:"
+                f" {fmt_num(g['value'])}{suffix}"
+            )
+        blocks.append("\n".join(lines))
+    return "\n\n".join(blocks) + ("\n" if blocks else "")
+
+
+def stage_totals(snapshot: dict) -> dict:
+    """``{span_name: {"count": n, "total_ms": x}}`` for every ms-unit
+    histogram — the compact per-stage breakdown for bench captures."""
+    out: dict[str, dict] = {}
+    for h in snapshot.get("hists", []):
+        if h.get("labels", {}).get("unit") != "ms":
+            continue
+        out[h["name"]] = {
+            "count": h["count"],
+            "total_ms": round(h["sum"], 3),
+        }
+    return out

@@ -1,0 +1,369 @@
+"""Process-wide metrics registry and span tracing.
+
+The reference's only observability is ad-hoc ``Timer.time`` blocks and
+heartbeat log lines (SURVEY.md §5; its docs admit "no profiling having
+been done"). This subsystem replaces that shape with first-class,
+artifact-producing instrumentation for the BGZF→inflate→check→load hot
+path:
+
+- **Metrics**: labeled ``Counter`` / ``Gauge`` / ``Histogram`` series in
+  one process-wide ``Registry`` (``obs.counter("bgzf.blocks_read")``).
+- **Spans**: ``with obs.span("inflate.window", blocks=n):`` context
+  managers that nest (thread-local stack), record wall time, emit one
+  structured JSONL event each, and feed a per-name duration histogram so
+  aggregate timings survive even when the raw trace is capped.
+- **Exporters** (``obs.exporters``): JSONL trace file, Prometheus
+  text-format snapshot, and a human summary in the reference's stats
+  format (``core/stats.py``).
+
+Disabled by default: until ``configure()`` installs a live registry,
+every entry point returns a shared no-op singleton — no allocation, no
+locking, no timestamps — so instrumented hot loops cost one attribute
+load + one ``is None`` test. ``--metrics-out PATH`` on any CLI
+subcommand (or the ``SPARK_BAM_METRICS_OUT`` env var) enables it for
+that run and writes the trace on exit.
+
+Span naming convention: dotted ``layer.stage`` names — ``bgzf.read``,
+``inflate.window``, ``check.window``, ``load.partition``, ``mesh.step``
+— so reports group naturally by hot-path layer.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Iterator
+
+# Histograms keep raw samples (for reference-style stats rendering) up to
+# this many observations; count/sum/min/max stay exact beyond it.
+_HIST_SAMPLE_CAP = 1 << 20
+# The JSONL trace buffer stops appending events past this; dropped events
+# are counted and still feed the per-name duration histograms.
+_TRACE_EVENT_CAP = 200_000
+
+
+def _label_key(labels: dict) -> tuple:
+    return tuple(sorted(labels.items()))
+
+
+class Counter:
+    """Monotonic counter; ``inc`` is the only mutator."""
+
+    __slots__ = ("name", "labels", "value")
+
+    def __init__(self, name: str, labels: dict):
+        self.name = name
+        self.labels = labels
+        self.value = 0
+
+    def inc(self, n: int = 1) -> None:
+        self.value += n
+
+
+class Gauge:
+    """Last-write-wins scalar, plus a running max (peak tracking)."""
+
+    __slots__ = ("name", "labels", "value", "max")
+
+    def __init__(self, name: str, labels: dict):
+        self.name = name
+        self.labels = labels
+        self.value = 0.0
+        self.max = None
+
+    def set(self, v) -> None:
+        self.value = v
+        if self.max is None or v > self.max:
+            self.max = v
+
+
+class Histogram:
+    """Sample distribution: exact count/sum/min/max, raw values retained
+    up to ``_HIST_SAMPLE_CAP`` for stats-format rendering."""
+
+    __slots__ = ("name", "labels", "count", "sum", "min", "max", "values")
+
+    def __init__(self, name: str, labels: dict):
+        self.name = name
+        self.labels = labels
+        self.count = 0
+        self.sum = 0.0
+        self.min = None
+        self.max = None
+        self.values: list[float] = []
+
+    def observe(self, v: float) -> None:
+        self.count += 1
+        self.sum += v
+        if self.min is None or v < self.min:
+            self.min = v
+        if self.max is None or v > self.max:
+            self.max = v
+        if len(self.values) < _HIST_SAMPLE_CAP:
+            self.values.append(v)
+
+
+class Span:
+    """One timed, nesting unit of work. Use via ``obs.span(name, **attrs)``."""
+
+    __slots__ = ("registry", "name", "attrs", "parent", "depth", "_t0", "t_wall")
+
+    def __init__(self, registry: "Registry", name: str, attrs: dict):
+        self.registry = registry
+        self.name = name
+        self.attrs = attrs
+        self.parent = None
+        self.depth = 0
+        self._t0 = 0.0
+        self.t_wall = 0.0
+
+    def set(self, **attrs) -> None:
+        """Attach attributes mid-span (e.g. measured device time)."""
+        self.attrs.update(attrs)
+
+    def __enter__(self) -> "Span":
+        stack = self.registry._stack()
+        if stack:
+            self.parent = stack[-1].name
+            self.depth = len(stack)
+        stack.append(self)
+        self.t_wall = time.time()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        ms = (time.perf_counter() - self._t0) * 1e3
+        stack = self.registry._stack()
+        if stack and stack[-1] is self:
+            stack.pop()
+        self.registry._finish_span(self, ms)
+
+
+class _NoopMetric:
+    """Shared do-nothing Counter/Gauge/Histogram stand-in."""
+
+    __slots__ = ()
+
+    def inc(self, n: int = 1) -> None:
+        pass
+
+    def set(self, v=None, **attrs) -> None:
+        pass
+
+    def observe(self, v: float) -> None:
+        pass
+
+    # Context-manager face: span() returns this same singleton when
+    # observability is disabled — zero allocation on the hot path.
+    def __enter__(self) -> "_NoopMetric":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        pass
+
+
+NOOP = _NoopMetric()
+
+
+class Registry:
+    """Process-wide metric store + span trace buffer (thread-safe)."""
+
+    def __init__(self, max_events: int = _TRACE_EVENT_CAP):
+        self._lock = threading.Lock()
+        self._counters: dict[tuple, Counter] = {}
+        self._gauges: dict[tuple, Gauge] = {}
+        self._hists: dict[tuple, Histogram] = {}
+        self._events: list[dict] = []
+        self._dropped = 0
+        self._max_events = max_events
+        self._tls = threading.local()
+        self.t_start = time.time()
+
+    # ------------------------------------------------------------- metrics
+    def _get(self, table: dict, cls, name: str, labels: dict):
+        key = (name, _label_key(labels))
+        m = table.get(key)
+        if m is None:
+            with self._lock:
+                m = table.setdefault(key, cls(name, labels))
+        return m
+
+    def counter(self, name: str, **labels) -> Counter:
+        return self._get(self._counters, Counter, name, labels)
+
+    def gauge(self, name: str, **labels) -> Gauge:
+        return self._get(self._gauges, Gauge, name, labels)
+
+    def histogram(self, name: str, **labels) -> Histogram:
+        return self._get(self._hists, Histogram, name, labels)
+
+    # --------------------------------------------------------------- spans
+    def _stack(self) -> list:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
+
+    def span(self, name: str, **attrs) -> Span:
+        return Span(self, name, attrs)
+
+    def _finish_span(self, span: Span, ms: float) -> None:
+        self.histogram(span.name, unit="ms").observe(ms)
+        event = {
+            "e": "span",
+            "name": span.name,
+            "ms": round(ms, 3),
+            "t": round(span.t_wall, 6),
+            "depth": span.depth,
+        }
+        if span.parent is not None:
+            event["parent"] = span.parent
+        if span.attrs:
+            event["attrs"] = {
+                k: (v if isinstance(v, (int, float, str, bool, type(None)))
+                    else str(v))
+                for k, v in span.attrs.items()
+            }
+        with self._lock:
+            if len(self._events) < self._max_events:
+                self._events.append(event)
+            else:
+                self._dropped += 1
+
+    # ------------------------------------------------------------ snapshot
+    def snapshot(self) -> dict:
+        """A point-in-time copy of every series (no trace events)."""
+        with self._lock:
+            return {
+                "counters": [
+                    {"name": c.name, "labels": c.labels, "value": c.value}
+                    for c in self._counters.values()
+                ],
+                "gauges": [
+                    {"name": g.name, "labels": g.labels, "value": g.value,
+                     "max": g.max}
+                    for g in self._gauges.values()
+                ],
+                "hists": [
+                    {"name": h.name, "labels": h.labels, "count": h.count,
+                     "sum": h.sum, "min": h.min, "max": h.max,
+                     "values": list(h.values[:4096])}
+                    for h in self._hists.values()
+                ],
+                "dropped_events": self._dropped,
+            }
+
+    def events(self) -> list[dict]:
+        with self._lock:
+            return list(self._events)
+
+
+# ------------------------------------------------------- module-level state
+
+_active: Registry | None = None
+_lock = threading.Lock()
+
+
+def configure(max_events: int = _TRACE_EVENT_CAP) -> Registry:
+    """Install (or return) the process-wide live registry."""
+    global _active
+    with _lock:
+        if _active is None:
+            _active = Registry(max_events=max_events)
+        return _active
+
+
+def shutdown() -> None:
+    """Drop the live registry; instrumentation reverts to no-ops."""
+    global _active
+    with _lock:
+        _active = None
+
+
+def enabled() -> bool:
+    return _active is not None
+
+
+def registry() -> Registry | None:
+    """The live registry, or None when observability is disabled."""
+    return _active
+
+
+def counter(name: str, **labels):
+    r = _active
+    return NOOP if r is None else r.counter(name, **labels)
+
+
+def gauge(name: str, **labels):
+    r = _active
+    return NOOP if r is None else r.gauge(name, **labels)
+
+
+def histogram(name: str, **labels):
+    r = _active
+    return NOOP if r is None else r.histogram(name, **labels)
+
+
+def span(name: str, **attrs):
+    """A nesting wall-clock span; the shared no-op when disabled."""
+    r = _active
+    return NOOP if r is None else Span(r, name, attrs)
+
+
+def count(name: str, n: int = 1) -> None:
+    """One-shot unlabeled counter bump — the hot-loop shorthand."""
+    r = _active
+    if r is not None:
+        r.counter(name).inc(n)
+
+
+def observe(name: str, v: float, **labels) -> None:
+    """One-shot histogram observation."""
+    r = _active
+    if r is not None:
+        r.histogram(name, **labels).observe(v)
+
+
+def export_jsonl(path) -> str:
+    """Write the live registry's trace + final metric snapshot as JSONL.
+
+    One JSON object per line: a ``meta`` header, every span event in
+    completion order, then ``counter``/``gauge``/``hist`` snapshot lines.
+    Safe to call with observability disabled (writes an empty-run file).
+    """
+    r = _active
+    lines: list[str] = []
+    meta = {
+        "e": "meta",
+        "version": 1,
+        "t": round(time.time(), 6),
+        "enabled": r is not None,
+    }
+    lines.append(json.dumps(meta))
+    if r is not None:
+        for ev in r.events():
+            lines.append(json.dumps(ev))
+        snap = r.snapshot()
+        for c in snap["counters"]:
+            lines.append(json.dumps({"e": "counter", **c}))
+        for g in snap["gauges"]:
+            lines.append(json.dumps({"e": "gauge", **g}))
+        for h in snap["hists"]:
+            lines.append(json.dumps({"e": "hist", **h}))
+        if snap["dropped_events"]:
+            lines.append(json.dumps(
+                {"e": "dropped", "count": snap["dropped_events"]}
+            ))
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    return str(path)
+
+
+def read_jsonl(path) -> Iterator[dict]:
+    """Parse a JSONL trace back into event dicts (blank lines skipped)."""
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                yield json.loads(line)

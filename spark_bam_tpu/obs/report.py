@@ -1,0 +1,64 @@
+"""Render a JSONL metrics trace as a human report (reference stats format).
+
+The ``spark-bam-tpu metrics-report`` subcommand and ``tools/tpu_watch.py``
+both consume this: parse the JSONL a ``--metrics-out`` run emitted,
+regroup span events by name, and render per-stage duration statistics
+with the same ``core/stats.py`` formatting the golden CLI reports use.
+"""
+
+from __future__ import annotations
+
+from spark_bam_tpu.obs.exporters import stats_summary
+from spark_bam_tpu.obs.registry import read_jsonl
+
+
+def load_trace(path) -> dict:
+    """Parse a trace file into ``{"spans_by_name", "snapshot", "meta"}``."""
+    spans_by_name: dict[str, list[float]] = {}
+    snapshot: dict = {"counters": [], "gauges": [], "hists": []}
+    meta: dict = {}
+    dropped = 0
+    for ev in read_jsonl(path):
+        kind = ev.get("e")
+        if kind == "span":
+            spans_by_name.setdefault(ev["name"], []).append(float(ev["ms"]))
+        elif kind == "counter":
+            snapshot["counters"].append(ev)
+        elif kind == "gauge":
+            snapshot["gauges"].append(ev)
+        elif kind == "hist":
+            snapshot["hists"].append(ev)
+        elif kind == "meta":
+            meta = ev
+        elif kind == "dropped":
+            dropped = int(ev.get("count", 0))
+    snapshot["dropped_events"] = dropped
+    return {"spans_by_name": spans_by_name, "snapshot": snapshot, "meta": meta}
+
+
+def render_report(path) -> str:
+    """The full metrics-report text for one trace file."""
+    trace = load_trace(path)
+    spans = trace["spans_by_name"]
+    header = [
+        f"metrics trace: {path}",
+        f"span events: {sum(len(v) for v in spans.values())}"
+        + (f" (+{trace['snapshot']['dropped_events']} dropped)"
+           if trace["snapshot"]["dropped_events"] else ""),
+    ]
+    body = stats_summary(trace["snapshot"], spans_by_name=spans)
+    return "\n".join(header) + "\n\n" + body
+
+
+def stage_summary_line(path, top: int = 5) -> str:
+    """One-line ``name=total_ms×count`` digest of the heaviest stages —
+    the tpu_watch per-capture log format."""
+    trace = load_trace(path)
+    totals = [
+        (name, sum(ms), len(ms))
+        for name, ms in trace["spans_by_name"].items()
+    ]
+    totals.sort(key=lambda t: -t[1])
+    return " ".join(
+        f"{name}={total:.0f}ms×{n}" for name, total, n in totals[:top]
+    )

@@ -49,6 +49,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from spark_bam_tpu import obs
 from spark_bam_tpu.bam.header import read_header
 from spark_bam_tpu.bgzf.block import MAX_BLOCK_SIZE
 from spark_bam_tpu.bgzf.flat import inflate_blocks
@@ -337,22 +338,40 @@ def _exact_row_true_positions(
     if lo_eval >= rg.hi_abs:
         return np.empty(0, dtype=np.int64)
     lens = st.lengths[: st.num_contigs]
-    cand_abs = np.arange(lo_eval, rg.hi_abs, dtype=np.int64)
-    res = np.full(len(cand_abs), 2, dtype=np.uint8)
-    while True:
-        view = rg.view(ch)
-        unc = np.flatnonzero(res == 2)
-        tri = eager_check_window_native(
-            view.data, cand_abs[unc] - rg.lo_abs, lens,
-            reads_to_check=st.config.reads_to_check, exact_eof=rg.at_eof,
-        )
-        if tri is None:
-            return None
-        res[unc] = tri
-        if rg.at_eof or not (res == 2).any():
-            return cand_abs[res == 1]
-        if not rg.grow(view.size):
-            return None
+    # Candidates walk the owned span in bounded chunks: per-position state
+    # (int64 cand_abs + uint8 res ≈ 9 bytes/position) over a whole
+    # multi-MB row would cost ~9x the row's uncompressed size in host
+    # memory; a 1 Mi-position chunk caps it at ~9 MB. ``rg`` persists
+    # across chunks, so lookahead growth won by an early chunk serves the
+    # rest of the row, and the inflated view is reused until it grows.
+    chunk_positions = 1 << 20
+    obs.count("mesh.patch_rows")
+    view = rg.view(ch)
+    hits: list[np.ndarray] = []
+    for c_lo in range(lo_eval, rg.hi_abs, chunk_positions):
+        c_hi = min(c_lo + chunk_positions, rg.hi_abs)
+        cand_abs = np.arange(c_lo, c_hi, dtype=np.int64)
+        res = np.full(len(cand_abs), 2, dtype=np.uint8)
+        obs.count("mesh.patch_chunks")
+        obs.observe("mesh.patch_chunk_positions", len(cand_abs))
+        while True:
+            unc = np.flatnonzero(res == 2)
+            tri = eager_check_window_native(
+                view.data, cand_abs[unc] - rg.lo_abs, lens,
+                reads_to_check=st.config.reads_to_check, exact_eof=rg.at_eof,
+            )
+            if tri is None:
+                return None
+            res[unc] = tri
+            if rg.at_eof or not (res == 2).any():
+                hits.append(cand_abs[res == 1])
+                break
+            if not rg.grow(view.size):
+                return None
+            view = rg.view(ch)
+    return (
+        np.concatenate(hits) if hits else np.empty(0, dtype=np.int64)
+    )
 
 
 def _exact_row_flags(st: "_ShardedStream", g: int, ch):
@@ -444,10 +463,14 @@ def count_reads_sharded(
     batches = st.batches(header_clamp=True)
     try:
         for args, done, c0 in batches:
-            totals = np.asarray(step(*args))
+            with obs.span("mesh.step", workload="count", c0=c0):
+                totals = np.asarray(step(*args))
             esc = int(totals[1])
             steps += 1
+            obs.count("mesh.steps")
             if esc:
+                obs.count("mesh.dirty_steps")
+                obs.count("mesh.escapes", esc)
                 # Escape-localized handling: the dirty STEP's device
                 # totals are untrusted (an escaped chain's verdict can be
                 # wrong in either direction), but every other step stands.
@@ -562,10 +585,13 @@ def full_check_summary_sharded(
     batches = st.batches(header_clamp=False)
     try:
         for args, done, c0 in batches:
-            totals, ci, cm, ti, tm = step(*args)
-            totals = np.asarray(totals).astype(np.int64)
+            with obs.span("mesh.step", workload="full_check", c0=c0):
+                totals, ci, cm, ti, tm = step(*args)
+                totals = np.asarray(totals).astype(np.int64)
             steps += 1
+            obs.count("mesh.steps")
             if totals[4]:
+                obs.count("mesh.dirty_steps")
                 # Deferred lanes: the device masks for this STEP are not
                 # exact — skip its totals/sites and patch its rows on
                 # host below (escape-localized, like count/check-bam).
@@ -662,8 +688,11 @@ def full_check_summary_sharded(
     tp_, tm_ = cat(two_pos, np.int64), cat(two_mask, np.int32)
     if dirty:
         # Patched rows appended their sites after the clean steps'; the
-        # report (and the streaming path it must match byte-for-byte)
-        # lists sites in ascending file order — restore it.
+        # report lists sites in ascending file order — restore it. (The
+        # streaming summary sorts its deferred re-emissions the same way,
+        # so the two paths agree on site ORDER whenever they agree on the
+        # site set — same-order output is a consequence of both sorting,
+        # not a standalone guarantee.)
         o = np.argsort(cp, kind="stable")
         cp, cm = cp[o], cm[o]
         o = np.argsort(tp_, kind="stable")
@@ -816,9 +845,12 @@ def check_bam_sharded(
     batches = st.batches(header_clamp=False, fill_row=fill_row)
     try:
         for args, done, c0 in batches:
-            totals = np.asarray(step(*args), dtype=np.int64)
+            with obs.span("mesh.step", workload="check_bam", c0=c0):
+                totals = np.asarray(step(*args), dtype=np.int64)
             steps += 1
+            obs.count("mesh.steps")
             if totals[3]:
+                obs.count("mesh.dirty_steps")
                 # Escape-localized handling (see count_reads_sharded):
                 # the dirty step's confusion counters are untrusted and
                 # its rows re-derive exactly on host below.

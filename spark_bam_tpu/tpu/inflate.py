@@ -35,10 +35,13 @@ checker consumes identical flat windows from either producer.
 from __future__ import annotations
 
 import logging
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Iterator
 
 import numpy as np
+
+from spark_bam_tpu import obs
 
 log = logging.getLogger(__name__)
 
@@ -90,7 +93,8 @@ def inflate_blocks_device(
     the native tokenizer is unavailable (callers fall back to zlib)."""
     from spark_bam_tpu.native.build import tokenize_deflate_native
 
-    toks = tokenize_deflate_native(comp, offsets, lengths, stride=STRIDE)
+    with obs.span("inflate.tokenize", blocks=len(offsets)):
+        toks = tokenize_deflate_native(comp, offsets, lengths, stride=STRIDE)
     if toks is None:
         return None
     lit, dist, out_lens = toks
@@ -107,9 +111,23 @@ def inflate_blocks_device(
         dist = np.concatenate(
             [dist, np.zeros((b_pad - b, STRIDE), dtype=np.uint16)]
         )
-    resolved = np.asarray(
-        resolve_lz77(jnp.asarray(lit), jnp.asarray(dist))
-    )[:b]
+    if obs.enabled():
+        # Phase-split timing: H2D transfer (jnp.asarray materializes the
+        # tokens on device) vs the LZ77 kernel + D2H. The explicit sync
+        # between phases exists only under a live registry — the
+        # production path keeps the async single-expression dispatch.
+        with obs.span("inflate.h2d", blocks=b, bytes=lit.nbytes + dist.nbytes):
+            lit_d = jnp.asarray(lit)
+            dist_d = jnp.asarray(dist)
+            lit_d.block_until_ready()
+            dist_d.block_until_ready()
+        with obs.span("inflate.device_kernel", blocks=b):
+            resolved = np.asarray(resolve_lz77(lit_d, dist_d))[:b]
+        obs.count("inflate.device_windows")
+    else:
+        resolved = np.asarray(
+            resolve_lz77(jnp.asarray(lit), jnp.asarray(dist))
+        )[:b]
     return np.concatenate(
         [resolved[i, :n] for i, n in enumerate(out_lens.tolist())]
     ) if len(out_lens) else np.empty(0, dtype=np.uint8)
@@ -223,7 +241,10 @@ class InflatePipeline:
         self.path = path
         # ``metas``: reuse a prior metadata scan (whole-file header walk)
         # when the caller already has one.
-        self.metas = list(blocks_metadata(path)) if metas is None else metas
+        if metas is None:
+            with obs.span("bgzf.read", kind="metadata_scan", path=str(path)):
+                metas = list(blocks_metadata(path))
+        self.metas = metas
         self.total = sum(m.uncompressed_size for m in self.metas)
         self.groups = window_plan(self.metas, window_uncompressed)
         self.threads = threads
@@ -265,7 +286,16 @@ class InflatePipeline:
                 pool.submit(produce, g) for g in self.groups[: self.depth]
             ]
             for i in range(len(self.groups)):
-                view = pending.pop(0).result()
+                fut = pending.pop(0)
+                # Double-buffer health: time spent blocked on the host
+                # producer is exactly the stall the ``depth`` knob exists
+                # to hide. >1ms of wait counts as a stall.
+                t0 = time.perf_counter()
+                view = fut.result()
+                wait_ms = (time.perf_counter() - t0) * 1e3
+                obs.observe("inflate.stall_ms", wait_ms, unit="ms")
+                if wait_ms > 1.0:
+                    obs.count("inflate.stalls")
                 nxt = i + self.depth
                 if nxt < len(self.groups):
                     pending.append(pool.submit(produce, self.groups[nxt]))

@@ -52,6 +52,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from spark_bam_tpu import obs
 from spark_bam_tpu.bam.header import read_header
 from spark_bam_tpu.check.vectorized import check_flat
 from spark_bam_tpu.core.config import Config
@@ -356,6 +357,7 @@ class StreamChecker:
             O(span²) per record."""
             if not len(self.pending):
                 return
+            obs.count("check.defer_retries")
             if fields == ("verdict",):
                 from spark_bam_tpu.native.build import eager_check_window_native
 
@@ -366,6 +368,7 @@ class StreamChecker:
                 if tri is not None:
                     verdicts = tri[tri != 2] == 1
                     positions = self._retire(tri != 2)
+                    obs.count("check.defer_resolved", len(positions))
                     yield from self._emit_runs(positions, (verdicts,))
                     return
             tip = self.base + len(self.buf)
@@ -373,6 +376,7 @@ class StreamChecker:
                 return
             self._gate_tip = tip
             positions, res, done = self._resolve_chains(at_eof)
+            obs.count("check.defer_resolved", len(positions))
             rows = tuple(np.asarray(getattr(res, f))[done] for f in fields)
             yield from self._emit_runs(positions, rows)
 
@@ -392,17 +396,27 @@ class StreamChecker:
         deferred = self._Deferred(self.lengths, self.config.reads_to_check)
         windows = 0
         for buf, base, own_end, at_eof, out in self._windows(self._launcher()):
-            res = self._materialize(buf, at_eof, out)
-            spans = [res[f][:own_end].copy() for f in fields]
-            bad = res["escaped"][:own_end]
-            if defer_inexact:
-                bad = bad | ~res["exact"][:own_end]
-            deferred.extend(buf, base)
-            bad_idx = np.flatnonzero(bad)
-            if len(bad_idx):
-                for s in spans:
-                    s[bad_idx] = 0  # re-emitted by the deferral path
-                deferred.add(base + bad_idx, buf, base)
+            with obs.span("check.window", base=base, own=own_end):
+                res = self._materialize(buf, at_eof, out)
+                spans = [res[f][:own_end].copy() for f in fields]
+                bad = res["escaped"][:own_end]
+                if defer_inexact:
+                    bad = bad | ~res["exact"][:own_end]
+                deferred.extend(buf, base)
+                bad_idx = np.flatnonzero(bad)
+                if len(bad_idx):
+                    for s in spans:
+                        s[bad_idx] = 0  # re-emitted by the deferral path
+                    deferred.add(base + bad_idx, buf, base)
+            if obs.enabled():
+                obs.count("check.windows")
+                obs.count("check.positions", own_end)
+                obs.count("check.deferred", len(bad_idx))
+                # The escaped sum is an O(own_end) pass — only pay it
+                # under a live registry.
+                obs.count(
+                    "check.escaped", int(res["escaped"][:own_end].sum())
+                )
             yield (base, *spans, buf) if with_buf else (base, *spans)
             for pos, row in deferred.resolve(at_eof, fields):
                 yield (pos, *row, None) if with_buf else (pos, *row)
@@ -453,6 +467,8 @@ class StreamChecker:
                 ring.pop(0).block_until_ready()
             windows += 1
             chunk += 1
+            obs.count("check.windows")
+            obs.count("check.positions", own_end)
             if self.progress is not None:
                 self.progress(windows, base + own_end, self.total)
             # One early escape checkpoint (window 4): escape-prone inputs
@@ -482,6 +498,7 @@ class StreamChecker:
             # Rare exact path (chains outran the halo — ultra-long reads):
             # the spans path resolves every deferral bit-exactly. Suppress
             # progress so consumers don't see the counters restart.
+            obs.count("check.count_escape_retries")
             saved, self.progress = self.progress, None
             try:
                 return self._count_via_spans()
@@ -584,6 +601,8 @@ class StreamChecker:
                 rows.append((buf, at_eof, lo, own_end))
                 windows_done += 1
                 pos_flushed = base + own_end
+                obs.count("check.windows")
+                obs.count("check.positions", own_end)
                 if len(rows) >= cap:
                     out = flush(rows)
                     rows = []
@@ -616,6 +635,7 @@ class StreamChecker:
             if not escaped and self.progress is not None and windows_done:
                 self.progress(windows_done, pos_flushed, self.total)
         if escaped:
+            obs.count("check.count_escape_retries")
             saved, self.progress = self.progress, None
             try:
                 return self._count_via_spans()
@@ -797,20 +817,46 @@ def full_check_summary_streaming(
             two_pos.append(base + twos)
             two_mask.append(fm[twos])
 
-    def cat(parts, dtype):
-        return (
-            np.concatenate(parts) if parts else np.empty(0, dtype=dtype)
-        )
+    def cat_sorted(pos_parts, mask_parts):
+        """Concatenate site arrays and restore ascending position order.
 
+        Deferred re-emissions land *behind* the tiling frontier (the span
+        contract above), so emission order is not ascending whenever any
+        position resolved through the deferral path — sort here so the
+        streaming summary's site order matches the in-memory path's.
+        """
+        pos = (
+            np.concatenate(pos_parts) if pos_parts
+            else np.empty(0, dtype=np.int64)
+        )
+        mask = (
+            np.concatenate(mask_parts) if mask_parts
+            else np.empty(0, dtype=np.int32)
+        )
+        if len(pos) > 1 and np.any(np.diff(pos) < 0):
+            order = np.argsort(pos, kind="stable")
+            pos, mask = pos[order], mask[order]
+        return pos, mask
+
+    if obs.enabled():
+        # Distinct name from check_flat's ``check.flag_refutations.*``:
+        # these totals are restricted to *considered* sites (and the device
+        # path never passes through check_flat), so the two would
+        # double-count under one name on the NumPy engine.
+        for i, name in enumerate(FLAG_NAMES):
+            obs.count(f"check.flag_fail_sites.{name}", int(per_flag[i]))
+
+    crit_pos_a, crit_mask_a = cat_sorted(crit_pos, crit_mask)
+    two_pos_a, two_mask_a = cat_sorted(two_pos, two_mask)
     return {
         "per_flag": {
             name: int(per_flag[i]) for i, name in enumerate(FLAG_NAMES)
         },
         "considered": considered_total,
-        "critical_positions": cat(crit_pos, np.int64),
-        "critical_masks": cat(crit_mask, np.int32),
-        "two_check_positions": cat(two_pos, np.int64),
-        "two_check_masks": cat(two_mask, np.int32),
+        "critical_positions": crit_pos_a,
+        "critical_masks": crit_mask_a,
+        "two_check_positions": two_pos_a,
+        "two_check_masks": two_mask_a,
         "positions": checker.total,
     }
 

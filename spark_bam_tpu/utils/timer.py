@@ -1,12 +1,16 @@
-"""Timers, heartbeats, and profiler hooks.
+"""Timers, heartbeats, and profiler hooks — thin shims over ``obs``.
 
 The reference's observability is wall-clock ``Timer.time`` blocks and
 heartbeat logging (SURVEY.md §5: ComputeSplits.scala:74-106,
 IndexBlocks.scala:34-45; its docs admit "no profiling having been done").
-Per the survey's recommendation we wire stage timers + the JAX profiler in
-from day one: ``profile_trace`` wraps any block in a TensorBoard-viewable
-device trace when ``SPARK_BAM_PROFILE_DIR`` is set, and is a no-op
-otherwise.
+These helpers predate the unified observability layer
+(``spark_bam_tpu.obs``) and are kept as shims: a named ``Timer`` feeds
+its duration into the live registry's ``timer.<name>`` histogram, and
+heartbeats bump ``progress.beats``. New instrumentation should use
+``obs.span``/``obs.counter`` directly. ``profile_trace`` wraps any block
+in a TensorBoard-viewable device trace when ``SPARK_BAM_PROFILE_DIR`` is
+set, and is a no-op otherwise — it composes with ``--metrics-out``
+(wall-clock spans and a device trace can capture the same run).
 """
 
 from __future__ import annotations
@@ -16,25 +20,38 @@ import logging
 import os
 import time
 
+from spark_bam_tpu import obs
+
 log = logging.getLogger(__name__)
 
 
 class Timer:
-    """Named stage timer: ``with Timer() as t: ...; t.ms``."""
+    """Named stage timer: ``with Timer() as t: ...; t.seconds / t.ms``.
+
+    ``seconds`` is the measured float duration; ``ms`` derives from it
+    (also float — the old int truncation erased sub-millisecond stages
+    entirely).
+    """
 
     def __init__(self, name: str = "", echo=None):
         self.name = name
         self.echo = echo
-        self.ms = 0
+        self.seconds = 0.0
+
+    @property
+    def ms(self) -> float:
+        return self.seconds * 1e3
 
     def __enter__(self) -> "Timer":
         self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, *exc):
-        self.ms = int((time.perf_counter() - self._t0) * 1000)
+        self.seconds = time.perf_counter() - self._t0
+        if self.name:
+            obs.observe(f"timer.{self.name}", self.ms, unit="ms")
         if self.echo is not None and self.name:
-            self.echo(f"{self.name}: {self.ms}ms")
+            self.echo(f"{self.name}: {self.ms:.3f}ms")
 
 
 @contextlib.contextmanager
@@ -44,6 +61,7 @@ def heartbeat(what: str, interval_seconds: float = 10.0):
 
     def beat(progress):
         nonlocal last
+        obs.count("progress.beats")
         now = time.monotonic()
         if now - last >= interval_seconds:
             log.info("%s: %s", what, progress)

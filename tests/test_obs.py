@@ -1,0 +1,269 @@
+"""Observability subsystem: registry semantics, span nesting, JSONL
+round-trip, exporter formats, the disabled no-op fast path, and the
+``--metrics-out`` / ``metrics-report`` CLI surface."""
+
+import pytest
+
+from spark_bam_tpu import obs
+from spark_bam_tpu.obs.exporters import (
+    prometheus_text,
+    stage_totals,
+    stats_summary,
+)
+from spark_bam_tpu.obs.registry import NOOP, Registry
+
+
+@pytest.fixture
+def reg():
+    obs.shutdown()
+    r = obs.configure()
+    yield r
+    obs.shutdown()
+
+
+# ---------------------------------------------------------------- registry
+
+
+def test_disabled_is_shared_noop_singleton():
+    obs.shutdown()
+    assert not obs.enabled()
+    assert obs.registry() is None
+    # Every entry point hands back the SAME object: zero allocation on
+    # instrumented hot loops when observability is off.
+    assert obs.span("x") is obs.span("y") is NOOP
+    assert obs.counter("c") is obs.gauge("g") is obs.histogram("h") is NOOP
+    obs.count("c", 5)
+    obs.observe("h", 1.0, unit="ms")
+    with obs.span("x", k=1) as s:
+        s.set(device_ms=3)  # attrs on the noop are swallowed too
+    assert obs.registry() is None
+
+
+def test_counter_gauge_histogram_semantics(reg):
+    c = obs.counter("bgzf.blocks_read")
+    c.inc()
+    c.inc(4)
+    assert obs.counter("bgzf.blocks_read") is c  # same series, same object
+    assert c.value == 5
+
+    g = obs.gauge("mem.peak")
+    g.set(10)
+    g.set(3)
+    assert g.value == 3 and g.max == 10  # last-write value, running peak
+
+    h = obs.histogram("lat", unit="ms")
+    for v in (2.0, 8.0, 5.0):
+        h.observe(v)
+    assert (h.count, h.sum, h.min, h.max) == (3, 15.0, 2.0, 8.0)
+    assert h.values == [2.0, 8.0, 5.0]
+
+
+def test_labeled_series_are_distinct(reg):
+    a = obs.counter("check.windows", kind="whole_file")
+    b = obs.counter("check.windows", kind="streaming")
+    a.inc()
+    assert a is not b and (a.value, b.value) == (1, 0)
+    # Label order does not split a series.
+    h1 = obs.histogram("x", unit="ms", stage="h2d")
+    h2 = obs.histogram("x", stage="h2d", unit="ms")
+    assert h1 is h2
+
+
+def test_count_observe_shorthand(reg):
+    obs.count("load.records", 7)
+    obs.observe("inflate.stall_ms", 2.5, unit="ms")
+    snap = reg.snapshot()
+    counters = {c["name"]: c["value"] for c in snap["counters"]}
+    assert counters["load.records"] == 7
+    hists = {h["name"]: h for h in snap["hists"]}
+    assert hists["inflate.stall_ms"]["count"] == 1
+
+
+# ------------------------------------------------------------------- spans
+
+
+def test_span_nesting_parent_depth_and_histogram(reg):
+    with obs.span("outer"):
+        with obs.span("inner", blocks=3):
+            pass
+        with obs.span("inner"):
+            pass
+    events = reg.events()
+    by_name = {}
+    for ev in events:
+        by_name.setdefault(ev["name"], []).append(ev)
+    # Children close before the parent: completion order in the trace.
+    assert [ev["name"] for ev in events] == ["inner", "inner", "outer"]
+    assert by_name["outer"][0]["depth"] == 0
+    assert "parent" not in by_name["outer"][0]
+    for ev in by_name["inner"]:
+        assert ev["depth"] == 1 and ev["parent"] == "outer"
+    assert by_name["inner"][0]["attrs"] == {"blocks": 3}
+    # Every span also feeds its per-name ms histogram.
+    hists = {h["name"]: h for h in reg.snapshot()["hists"]}
+    assert hists["inner"]["count"] == 2
+    assert hists["outer"]["count"] == 1
+
+
+def test_span_attrs_coerced_to_json_safe(reg):
+    class Opaque:
+        def __str__(self):
+            return "opaque!"
+
+    with obs.span("s", path=Opaque(), n=2, ok=True):
+        pass
+    attrs = reg.events()[-1]["attrs"]
+    assert attrs == {"path": "opaque!", "n": 2, "ok": True}
+
+
+def test_trace_event_cap_counts_drops(tmp_path):
+    r = Registry(max_events=2)
+    for _ in range(5):
+        with r.span("s"):
+            pass
+    assert len(r.events()) == 2
+    snap = r.snapshot()
+    assert snap["dropped_events"] == 3
+    # Dropped events still feed the duration histogram (aggregate survives).
+    hists = {h["name"]: h for h in snap["hists"]}
+    assert hists["s"]["count"] == 5
+
+
+# -------------------------------------------------------- JSONL round-trip
+
+
+def test_export_jsonl_round_trip(tmp_path, reg):
+    with obs.span("bgzf.read", kind="metadata_scan"):
+        with obs.span("inflate.block"):
+            pass
+    obs.count("bgzf.blocks_read", 3)
+    obs.gauge("mem.peak").set(9)
+    path = tmp_path / "trace.jsonl"
+    obs.export_jsonl(path)
+
+    events = list(obs.read_jsonl(path))
+    meta = events[0]
+    assert meta["e"] == "meta" and meta["version"] == 1 and meta["enabled"]
+    spans = [ev for ev in events if ev["e"] == "span"]
+    assert [s["name"] for s in spans] == ["inflate.block", "bgzf.read"]
+    assert spans[0]["parent"] == "bgzf.read"
+    counters = {ev["name"]: ev for ev in events if ev["e"] == "counter"}
+    assert counters["bgzf.blocks_read"]["value"] == 3
+    gauges = {ev["name"]: ev for ev in events if ev["e"] == "gauge"}
+    assert gauges["mem.peak"]["max"] == 9
+    # Span durations also arrive as hist snapshot lines.
+    hists = {ev["name"]: ev for ev in events if ev["e"] == "hist"}
+    assert hists["bgzf.read"]["count"] == 1
+
+
+def test_export_jsonl_disabled_writes_empty_run(tmp_path):
+    obs.shutdown()
+    path = tmp_path / "empty.jsonl"
+    obs.export_jsonl(path)
+    events = list(obs.read_jsonl(path))
+    assert len(events) == 1
+    assert events[0]["e"] == "meta" and events[0]["enabled"] is False
+
+
+# --------------------------------------------------------------- exporters
+
+
+def test_prometheus_text_format(reg):
+    obs.counter("bgzf.blocks_read").inc(2)
+    obs.gauge("mem.peak").set(7)
+    h = obs.histogram("inflate.window", unit="ms")
+    for v in (1.0, 2.0, 3.0):
+        h.observe(v)
+    text = prometheus_text(reg.snapshot())
+    assert "# TYPE bgzf_blocks_read counter" in text
+    assert "bgzf_blocks_read 2" in text
+    assert "# TYPE mem_peak gauge" in text
+    assert "# TYPE inflate_window summary" in text
+    assert 'inflate_window{quantile="0.5",unit="ms"} 2.0' in text
+    assert 'inflate_window_sum{unit="ms"} 6.0' in text
+    assert 'inflate_window_count{unit="ms"} 3' in text
+
+
+def test_stats_summary_and_stage_totals(reg):
+    obs.counter("load.records").inc(42)
+    h = obs.histogram("load.partition", unit="ms")
+    h.observe(5.0)
+    h.observe(7.0)
+    obs.histogram("mesh.patch_chunk_positions").observe(100.0)  # not ms
+    snap = reg.snapshot()
+    text = stats_summary(snap)
+    assert "load.partition[unit=ms]:" in text
+    assert "load.records: 42" in text
+    # stage_totals keeps only ms-unit series (per-stage bench breakdown).
+    totals = stage_totals(snap)
+    assert totals == {"load.partition": {"count": 2, "total_ms": 12.0}}
+
+
+# ------------------------------------------------------------- CLI surface
+
+
+def _small_bam(tmp_path):
+    from tests.bam_factories import random_bam
+
+    path = tmp_path / "smoke.bam"
+    random_bam(path, seed=11, n_records=(120, 121))
+    return path
+
+
+def test_cli_count_reads_metrics_out_smoke(tmp_path, capsys, monkeypatch):
+    """ISSUE acceptance: ``count-reads --metrics-out`` emits a valid JSONL
+    trace whose spans cover the bgzf/inflate/check/load stages, and
+    ``metrics-report`` renders it."""
+    from spark_bam_tpu.cli.main import main
+
+    monkeypatch.delenv("SPARK_BAM_METRICS_OUT", raising=False)
+    bam = _small_bam(tmp_path)
+    trace = tmp_path / "m.jsonl"
+    # A small split size forces several partitions through the
+    # find-block-start → find-record-start resolution path.
+    rc = main(
+        ["count-reads", "-m", "16k", "--metrics-out", str(trace), str(bam)]
+    )
+    assert rc == 0
+    assert not obs.enabled(), "CLI must shut the registry down on exit"
+
+    events = list(obs.read_jsonl(trace))
+    assert events[0]["e"] == "meta" and events[0]["enabled"]
+    names = {ev["name"] for ev in events if ev["e"] == "span"}
+    assert {
+        "cli.count-reads",
+        "load.count",
+        "load.partition",
+        "bgzf.read",
+        "check.find_record_start",
+        "inflate.block",
+    } <= names
+    roots = [
+        ev for ev in events
+        if ev["e"] == "span" and ev["name"] == "cli.count-reads"
+    ]
+    assert len(roots) == 1 and roots[0]["depth"] == 0
+    counters = {
+        ev["name"]: ev["value"] for ev in events if ev["e"] == "counter"
+    }
+    assert counters["bgzf.blocks_read"] > 0
+    assert counters["load.partitions"] > 0
+
+    capsys.readouterr()
+    rc = main(["metrics-report", str(trace)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "cli.count-reads" in out
+    assert "load.partition" in out
+    assert "bgzf.blocks_read" in out
+
+
+def test_cli_disabled_by_default(tmp_path, capsys, monkeypatch):
+    from spark_bam_tpu.cli.main import main
+
+    monkeypatch.delenv("SPARK_BAM_METRICS_OUT", raising=False)
+    bam = _small_bam(tmp_path)
+    rc = main(["count-reads", str(bam)])
+    assert rc == 0
+    assert not obs.enabled()
+    capsys.readouterr()

@@ -10,14 +10,37 @@ def test_timer_measures_and_echoes():
     lines = []
     with Timer("stage", echo=lines.append) as t:
         time.sleep(0.02)
+    assert isinstance(t.seconds, float) and isinstance(t.ms, float)
     assert t.ms >= 15
-    assert lines == [f"stage: {t.ms}ms"]
+    assert lines == [f"stage: {t.ms:.3f}ms"]
 
     # No name ⇒ silent even with an echo sink.
     lines.clear()
     with Timer(echo=lines.append):
         pass
     assert lines == []
+
+
+def test_timer_sub_millisecond_not_truncated():
+    # The old int(ms) truncation erased sub-ms stages; float ms keeps them.
+    with Timer("quick") as t:
+        time.sleep(0.001)
+    assert 0 < t.ms < 1000
+    assert t.ms == t.seconds * 1e3
+
+
+def test_named_timer_feeds_registry():
+    from spark_bam_tpu import obs
+
+    obs.shutdown()
+    reg = obs.configure()
+    try:
+        with Timer("stagex"):
+            pass
+        hists = {h["name"]: h for h in reg.snapshot()["hists"]}
+        assert hists["timer.stagex"]["count"] == 1
+    finally:
+        obs.shutdown()
 
 
 def test_heartbeat_rate_limits(caplog):

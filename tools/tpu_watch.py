@@ -64,12 +64,17 @@ def tunnel_alive(timeout_s: int = 90) -> bool:
         return False
 
 
-def run_bench(timeout_s: int) -> dict | None:
-    """One bench.py capture; returns the parsed JSON record (or None)."""
+def run_bench(timeout_s: int, trace_path: Path) -> dict | None:
+    """One bench.py capture; returns the parsed JSON record (or None).
+
+    The child runs with ``SPARK_BAM_METRICS_OUT`` pointing at
+    ``trace_path`` so bench.py's per-stage obs registry also lands on
+    disk as a JSONL trace (renderable with ``metrics-report``)."""
+    env = dict(os.environ, SPARK_BAM_METRICS_OUT=str(trace_path))
     try:
         out = subprocess.run(
             [sys.executable, str(REPO / "bench.py")],
-            capture_output=True, text=True, timeout=timeout_s,
+            capture_output=True, text=True, timeout=timeout_s, env=env,
         )
         for line in reversed(out.stdout.strip().splitlines()):
             try:
@@ -79,6 +84,21 @@ def run_bench(timeout_s: int) -> dict | None:
     except subprocess.TimeoutExpired:
         pass
     return None
+
+
+def _stage_line(trace_path: Path) -> str:
+    """Per-stage digest of the capture's obs trace (heaviest spans first);
+    degrades to a note when the child wrote no trace (old bench.py, crash
+    before export)."""
+    if not trace_path.exists():
+        return "(no trace written)"
+    sys.path.insert(0, str(REPO))
+    from spark_bam_tpu.obs.report import stage_summary_line
+
+    try:
+        return stage_summary_line(trace_path)
+    except (OSError, ValueError, KeyError) as e:
+        return f"(trace unreadable: {e})"
 
 
 def main():
@@ -103,13 +123,16 @@ def main():
         elif tunnel_alive():
             print(f"[{time.strftime('%H:%M:%S')}] tunnel ALIVE — capturing",
                   flush=True)
-            rec = run_bench(args.bench_timeout)
+            trace = REPO / f"BENCH_TRACE_{time.strftime('%Y%m%d_%H%M%S')}.jsonl"
+            rec = run_bench(args.bench_timeout, trace)
             if rec is not None and rec.get("backend") == "tpu":
                 captures += 1
                 print(f"[{time.strftime('%H:%M:%S')}] capture {captures}: "
                       f"value={rec.get('value')} "
                       f"vs_baseline={rec.get('vs_baseline')} "
                       f"source={rec.get('value_source')}", flush=True)
+                print(f"[{time.strftime('%H:%M:%S')}] stages: "
+                      f"{_stage_line(trace)}", flush=True)
                 if args.follow:
                     try:
                         subprocess.run(args.follow, shell=True,
