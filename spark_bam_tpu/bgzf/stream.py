@@ -23,23 +23,38 @@ from typing import Iterator, Optional
 
 from spark_bam_tpu import obs
 from spark_bam_tpu.bgzf.block import Block, Metadata, FOOTER_SIZE
-from spark_bam_tpu.bgzf.header import Header
+from spark_bam_tpu.bgzf.header import Header, HeaderParseException
 from spark_bam_tpu.core.channel import ByteChannel
+from spark_bam_tpu.core.faults import (
+    BlockCorruptionError,
+    BlockGapError,
+    ShortReadError,
+)
 from spark_bam_tpu.core.pos import Pos
 
 
 def inflate_block_payload(comp: bytes | memoryview, uncompressed_size: int) -> bytes:
     """Raw-DEFLATE inflate of one block payload (reference Stream.scala:49-54)."""
-    data = zlib.decompress(bytes(comp), wbits=-15, bufsize=max(uncompressed_size, 1))
+    try:
+        data = zlib.decompress(
+            bytes(comp), wbits=-15, bufsize=max(uncompressed_size, 1)
+        )
+    except zlib.error as e:
+        raise BlockCorruptionError(f"BGZF payload inflate failed: {e}") from e
     if len(data) != uncompressed_size:
-        raise IOError(
+        raise BlockCorruptionError(
             f"Expected {uncompressed_size} decompressed bytes, found {len(data)}"
         )
     return data
 
 
 def read_block(ch: ByteChannel) -> Optional[Block]:
-    """Read + inflate the block at the channel position; None at EOF sentinel/EOF."""
+    """Read + inflate the block at the channel position; None at EOF sentinel/EOF.
+
+    The ISIZE length check and CRC32 verification classify damaged payloads
+    as ``BlockCorruptionError`` (unrecoverable — retrying re-reads the same
+    bytes), distinct from the retryable transport-level errors.
+    """
     start = ch.position()
     try:
         header = Header.read(ch)
@@ -57,6 +72,12 @@ def read_block(ch: ByteChannel) -> Optional[Block]:
     # None-check. Counters track read vs inflate volume either way.
     with obs.span("inflate.block", start=start):
         data = inflate_block_payload(payload[:data_length], uncompressed_size)
+    crc = int.from_bytes(payload[data_length:data_length + 4], "little")
+    if zlib.crc32(data) & 0xFFFFFFFF != crc:
+        raise BlockCorruptionError(
+            f"BGZF block at {start}: CRC32 mismatch "
+            f"(stored {crc:#010x}, computed {zlib.crc32(data) & 0xFFFFFFFF:#010x})"
+        )
     obs.count("bgzf.blocks_read")
     obs.count("bgzf.bytes_read", header.compressed_size)
     obs.count("bgzf.bytes_inflated", uncompressed_size)
@@ -64,18 +85,67 @@ def read_block(ch: ByteChannel) -> Optional[Block]:
 
 
 class BlockStream:
-    """Iterator of decompressed Blocks from a channel (reference ``Stream``)."""
+    """Iterator of decompressed Blocks from a channel (reference ``Stream``).
 
-    def __init__(self, ch: ByteChannel):
+    ``tolerant=False`` (default, the historical semantics + anomaly
+    classification): a genuinely truncated file still ends cleanly, but
+    mid-file byte loss raises retryable ``ShortReadError`` and a damaged
+    block raises ``BlockCorruptionError`` — no more silent truncation.
+
+    ``tolerant=True`` (``FaultPolicy.mode=tolerant``): a damaged block is
+    quarantined instead — the stream re-syncs to the next sound block
+    header (``find_block_start``), records the gap in ``self.quarantined``,
+    and raises ``BlockGapError`` once so the caller can account for the gap
+    (the record layer re-finds a record boundary; a plain block consumer
+    may simply continue iterating — the channel is already positioned at
+    the resync point).
+    """
+
+    def __init__(self, ch: ByteChannel, tolerant: bool = False):
         self.ch = ch
+        self.tolerant = tolerant
+        self.quarantined: list[BlockGapError] = []
         self._head: Optional[Block] = None
         self._done = False
 
     def _advance(self) -> Optional[Block]:
+        start = self.ch.position()
         try:
             return read_block(self.ch)
-        except EOFError:
-            return None
+        except EOFError as e:
+            if self.ch.position() >= self.ch.size:
+                # The missing bytes never existed (truncated file): clean
+                # stream end, the reference's tolerant-truncation shape.
+                return None
+            err = ShortReadError(
+                f"mid-file EOF in block at {start} "
+                f"(channel at {self.ch.position()} of {self.ch.size}): {e}"
+            )
+            if not self.tolerant:
+                raise err from e
+            self._resync(start, err)
+        except (BlockCorruptionError, HeaderParseException) as e:
+            if not self.tolerant:
+                raise
+            self._resync(start, e)
+
+    def _resync(self, damaged_start: int, err: Exception) -> None:
+        """Quarantine the damaged block: position the channel at the next
+        sound block header and raise ``BlockGapError`` describing the gap."""
+        from spark_bam_tpu.bgzf.find_block_start import find_block_start
+        from spark_bam_tpu.bgzf.header import HeaderSearchFailedException
+
+        try:
+            resync = find_block_start(self.ch, damaged_start + 1)
+        except (HeaderSearchFailedException, EOFError):
+            resync = None
+        self.ch.seek(resync if resync is not None else self.ch.size)
+        gap = BlockGapError(
+            damaged_start, resync, f"{type(err).__name__}: {err}"
+        )
+        self.quarantined.append(gap)
+        obs.count("faults.quarantined_blocks")
+        raise gap from err
 
     def head(self) -> Optional[Block]:
         if self._head is None and not self._done:
@@ -106,8 +176,8 @@ class SeekableBlockStream(BlockStream):
 
     MAX_CACHE_SIZE = 100
 
-    def __init__(self, ch: ByteChannel):
-        super().__init__(ch)
+    def __init__(self, ch: ByteChannel, tolerant: bool = False):
+        super().__init__(ch, tolerant=tolerant)
         self._cache: OrderedDict[int, Block] = OrderedDict()
 
     def _advance(self) -> Optional[Block]:
@@ -260,8 +330,8 @@ class SeekableUncompressedBytes(UncompressedBytes):
         self.stream: SeekableBlockStream = stream
 
     @staticmethod
-    def open(ch: ByteChannel) -> "SeekableUncompressedBytes":
-        return SeekableUncompressedBytes(SeekableBlockStream(ch))
+    def open(ch: ByteChannel, tolerant: bool = False) -> "SeekableUncompressedBytes":
+        return SeekableUncompressedBytes(SeekableBlockStream(ch, tolerant=tolerant))
 
     def seek(self, pos: Pos) -> None:
         self.stream.seek(pos.block_pos)

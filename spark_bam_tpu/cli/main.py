@@ -38,8 +38,24 @@ def _add_metrics(sub):
     )
 
 
+def _add_faults(sub):
+    sub.add_argument(
+        "--faults", default=None, metavar="SPEC",
+        help="fault-tolerance policy for partition execution, e.g. "
+             "'retries=3,backoff=0.05,deadline=60,hedge=2,mode=tolerant' "
+             "(SPARK_BAM_FAULTS env var works too; docs/robustness.md)",
+    )
+    sub.add_argument(
+        "--chaos", default=None, metavar="SEED:SPEC",
+        help="deterministic fault injection on every opened channel, e.g. "
+             "'7:io=0.1,latency=0.05x10,short=0.02,corrupt=1e-6' — same "
+             "seed replays the same faults (docs/robustness.md)",
+    )
+
+
 def _add_common(sub, split_default=None):
     _add_metrics(sub)
+    _add_faults(sub)
     sub.add_argument("-m", "--max-split-size", default=split_default,
                      help="split size (byte shorthand like 2MB ok)")
     sub.add_argument("-l", "--print-limit", type=int, default=10)
@@ -191,6 +207,21 @@ def main(argv=None) -> int:
         if value is not None:
             config = config.replace(**{knob: value})
 
+    from spark_bam_tpu.core.faults import FaultPolicy, install_chaos, uninstall_chaos
+    from spark_bam_tpu.parallel.executor import last_report, reset_last_report
+
+    chaos_state = None
+    try:
+        if getattr(args, "faults", None):
+            FaultPolicy.parse(args.faults)  # fail before any work starts
+            config = config.replace(faults=args.faults)
+        if getattr(args, "chaos", None):
+            chaos_state = install_chaos(args.chaos)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    reset_last_report()
+
     # --metrics-out (or the env var) turns the process-wide registry on
     # for this run; everything below the root ``cli.<command>`` span
     # records into it and the trace is written on the way out.
@@ -304,6 +335,18 @@ def main(argv=None) -> int:
             from spark_bam_tpu.cli import metrics_report
 
             metrics_report.run(args.trace, p)
+        # Fault-tolerance postscript: whenever partition execution had to
+        # retry/hedge/quarantine, say so (the quarantine list is the
+        # operator's cue that the output is a degraded-but-complete run).
+        rep = last_report()
+        if rep is not None and (rep.retries or rep.hedges or rep.quarantined):
+            p.echo(rep.summary())
+        if chaos_state is not None:
+            injected = ", ".join(
+                f"{k}={v}" for k, v in chaos_state.injected.items() if v
+            )
+            p.echo(f"chaos(seed={chaos_state.seed}): injected "
+                   f"{injected or 'nothing'}")
         return 0
     except UsageError as e:
         # Flag-combination errors (e.g. --sharded with -u or CRAM) present
@@ -311,6 +354,8 @@ def main(argv=None) -> int:
         print(f"error: {e}", file=sys.stderr)
         return 2
     finally:
+        if chaos_state is not None:
+            uninstall_chaos()
         root_span.__exit__(None, None, None)
         if metrics_out:
             # Export after the root span closes so it lands in the trace;

@@ -201,6 +201,19 @@ class CachingChannel(ByteChannel):
 # fakes; deployments can register gs://, s3://, … backends).
 _SCHEMES: dict = {}
 
+# Chaos injection seam (core/faults.py): when installed, every channel
+# ``open_channel`` hands out is wrapped so deterministic faults reach every
+# consumer. A plain module attribute (not an import of faults) so the
+# disabled path costs one ``is None`` test and no import cycle exists.
+_CHAOS_WRAPPER = None
+
+
+def set_chaos_wrapper(wrapper) -> None:
+    """Install ``wrapper(ch, path) -> ByteChannel`` over every opened
+    channel (``faults.install_chaos``); ``None`` uninstalls."""
+    global _CHAOS_WRAPPER
+    _CHAOS_WRAPPER = wrapper
+
 _URL_RE = re.compile(r"^([a-z][a-z0-9+.-]*)://")
 
 
@@ -289,6 +302,8 @@ def open_channel(path, cached: bool = False) -> ByteChannel:
             )
         else:
             raise ValueError(f"no channel backend for scheme {scheme!r}: {s}")
-        return CachingChannel(ch) if cached else ch
-    ch = MMapChannel(path)
+    else:
+        ch = MMapChannel(path)
+    if _CHAOS_WRAPPER is not None:
+        ch = _CHAOS_WRAPPER(ch, s)
     return CachingChannel(ch) if cached else ch

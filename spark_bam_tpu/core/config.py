@@ -92,6 +92,11 @@ class Config:
     # because resident chunks hold ~1 GiB of HBM and the count is the only
     # projection the scan kernel serves.
     resident_scan: bool = False
+    # --- fault tolerance (core/faults.py; docs/robustness.md) ---
+    # Compact FaultPolicy spec ("retries=3,deadline=60,mode=tolerant"; "" =
+    # defaults). Kept as the string form so the frozen dataclass stays
+    # hashable/env-roundtrippable; ``fault_policy`` parses it (cached).
+    faults: str = ""
     # --- misc ---
     warn: bool = False                  # root log-level toggle (args/LogArgs.scala:30-33)
     # Accepted for config-surface parity (PostPartitionArgs -p, default
@@ -111,6 +116,13 @@ class Config:
         ``backend=pallas``, else the XLA pass) — the single mapping every
         tier consults (StreamChecker, the CLI, the mesh steps)."""
         return "pallas" if self.backend == "pallas" else "xla"
+
+    @property
+    def fault_policy(self):
+        """The parsed ``FaultPolicy`` for this config's ``faults`` spec."""
+        from spark_bam_tpu.core.faults import FaultPolicy
+
+        return FaultPolicy.parse(self.faults)
 
     def split_size_or(self, default: int) -> int:
         return self.split_size if self.split_size is not None else default

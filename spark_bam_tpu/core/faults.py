@@ -1,0 +1,428 @@
+"""Fault model: retry/hedge/quarantine policy + deterministic chaos injection.
+
+The reference gets partition-level fault tolerance for free from Spark
+(failed tasks are retried, stragglers are speculatively re-executed); the
+host-side substrate (parallel/executor.py) replaced the Spark driver with a
+bare pool, so until this layer existed a single transient I/O error killed
+an entire multi-hour load. Two halves live here:
+
+- ``FaultPolicy`` — what the resilient executor is allowed to do about a
+  failing partition: bounded retries with jittered exponential backoff, a
+  per-attempt deadline, speculative re-execution of stragglers ("hedging",
+  the Spark-speculation analog), and the ``strict`` | ``tolerant``
+  degradation mode (raise vs quarantine-and-continue). Parseable from a
+  compact ``k=v,...`` spec so it threads through config/env/CLI unchanged
+  (``Config.faults`` / ``SPARK_BAM_FAULTS`` / ``--faults``).
+
+- ``ChaosChannel`` — a seeded, deterministic ``ByteChannel`` wrapper that
+  injects transient ``IOError``s, latency spikes, short reads, and byte
+  corruption, each decided by an offset-keyed splitmix64 hash so the fault
+  *set* is reproducible across runs (same seed ⇒ same faulty offsets ⇒ same
+  recovery story). Transient faults fire once per offset (shared across all
+  channels of one installation), so a partition retry makes progress the
+  way a real transient blip does. ``install_chaos("SEED:SPEC")`` wraps
+  every channel ``open_channel`` hands out (the ``--chaos`` CLI flag).
+
+Proofs live in tests/test_faults.py; semantics in docs/robustness.md.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import random
+import threading
+import time
+from dataclasses import dataclass
+from functools import lru_cache
+
+from spark_bam_tpu import obs
+from spark_bam_tpu.core import channel as _channel
+from spark_bam_tpu.core.channel import ByteChannel
+
+
+class Unrecoverable:
+    """Marker mixin: errors that retrying can never fix (corruption, parse
+    failures with deterministic inputs). The resilient executor fails such
+    attempts immediately instead of burning its retry budget."""
+
+
+#: OSError subclasses that are deterministic in practice — retrying a
+#: missing file three times only delays the real error.
+_NONRETRYABLE_OS = (
+    FileNotFoundError,
+    PermissionError,
+    IsADirectoryError,
+    NotADirectoryError,
+)
+
+
+def retryable(exc: BaseException) -> bool:
+    """Is this exception worth a fresh attempt? Transient transport errors
+    (the OSError family, timeouts) are; corruption (``Unrecoverable``),
+    deterministic filesystem errors, and everything else are not."""
+    if isinstance(exc, Unrecoverable):
+        return False
+    if isinstance(exc, _NONRETRYABLE_OS):
+        return False
+    return isinstance(exc, (OSError, TimeoutError))
+
+
+class ShortReadError(IOError):
+    """Mid-file byte loss: the channel reported more bytes than it
+    delivered (EOF before ``channel.size``). Retryable — the transient-
+    short-read signature; a genuinely truncated file EOFs *at* its size
+    and keeps the historical clean-truncation semantics instead."""
+
+
+class BlockCorruptionError(IOError, Unrecoverable):
+    """A BGZF block failed CRC32/inflate — deterministic damage that no
+    retry fixes. Strict mode raises it; tolerant mode quarantines."""
+
+
+class BlockGapError(IOError, Unrecoverable):
+    """Tolerant-mode resync marker: the block at ``damaged_start`` was
+    unreadable and the stream's next sound block starts at ``resync``
+    (``None`` when no further block header chains — damage runs to EOF).
+    Raised by a tolerant ``BlockStream`` so the record layer can re-find a
+    record boundary past the gap and continue (load/api.py)."""
+
+    def __init__(self, damaged_start: int, resync: int | None, reason: str):
+        super().__init__(
+            f"unreadable BGZF block at {damaged_start} "
+            f"(resync at {resync}): {reason}"
+        )
+        self.damaged_start = damaged_start
+        self.resync = resync
+        self.reason = reason
+
+
+# ------------------------------------------------------------------ policy
+@dataclass(frozen=True)
+class FaultPolicy:
+    """What the resilient executor may do about a failing/straggling
+    partition. The default is production-lenient on transients (3 retries)
+    and strict on outcomes (exhausted retries raise)."""
+
+    max_retries: int = 3        # retries beyond the first attempt
+    backoff_base: float = 0.05  # s; doubles per retry
+    backoff_max: float = 5.0    # s; backoff ceiling
+    jitter: float = 0.5         # fraction of each delay randomized away
+    deadline: float | None = None     # s per attempt; None = unbounded
+    hedge_after: float | None = None  # launch a twin at N× median latency
+    mode: str = "strict"        # strict (raise) | tolerant (quarantine)
+
+    MODES = ("strict", "tolerant")
+
+    def __post_init__(self):
+        if self.mode not in self.MODES:
+            raise ValueError(
+                f"Unknown fault mode {self.mode!r}: expected one of "
+                f"{', '.join(self.MODES)}"
+            )
+        if self.max_retries < 0:
+            raise ValueError(f"max_retries must be >= 0: {self.max_retries}")
+
+    @property
+    def tolerant(self) -> bool:
+        return self.mode == "tolerant"
+
+    def backoff_delay(self, attempt: int, rng=random) -> float:
+        """Jittered exponential backoff before retry ``attempt + 1``."""
+        d = min(self.backoff_max, self.backoff_base * (2 ** attempt))
+        return d * (1 - self.jitter + self.jitter * rng.random())
+
+    _KEYS = {
+        "retries": "max_retries",
+        "max_retries": "max_retries",
+        "backoff": "backoff_base",
+        "backoff_base": "backoff_base",
+        "backoff_max": "backoff_max",
+        "jitter": "jitter",
+        "deadline": "deadline",
+        "hedge": "hedge_after",
+        "hedge_after": "hedge_after",
+        "mode": "mode",
+    }
+
+    @staticmethod
+    @lru_cache(maxsize=64)
+    def parse(spec: str) -> "FaultPolicy":
+        """``"retries=3,backoff=0.05,deadline=60,hedge=2,mode=tolerant"``
+        (any subset; ``""`` ⇒ defaults). ``hedge``/``deadline`` accept
+        ``off``/``none`` to disable explicitly."""
+        kw: dict = {}
+        for part in (spec or "").split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if "=" not in part:
+                raise ValueError(f"Bad fault-policy entry {part!r} in {spec!r}")
+            key, value = (t.strip() for t in part.split("=", 1))
+            field = FaultPolicy._KEYS.get(key.replace("-", "_"))
+            if field is None:
+                raise ValueError(
+                    f"Unknown fault-policy key {key!r}: expected one of "
+                    f"{', '.join(sorted(set(FaultPolicy._KEYS)))}"
+                )
+            if field == "mode":
+                kw[field] = value
+            elif field == "max_retries":
+                kw[field] = int(value)
+            elif field in ("deadline", "hedge_after") and value.lower() in (
+                "off", "none", ""
+            ):
+                kw[field] = None
+            else:
+                kw[field] = float(value)
+        return FaultPolicy(**kw)
+
+    @staticmethod
+    def from_env(env=None) -> "FaultPolicy":
+        import os
+
+        return FaultPolicy.parse((env or os.environ).get("SPARK_BAM_FAULTS", ""))
+
+
+def with_retries(fn, policy: "FaultPolicy", what: str = "operation"):
+    """Run a driver-side callable under the policy's retry schedule.
+
+    The executor covers partition work; this covers the small driver-level
+    reads that precede it (header parse, split planning) so a transient
+    fault there doesn't kill the job either. Returns ``fn()``'s value;
+    exhausted retries re-raise the last error (driver reads have no
+    quarantine analog — nothing downstream exists without them)."""
+    last: BaseException | None = None
+    for attempt in range(policy.max_retries + 1):
+        try:
+            return fn()
+        except Exception as e:
+            last = e
+            if not retryable(e) or attempt == policy.max_retries:
+                raise
+            obs.count("faults.retries")
+            time.sleep(policy.backoff_delay(attempt))
+    raise last  # unreachable; satisfies control-flow analysis
+
+
+# ------------------------------------------------------------------- chaos
+class ChaosError(IOError):
+    """Injected transient I/O failure (retryable by design)."""
+
+
+_M64 = (1 << 64) - 1
+# Distinct streams per fault kind so the same offset rolls independently.
+_K_IO, _K_LATENCY, _K_SHORT, _K_CORRUPT = 1, 2, 3, 4
+
+
+def _mix(seed: int, kind: int, x: int) -> int:
+    """splitmix64 finalizer over (seed, kind, offset) — the deterministic
+    per-offset randomness source (reproducible across runs/platforms)."""
+    z = (x + seed * 0x9E3779B97F4A7C15 + kind * 0xD1B54A32D192ED03) & _M64
+    z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & _M64
+    z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & _M64
+    return z ^ (z >> 31)
+
+
+def _roll(seed: int, kind: int, x: int, rate: float) -> bool:
+    return rate > 0 and (_mix(seed, kind, x) >> 11) < rate * (1 << 53)
+
+
+@dataclass(frozen=True)
+class ChaosSpec:
+    """Which faults to inject and how often. Rates are per *read request*
+    (keyed by its byte offset), except ``corrupt`` which is per byte."""
+
+    io: float = 0.0        # transient IOError rate
+    latency: float = 0.0   # latency-spike rate
+    latency_ms: float = 10.0
+    short: float = 0.0     # short-read rate
+    corrupt: float = 0.0   # per-byte corruption rate
+
+    @staticmethod
+    def parse(spec: str) -> "ChaosSpec":
+        """``"io=0.1,latency=0.05x10,short=0.02,corrupt=1e-6"`` — latency's
+        optional ``xMS`` suffix sets the spike length (default 10 ms)."""
+        kw: dict = {}
+        for part in (spec or "").split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if "=" not in part:
+                raise ValueError(f"Bad chaos entry {part!r} in {spec!r}")
+            key, value = (t.strip() for t in part.split("=", 1))
+            if key == "latency":
+                if "x" in value:
+                    rate, ms = value.split("x", 1)
+                    kw["latency"], kw["latency_ms"] = float(rate), float(ms)
+                else:
+                    kw["latency"] = float(value)
+            elif key in ("io", "short", "corrupt"):
+                kw[key] = float(value)
+            else:
+                raise ValueError(
+                    f"Unknown chaos key {key!r}: expected io, latency, "
+                    f"short, or corrupt"
+                )
+        return ChaosSpec(**kw)
+
+
+def parse_chaos(arg: str) -> tuple[int, ChaosSpec]:
+    """``"SEED:SPEC"`` (the ``--chaos`` argument shape)."""
+    seed, _, spec = arg.partition(":")
+    try:
+        seed_i = int(seed)
+    except ValueError:
+        raise ValueError(f"Bad chaos seed {seed!r} in {arg!r} (want SEED:SPEC)")
+    return seed_i, ChaosSpec.parse(spec)
+
+
+#: Transient-fault blast radius: one fired fault suppresses further
+#: transient faults of its kind within this many bytes (aligned region).
+#: Models a time/locality-correlated blip — the retry that re-reads the
+#: neighborhood succeeds, the way a real hiccup clears — so recovery cost
+#: scales with damaged *regions*, not with every unlucky request offset.
+_TRANSIENT_RADIUS_BITS = 12  # 4 KiB
+
+
+class ChaosState:
+    """Shared across every ChaosChannel of one installation: transient-
+    fault consumption (a fault fires once per 4 KiB region, so a partition
+    retry that re-reads the file makes progress) and injected-fault tallies
+    for assertions/reporting."""
+
+    def __init__(self, seed: int, spec: ChaosSpec):
+        self.seed = seed
+        self.spec = spec
+        self.lock = threading.Lock()
+        self.consumed: set[tuple[int, int]] = set()
+        self.injected: dict[str, int] = {
+            "io": 0, "latency": 0, "short": 0, "corrupt": 0
+        }
+
+    def _note(self, kind: str, n: int = 1) -> None:
+        with self.lock:
+            self.injected[kind] += n
+
+    def _consume_once(self, kind: int, pos: int) -> bool:
+        """True the first time a (kind, region) fault fires."""
+        key = (kind, pos >> _TRANSIENT_RADIUS_BITS)
+        with self.lock:
+            if key in self.consumed:
+                return False
+            self.consumed.add(key)
+            return True
+
+
+class ChaosChannel(ByteChannel):
+    """Deterministic fault-injecting wrapper around any ``ByteChannel``.
+
+    Fault decisions are pure functions of (seed, kind, offset); transient
+    kinds (io, short) additionally fire only on the offset's first access
+    (shared ``ChaosState``), so retries recover the way they would from a
+    real transient blip while the fault set stays replayable. Corruption is
+    a pure per-byte function — persistent damage, the quarantine test case.
+    """
+
+    def __init__(self, inner: ByteChannel, seed: int, spec: ChaosSpec,
+                 state: ChaosState | None = None):
+        super().__init__()
+        self.inner = inner
+        self.state = state or ChaosState(seed, spec)
+        self.seed = self.state.seed
+        self.spec = self.state.spec
+
+    def _read_at(self, pos: int, n: int) -> bytes:
+        if n <= 0:
+            return self.inner.read_at(pos, n)
+        seed, spec, state = self.seed, self.spec, self.state
+        if _roll(seed, _K_LATENCY, pos, spec.latency):
+            state._note("latency")
+            obs.count("chaos.latency_spikes")
+            time.sleep(spec.latency_ms / 1e3)
+        if _roll(seed, _K_IO, pos, spec.io) and state._consume_once(_K_IO, pos):
+            state._note("io")
+            obs.count("chaos.io_errors")
+            raise ChaosError(
+                f"chaos(seed={seed}): injected transient IOError at "
+                f"offset {pos}"
+            )
+        data = self.inner.read_at(pos, n)
+        if (
+            len(data) > 1
+            and _roll(seed, _K_SHORT, pos, spec.short)
+            and state._consume_once(_K_SHORT, pos)
+        ):
+            state._note("short")
+            obs.count("chaos.short_reads")
+            data = data[: len(data) // 2]
+        if spec.corrupt > 0 and data:
+            data = self._corrupt(pos, data)
+        return data
+
+    def _corrupt(self, pos: int, data: bytes) -> bytes:
+        import numpy as np
+
+        offs = np.arange(pos, pos + len(data), dtype=np.uint64)
+        z = (
+            offs
+            + np.uint64((self.seed * 0x9E3779B97F4A7C15) & _M64)
+            + np.uint64((_K_CORRUPT * 0xD1B54A32D192ED03) & _M64)
+        )
+        z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+        z = z ^ (z >> np.uint64(31))
+        mask = (z >> np.uint64(11)) < np.uint64(int(self.spec.corrupt * (1 << 53)))
+        if not mask.any():
+            return data
+        out = np.frombuffer(data, dtype=np.uint8).copy()
+        # Nonzero flip so a "corrupted" byte always actually changes.
+        out[mask] ^= (z[mask] & np.uint64(0xFF)).astype(np.uint8) | np.uint8(1)
+        hits = int(mask.sum())
+        self.state._note("corrupt", hits)
+        obs.count("chaos.corrupted_bytes", hits)
+        return out.tobytes()
+
+    @property
+    def size(self) -> int:
+        return self.inner.size
+
+    def close(self) -> None:
+        self.inner.close()
+
+
+# ------------------------------------------------- process-wide installation
+_installed: ChaosState | None = None
+
+
+def install_chaos(arg: str | tuple[int, ChaosSpec]) -> ChaosState:
+    """Wrap every channel ``open_channel`` hands out from now on in a
+    ``ChaosChannel`` sharing one ``ChaosState`` (the ``--chaos`` flag).
+    Returns the state for fault-tally inspection."""
+    global _installed
+    seed, spec = parse_chaos(arg) if isinstance(arg, str) else arg
+    state = ChaosState(seed, spec)
+    _installed = state
+    _channel.set_chaos_wrapper(
+        lambda ch, path: ChaosChannel(ch, seed, spec, state=state)
+    )
+    return state
+
+
+def uninstall_chaos() -> None:
+    global _installed
+    _installed = None
+    _channel.set_chaos_wrapper(None)
+
+
+def installed_chaos() -> ChaosState | None:
+    return _installed
+
+
+@contextlib.contextmanager
+def chaos(arg: str | tuple[int, ChaosSpec]):
+    """``with chaos("7:io=0.1"): ...`` — scoped installation for tests."""
+    state = install_chaos(arg)
+    try:
+        yield state
+    finally:
+        uninstall_chaos()

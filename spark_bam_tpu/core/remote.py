@@ -43,7 +43,9 @@ def _parse_retry_after(value: str | None) -> float:
         from email.utils import parsedate_to_datetime
 
         dt = parsedate_to_datetime(value)
-        return dt.timestamp() - time.time()
+        # An HTTP-date already in the past must not yield a negative wait
+        # (callers feed this to sleep schedules): retry immediately instead.
+        return max(0.0, dt.timestamp() - time.time())
     except (TypeError, ValueError, OverflowError):
         return 0.0
 

@@ -28,6 +28,11 @@ from spark_bam_tpu.bgzf.stream import SeekableBlockStream, SeekableUncompressedB
 from spark_bam_tpu.check.eager import EagerChecker
 from spark_bam_tpu.core.channel import open_channel, path_exists, path_size
 from spark_bam_tpu.core.config import Config
+from spark_bam_tpu.core.faults import (
+    BlockCorruptionError,
+    BlockGapError,
+    with_retries,
+)
 from spark_bam_tpu.core.pos import Pos
 from spark_bam_tpu.load.dataset import Dataset
 from spark_bam_tpu.load.intervals import LociSet
@@ -52,13 +57,21 @@ def _resolve_split_start(path, split: FileSplit, header: BamHeader, config: Conf
             )
     if block_start >= split.end:
         return None
+    tolerant = config.fault_policy.tolerant
     with obs.span("check.find_record_start", block=block_start):
-        if config.backend != "python":
+        # Tolerant mode pins the Python checker: it streams lazily (only
+        # the records it actually checks), so damage beyond the boundary
+        # scan can't fail resolution, and a damaged block *inside* it
+        # surfaces as a BlockGapError we can resync past — the native
+        # window scan eagerly inflates far ahead with no gap story.
+        if config.backend != "python" and not tolerant:
             pos = _native_next_read_start(path, block_start, header, config)
             if pos is not NotImplemented:
                 return pos
         checker = EagerChecker(
-            SeekableUncompressedBytes(SeekableBlockStream(open_channel(path))),
+            SeekableUncompressedBytes(
+                SeekableBlockStream(open_channel(path), tolerant=tolerant)
+            ),
             header.contig_lengths,
             config.reads_to_check,
         )
@@ -70,6 +83,14 @@ def _resolve_split_start(path, split: FileSplit, header: BamHeader, config: Conf
             return checker.next_read_start(
                 Pos(block_start, 0), config.max_read_size
             )
+        except BlockGapError as gap:
+            # Tolerant only: the boundary scan itself ran into a damaged
+            # block; resume the search past the gap (None ⇒ the partition's
+            # range is lost with the damage).
+            pos = _tolerant_record_resync(path, gap, header, config)
+            if pos is None or pos.block_pos >= split.end:
+                return None
+            return pos
         finally:
             checker.close()
 
@@ -187,18 +208,72 @@ def _native_next_read_start(path, block_start: int, header: BamHeader, config: C
             confirm.close()
 
 
+def _tolerant_record_resync(path, gap: BlockGapError, header: BamHeader,
+                            config: Config):
+    """After a quarantined block gap: the first provable record boundary at
+    or past the resynced block, or None when the damage runs to EOF or no
+    boundary can be proven (the rest of the partition is lost with it).
+    Mirrors split resolution — find-block-start already happened in the
+    stream's resync; this is the find-record-start half."""
+    from spark_bam_tpu.check.checker import NoReadFoundException
+    from spark_bam_tpu.bgzf.header import HeaderParseException
+
+    if gap.resync is None:
+        return None
+    checker = EagerChecker(
+        SeekableUncompressedBytes(
+            SeekableBlockStream(open_channel(path), tolerant=True)
+        ),
+        header.contig_lengths,
+        config.reads_to_check,
+    )
+    try:
+        return checker.next_read_start(Pos(gap.resync, 0), config.max_read_size)
+    except BlockGapError as nxt:
+        # The resync region is damaged too; chase the next gap (resync
+        # offsets strictly increase, so this terminates).
+        if nxt.resync is None or nxt.resync <= gap.resync:
+            return None
+        return _tolerant_record_resync(path, nxt, header, config)
+    except (NoReadFoundException, BlockCorruptionError, HeaderParseException,
+            EOFError):
+        return None
+    finally:
+        checker.close()
+
+
 def _iter_split_records(path, split: FileSplit, header: BamHeader, config: Config):
     with obs.span("load.partition", split=split.start):
         start_pos = _resolve_split_start(path, split, header, config)
     if start_pos is None:
         return
+    tolerant = config.fault_policy.tolerant
     stream = SeekableRecordStream(
-        SeekableUncompressedBytes(SeekableBlockStream(open_channel(path))), header
+        SeekableUncompressedBytes(
+            SeekableBlockStream(open_channel(path), tolerant=tolerant)
+        ),
+        header,
     )
     records = 0
     try:
         stream.seek(start_pos)
-        for pos, rec in stream:
+        it = iter(stream)
+        while True:
+            try:
+                pos, rec = next(it)
+            except StopIteration:
+                break
+            except BlockGapError as gap:
+                # Tolerant mode only (strict streams don't raise it): the
+                # damaged block is quarantined; resume at the next provable
+                # record boundary past the gap. Records overlapping the
+                # damage are dropped with it.
+                resume = _tolerant_record_resync(path, gap, header, config)
+                if resume is None or resume.block_pos >= split.end:
+                    break
+                stream.seek(resume)
+                it = iter(stream)
+                continue
             if pos.block_pos >= split.end:
                 break
             records += 1
@@ -220,12 +295,16 @@ def load_reads_and_positions(
     """(Pos, BamRecord) pairs, partitioned by file splits (ref :281-334)."""
     config = config.replace(split_size=split_size) if split_size else config
     size = config.split_size_or(Config.LOAD_SPLIT_SIZE_DEFAULT)
-    header = read_header(path)
-    splits = file_splits(path, size)
+    policy = config.fault_policy
+    # Driver-side reads run before any partition exists; retry them under
+    # the same policy so a transient fault here doesn't kill the job.
+    header = with_retries(lambda: read_header(path), policy, "read_header")
+    splits = with_retries(lambda: file_splits(path, size), policy, "file_splits")
     return Dataset(
         splits,
         lambda split: _iter_split_records(path, split, header, config),
         parallel,
+        policy=config.fault_policy,
     )
 
 
@@ -238,7 +317,12 @@ def load_bam(
     """Records of a BAM, partitioned by file splits (ref :173-243)."""
     ds = load_reads_and_positions(path, split_size, config, parallel)
     compute = ds.compute
-    return Dataset(ds.partitions, lambda p: (rec for _, rec in compute(p)), parallel)
+    return Dataset(
+        ds.partitions,
+        lambda p: (rec for _, rec in compute(p)),
+        parallel,
+        policy=ds.policy,
+    )
 
 
 def load_splits_and_reads(
@@ -314,7 +398,7 @@ def load_sam(
                     continue
                 yield parse_sam_line(text, contigs_by_name)
 
-    return Dataset(ranges, compute, parallel)
+    return Dataset(ranges, compute, parallel, policy=config.fault_policy)
 
 
 def load_cram(
@@ -344,7 +428,7 @@ def load_cram(
         with CramReader(path, reference=reference) as r:
             yield from r.records(group[0].offset, group[-1].offset + 1)
 
-    return Dataset(groups, compute, parallel)
+    return Dataset(groups, compute, parallel, policy=config.fault_policy)
 
 
 def _resolve_reference(reference):
@@ -434,7 +518,7 @@ def load_cram_intervals(
                     if overlaps(rec):
                         yield rec
 
-    return Dataset(groups, compute, parallel)
+    return Dataset(groups, compute, parallel, policy=config.fault_policy)
 
 
 def _contiguous_runs(group):
@@ -539,6 +623,7 @@ def _load_sam_intervals(
         ds.partitions,
         lambda p: (rec for rec in compute(p) if overlaps(rec)),
         parallel,
+        policy=ds.policy,
     )
 
 
@@ -555,7 +640,9 @@ def load_bam_intervals(
     reference's behavior for unindexed text input."""
     if str(path).endswith(".sam"):
         return _load_sam_intervals(path, loci, split_size, config, parallel)
-    header = read_header(path)
+    header = with_retries(
+        lambda: read_header(path), config.fault_policy, "read_header"
+    )
     if isinstance(loci, str):
         loci = LociSet.parse(loci, header.contig_lengths)
     config = config.replace(split_size=split_size) if split_size else config
@@ -590,4 +677,4 @@ def load_bam_intervals(
         finally:
             stream.close()
 
-    return Dataset(groups, compute, parallel)
+    return Dataset(groups, compute, parallel, policy=config.fault_policy)

@@ -2,8 +2,12 @@
 
 A ``Dataset`` is a list of partition descriptors plus a compute function;
 actions (count/collect/first-per-partition) execute partitions through the
-host orchestrator (parallel/executor.py). This replaces the reference's
-Spark RDD surface for the load API.
+host orchestrator (parallel/executor.py) under the dataset's ``FaultPolicy``
+— retries, deadlines, hedging, and strict-vs-tolerant degradation come from
+there, the way the reference's RDD actions inherited Spark's task-level
+fault tolerance. After any action, ``last_report`` holds the ``JobReport``
+of per-partition attempts/outcomes (quarantined partitions contribute
+nothing to the action's result in tolerant mode).
 """
 
 from __future__ import annotations
@@ -11,7 +15,12 @@ from __future__ import annotations
 from typing import Callable, Generic, Iterable, Iterator, Sequence, TypeVar
 
 from spark_bam_tpu import obs
-from spark_bam_tpu.parallel.executor import ParallelConfig, map_partitions
+from spark_bam_tpu.core.faults import FaultPolicy
+from spark_bam_tpu.parallel.executor import (
+    JobReport,
+    ParallelConfig,
+    run_partitions,
+)
 
 T = TypeVar("T")
 P = TypeVar("P")
@@ -23,19 +32,30 @@ class Dataset(Generic[P, T]):
         partitions: Sequence[P],
         compute: Callable[[P], Iterable[T]],
         parallel: ParallelConfig = ParallelConfig(),
+        policy: FaultPolicy | None = None,
     ):
         self.partitions = list(partitions)
         self.compute = compute
         self.parallel = parallel
+        self.policy = policy
+        self.last_report: JobReport | None = None
 
     @property
     def num_partitions(self) -> int:
         return len(self.partitions)
 
+    def _execute(self, fn: Callable[[P], object]) -> list:
+        results, report = run_partitions(
+            fn, self.partitions, self.parallel, self.policy
+        )
+        self.last_report = report
+        return results
+
     def map_partitions(self, fn: Callable[[Iterable[T]], Iterable[T]]) -> "Dataset":
         compute = self.compute
         return Dataset(
-            self.partitions, lambda p: fn(compute(p)), self.parallel
+            self.partitions, lambda p: fn(compute(p)), self.parallel,
+            policy=self.policy,
         )
 
     def map(self, fn: Callable[[T], object]) -> "Dataset":
@@ -47,25 +67,21 @@ class Dataset(Generic[P, T]):
     def count(self) -> int:
         with obs.span("load.count", partitions=len(self.partitions)):
             return sum(
-                map_partitions(
-                    lambda p: sum(1 for _ in self.compute(p)),
-                    self.partitions,
-                    self.parallel,
-                )
+                n
+                for n in self._execute(lambda p: sum(1 for _ in self.compute(p)))
+                if n is not None
             )
 
     def collect(self) -> list[T]:
         out: list[T] = []
-        for part in map_partitions(
-            lambda p: list(self.compute(p)), self.partitions, self.parallel
-        ):
-            out.extend(part)
+        for part in self._execute(lambda p: list(self.compute(p))):
+            if part is not None:
+                out.extend(part)
         return out
 
-    def partition_sizes(self) -> list[int]:
-        return map_partitions(
-            lambda p: sum(1 for _ in self.compute(p)), self.partitions, self.parallel
-        )
+    def partition_sizes(self) -> list[int | None]:
+        """Record count per partition (``None`` marks a quarantined one)."""
+        return self._execute(lambda p: sum(1 for _ in self.compute(p)))
 
     def first_per_partition(self) -> list[T | None]:
         def first(p):
@@ -73,7 +89,7 @@ class Dataset(Generic[P, T]):
                 return x
             return None
 
-        return map_partitions(first, self.partitions, self.parallel)
+        return self._execute(first)
 
     def __iter__(self) -> Iterator[T]:
         for p in self.partitions:

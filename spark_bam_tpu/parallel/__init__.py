@@ -1,3 +1,21 @@
-from spark_bam_tpu.parallel.executor import ParallelConfig, map_partitions
+from spark_bam_tpu.parallel.executor import (
+    Attempt,
+    JobReport,
+    ParallelConfig,
+    PartitionReport,
+    last_report,
+    map_partitions,
+    reset_last_report,
+    run_partitions,
+)
 
-__all__ = ["ParallelConfig", "map_partitions"]
+__all__ = [
+    "Attempt",
+    "JobReport",
+    "ParallelConfig",
+    "PartitionReport",
+    "last_report",
+    "map_partitions",
+    "reset_last_report",
+    "run_partitions",
+]

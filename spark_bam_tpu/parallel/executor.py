@@ -1,10 +1,25 @@
-"""Host-side partition orchestration.
+"""Host-side partition orchestration with Spark-grade fault tolerance.
 
 Replaces the Spark driver/executor substrate (SURVEY.md §2.9): partitions are
 planned on the host and executed by a pluggable pool — sequential, threads
 (zlib/NumPy release the GIL, so threads saturate cores for this workload), or
 processes. The reference's analogous knob is ``ParallelConfig``
 (check/.../bam/spark/ParallelConfig.scala:127-148, Threads-vs-Spark).
+
+What Spark supplied for free — failed-task retry, straggler speculation,
+job-level failure semantics — lives here now (``run_partitions``), governed
+by a ``FaultPolicy`` (core/faults.py):
+
+- transient failures (the OSError family) retry with jittered exponential
+  backoff, up to ``max_retries`` per partition;
+- an attempt exceeding ``deadline`` seconds is written off as timed out and
+  a fresh attempt launched (the stale one's late success is still accepted);
+- with ``hedge_after`` set, a partition running longer than N× the median
+  completed-attempt latency gets a speculative twin — first finisher wins
+  (Spark's speculative execution);
+- exhausted retries either raise (``strict``) or quarantine the partition
+  and continue (``tolerant``), with every attempt recorded in a
+  ``JobReport`` returned alongside the results.
 
 Accumulator-style reductions become plain fold-left over per-partition
 results; device-side reductions (psum over a mesh) live in parallel/mesh.py.
@@ -13,12 +28,30 @@ results; device-side reductions (psum over a mesh) live in parallel/mesh.py.
 from __future__ import annotations
 
 import os
-from concurrent.futures import ProcessPoolExecutor, ThreadPoolExecutor
-from dataclasses import dataclass
+import statistics
+import time
+from concurrent.futures import (
+    FIRST_COMPLETED,
+    Future,
+    ProcessPoolExecutor,
+    ThreadPoolExecutor,
+    wait,
+)
+from dataclasses import dataclass, field
 from typing import Callable, Iterable, Sequence, TypeVar
+
+from spark_bam_tpu import obs
+from spark_bam_tpu.core.faults import FaultPolicy, retryable
 
 T = TypeVar("T")
 R = TypeVar("R")
+
+_MODES = ("sequential", "threads", "processes")
+
+#: Coordinator wake interval when deadlines/hedging need a clock (s).
+_WATCH_TICK = 0.02
+#: Hedging needs this many completed attempts before the median means much.
+_HEDGE_MIN_SAMPLES = 3
 
 
 @dataclass(frozen=True)
@@ -33,27 +66,342 @@ class ParallelConfig:
     @staticmethod
     def parse(s: str) -> "ParallelConfig":
         """``"sequential"`` | ``"threads[=N]"`` | ``"processes[=N]"``."""
-        if "=" in s:
-            mode, n = s.split("=", 1)
-            return ParallelConfig(mode, int(n))
-        return ParallelConfig(s)
+        mode, _, n = s.partition("=")
+        workers = 0
+        if n:
+            try:
+                workers = int(n)
+            except ValueError:
+                raise ValueError(
+                    f"Bad parallel worker count {n!r} in {s!r}: want an integer"
+                )
+        if mode not in _MODES:
+            raise ValueError(
+                f"Unknown parallel mode {mode!r} in {s!r}: expected one of "
+                f"{', '.join(_MODES)}"
+            )
+        if workers < 0:
+            raise ValueError(
+                f"Parallel worker count must be >= 0 (0 = all cores): {s!r}"
+            )
+        return ParallelConfig(mode, workers)
+
+
+# ------------------------------------------------------------- job reporting
+@dataclass
+class Attempt:
+    """One execution attempt of one partition."""
+
+    partition: int
+    number: int          # 0-based attempt index (hedges share the primary's)
+    speculative: bool
+    outcome: str         # ok | error | timeout | lost
+    ms: float
+    error: str | None = None
+
+
+@dataclass
+class PartitionReport:
+    index: int
+    status: str = "pending"   # pending | ok | quarantined
+    attempts: list[Attempt] = field(default_factory=list)
+    error: str | None = None
+
+
+@dataclass
+class JobReport:
+    """Per-partition attempt/outcome ledger for one ``run_partitions`` call
+    — the observable replacement for Spark's task-level UI."""
+
+    partitions: list[PartitionReport]
+
+    @property
+    def quarantined(self) -> list[int]:
+        return [p.index for p in self.partitions if p.status == "quarantined"]
+
+    @property
+    def retries(self) -> int:
+        return sum(
+            1
+            for p in self.partitions
+            for a in p.attempts
+            if a.number > 0 and not a.speculative
+        )
+
+    @property
+    def hedges(self) -> int:
+        hedged = {
+            (a.partition, a.number)
+            for p in self.partitions
+            for a in p.attempts
+            if a.speculative
+        }
+        return len(hedged)
+
+    def summary(self) -> str:
+        lines = [
+            f"fault tolerance: {len(self.partitions)} partitions, "
+            f"{self.retries} retries, {self.hedges} hedges, "
+            f"{len(self.quarantined)} quarantined"
+        ]
+        for p in self.partitions:
+            if p.status == "quarantined":
+                lines.append(f"\tquarantined partition {p.index}: {p.error}")
+        return "\n".join(lines)
+
+
+# The most recent JobReport, whatever Dataset/CLI layer triggered it — the
+# CLI reads this after a subcommand to print the quarantine summary without
+# threading the report through every action's return type.
+_last_report: JobReport | None = None
+
+
+def last_report() -> JobReport | None:
+    return _last_report
+
+
+def reset_last_report() -> None:
+    global _last_report
+    _last_report = None
+
+
+def _errstr(e: BaseException) -> str:
+    return f"{type(e).__name__}: {e}"
+
+
+def _record(report: PartitionReport, attempt: Attempt) -> None:
+    report.attempts.append(attempt)
+    obs.observe("faults.attempt_ms", attempt.ms)
+
+
+def _fail_partition(
+    report: PartitionReport, err: BaseException, policy: FaultPolicy
+) -> None:
+    """Exhausted retries: quarantine (tolerant) or re-raise (strict)."""
+    if policy.tolerant:
+        report.status = "quarantined"
+        report.error = _errstr(err)
+        obs.count("faults.quarantined")
+    else:
+        raise err
+
+
+# ------------------------------------------------------------ the executor
+def run_partitions(
+    fn: Callable[[T], R],
+    items: Sequence[T],
+    config: ParallelConfig = ParallelConfig(),
+    policy: FaultPolicy | None = None,
+) -> tuple[list[R | None], JobReport]:
+    """Apply ``fn`` to every partition under ``policy``, preserving order.
+
+    Returns ``(results, report)``; quarantined partitions (tolerant mode
+    only) hold ``None`` in ``results`` and are listed in
+    ``report.quarantined``. Strict mode raises the partition's final error
+    after its retries are exhausted.
+    """
+    global _last_report
+    policy = policy or FaultPolicy()
+    if config.mode not in _MODES:
+        raise ValueError(
+            f"Unknown parallel mode: {config.mode} (expected one of "
+            f"{', '.join(_MODES)})"
+        )
+    reports = [PartitionReport(i) for i in range(len(items))]
+    report = JobReport(reports)
+    _last_report = report
+    if config.mode == "sequential" or len(items) <= 1:
+        results = _run_sequential(fn, items, policy, reports)
+    else:
+        results = _run_pooled(fn, items, config, policy, reports)
+    return results, report
 
 
 def map_partitions(
     fn: Callable[[T], R],
     items: Sequence[T],
     config: ParallelConfig = ParallelConfig(),
+    policy: FaultPolicy | None = None,
 ) -> list[R]:
-    """Apply ``fn`` to every partition, preserving order."""
-    if config.mode == "sequential" or len(items) <= 1:
-        return [fn(item) for item in items]
-    if config.mode == "threads":
-        with ThreadPoolExecutor(max_workers=config.num_workers) as pool:
-            return list(pool.map(fn, items))
-    if config.mode == "processes":
-        with ProcessPoolExecutor(max_workers=config.num_workers) as pool:
-            return list(pool.map(fn, items))
-    raise ValueError(f"Unknown parallel mode: {config.mode}")
+    """Apply ``fn`` to every partition, preserving order (results only)."""
+    results, _ = run_partitions(fn, items, config, policy)
+    return results
+
+
+def _run_sequential(fn, items, policy, reports) -> list:
+    results: list = [None] * len(items)
+    for i, item in enumerate(items):
+        last: BaseException | None = None
+        for attempt in range(policy.max_retries + 1):
+            t0 = time.perf_counter()
+            try:
+                value = fn(item)
+            except Exception as e:
+                ms = (time.perf_counter() - t0) * 1e3
+                _record(reports[i], Attempt(i, attempt, False, "error", ms,
+                                            _errstr(e)))
+                last = e
+                if not retryable(e) or attempt == policy.max_retries:
+                    break
+                obs.count("faults.retries")
+                time.sleep(policy.backoff_delay(attempt))
+            else:
+                ms = (time.perf_counter() - t0) * 1e3
+                _record(reports[i], Attempt(i, attempt, False, "ok", ms))
+                reports[i].status = "ok"
+                results[i] = value
+                last = None
+                break
+        if last is not None:
+            _fail_partition(reports[i], last, policy)
+    return results
+
+
+def _run_pooled(fn, items, config, policy, reports) -> list:
+    n = len(items)
+    pool_cls = (
+        ThreadPoolExecutor if config.mode == "threads" else ProcessPoolExecutor
+    )
+    results: list = [None] * n
+    resolved = [False] * n
+    attempts_started = [0] * n          # non-speculative attempts submitted
+    hedged = [False] * n
+    completed_ms: list[float] = []      # successful latencies (hedge median)
+    inflight: dict[Future, tuple[int, int, bool, float]] = {}
+    abandoned: set[Future] = set()      # deadline-expired but still running
+    retry_due: list[tuple[float, int, int]] = []  # (due, partition, attempt)
+    unresolved = n
+    pool = pool_cls(max_workers=config.num_workers)
+
+    def submit(i: int, attempt_no: int, speculative: bool) -> None:
+        if not speculative:
+            attempts_started[i] += 1
+        fut = pool.submit(fn, items[i])
+        inflight[fut] = (i, attempt_no, speculative, time.monotonic())
+
+    def inflight_attempts(i: int) -> int:
+        return sum(
+            1
+            for fut, (j, _, _, _) in inflight.items()
+            if j == i and fut not in abandoned
+        )
+
+    def after_failure(i: int, attempt_no: int, err: BaseException) -> None:
+        """A live attempt of unresolved partition ``i`` just failed: retry
+        if the budget and error class allow, else — once nothing else is
+        running for it — quarantine or raise."""
+        reports[i].error = _errstr(err)
+        if retryable(err) and attempts_started[i] <= policy.max_retries:
+            retry_due.append(
+                (time.monotonic() + policy.backoff_delay(attempt_no), i,
+                 attempts_started[i])
+            )
+            return
+        if inflight_attempts(i) or any(j == i for _, j, _ in retry_due):
+            return  # a twin/retry is still in play; let it decide
+        nonlocal unresolved
+        resolved[i] = True
+        unresolved -= 1
+        _fail_partition(reports[i], err, policy)
+
+    try:
+        for i in range(n):
+            submit(i, 0, speculative=False)
+        watch = policy.deadline is not None or policy.hedge_after is not None
+        while unresolved:
+            now = time.monotonic()
+            for entry in [e for e in retry_due if e[0] <= now]:
+                retry_due.remove(entry)
+                _, i, attempt_no = entry
+                if not resolved[i]:
+                    obs.count("faults.retries")
+                    submit(i, attempt_no, speculative=False)
+            timeout = None
+            if retry_due:
+                timeout = max(0.0, min(d for d, _, _ in retry_due) - now)
+            if watch:
+                timeout = _WATCH_TICK if timeout is None else min(
+                    timeout, _WATCH_TICK
+                )
+            if not inflight:
+                if not retry_due:
+                    break  # every partition resolved or failed
+                time.sleep(timeout or _WATCH_TICK)
+                continue
+            done, _ = wait(
+                list(inflight), timeout=timeout, return_when=FIRST_COMPLETED
+            )
+            now = time.monotonic()
+            for fut in done:
+                i, attempt_no, speculative, t0 = inflight.pop(fut)
+                stale = fut in abandoned
+                abandoned.discard(fut)
+                ms = (now - t0) * 1e3
+                err = fut.exception()
+                if err is None:
+                    if resolved[i]:
+                        _record(reports[i],
+                                Attempt(i, attempt_no, speculative, "lost", ms))
+                        continue
+                    _record(reports[i],
+                            Attempt(i, attempt_no, speculative, "ok", ms))
+                    reports[i].status = "ok"
+                    results[i] = fut.result()
+                    resolved[i] = True
+                    unresolved -= 1
+                    completed_ms.append(ms)
+                else:
+                    _record(reports[i],
+                            Attempt(i, attempt_no, speculative, "error", ms,
+                                    _errstr(err)))
+                    if resolved[i] or stale:
+                        # Stale: its deadline expiry already scheduled the
+                        # recovery; don't double-consume the budget.
+                        continue
+                    after_failure(i, attempt_no, err)
+            if policy.deadline is not None:
+                for fut, (i, attempt_no, speculative, t0) in list(
+                    inflight.items()
+                ):
+                    if fut in abandoned or resolved[i]:
+                        continue
+                    if now - t0 > policy.deadline:
+                        abandoned.add(fut)
+                        _record(reports[i],
+                                Attempt(i, attempt_no, speculative, "timeout",
+                                        (now - t0) * 1e3,
+                                        "partition deadline exceeded"))
+                        if not speculative:
+                            after_failure(
+                                i, attempt_no,
+                                TimeoutError(
+                                    f"partition {i} attempt {attempt_no} "
+                                    f"exceeded deadline {policy.deadline}s"
+                                ),
+                            )
+            if (
+                policy.hedge_after is not None
+                and len(completed_ms) >= _HEDGE_MIN_SAMPLES
+            ):
+                median = statistics.median(completed_ms)
+                for fut, (i, attempt_no, speculative, t0) in list(
+                    inflight.items()
+                ):
+                    if speculative or resolved[i] or hedged[i]:
+                        continue
+                    if fut in abandoned:
+                        continue
+                    if (now - t0) * 1e3 > policy.hedge_after * median:
+                        hedged[i] = True
+                        obs.count("faults.hedges")
+                        submit(i, attempt_no, speculative=True)
+    except BaseException:
+        # Strict-mode failure (or interrupt): stop feeding the pool and
+        # don't join running attempts — they're discarded, not awaited.
+        pool.shutdown(wait=False, cancel_futures=True)
+        raise
+    pool.shutdown(wait=False)
+    return results
 
 
 def fold_results(results: Iterable[R], zero, merge) -> object:

@@ -1,0 +1,485 @@
+"""Fault-tolerance layer: FaultPolicy parsing, the resilient executor
+(retry / hedge / deadline / quarantine + JobReport), the deterministic
+ChaosChannel harness, and chaos-driven end-to-end recovery through
+``load_bam`` (docs/robustness.md)."""
+
+import threading
+import time
+
+import pytest
+
+from spark_bam_tpu import obs
+from spark_bam_tpu.bam.header import BamHeader, ContigLengths
+from spark_bam_tpu.bam.record import BamRecord
+from spark_bam_tpu.bam.writer import write_bam
+from spark_bam_tpu.core.config import Config
+from spark_bam_tpu.core.faults import (
+    BlockCorruptionError,
+    ChaosChannel,
+    ChaosError,
+    ChaosSpec,
+    ChaosState,
+    FaultPolicy,
+    chaos,
+    parse_chaos,
+    retryable,
+)
+from spark_bam_tpu.core.pos import Pos
+from spark_bam_tpu.load.api import load_bam
+from spark_bam_tpu.parallel.executor import (
+    ParallelConfig,
+    map_partitions,
+    run_partitions,
+)
+
+# Zero-backoff policies so retry tests spend no wall-clock sleeping.
+FAST = FaultPolicy(backoff_base=0.0, jitter=0.0)
+FAST_TOLERANT = FaultPolicy(backoff_base=0.0, jitter=0.0, mode="tolerant")
+
+
+# ------------------------------------------------------------ policy parsing
+
+
+def test_fault_policy_parse_full_spec():
+    p = FaultPolicy.parse(
+        "retries=5,backoff=0.1,backoff_max=2,jitter=0,deadline=60,"
+        "hedge=2.5,mode=tolerant"
+    )
+    assert p.max_retries == 5
+    assert p.backoff_base == 0.1
+    assert p.backoff_max == 2.0
+    assert p.jitter == 0.0
+    assert p.deadline == 60.0
+    assert p.hedge_after == 2.5
+    assert p.tolerant
+
+
+def test_fault_policy_parse_empty_is_default():
+    assert FaultPolicy.parse("") == FaultPolicy()
+    assert FaultPolicy().mode == "strict"
+
+
+def test_fault_policy_parse_off_disables():
+    p = FaultPolicy.parse("deadline=off,hedge=none")
+    assert p.deadline is None and p.hedge_after is None
+
+
+@pytest.mark.parametrize(
+    "spec", ["bogus=1", "mode=yolo", "retries", "retries=-1"]
+)
+def test_fault_policy_parse_rejects(spec):
+    with pytest.raises(ValueError):
+        FaultPolicy.parse(spec)
+
+
+def test_fault_policy_from_config_env(monkeypatch):
+    monkeypatch.setenv("SPARK_BAM_FAULTS", "retries=7,mode=tolerant")
+    p = Config.from_env().fault_policy
+    assert p.max_retries == 7 and p.tolerant
+
+
+def test_backoff_is_capped_exponential():
+    p = FaultPolicy(backoff_base=0.1, backoff_max=0.5, jitter=0.0)
+    assert [p.backoff_delay(a) for a in range(4)] == [0.1, 0.2, 0.4, 0.5]
+
+
+# ---------------------------------------------- ParallelConfig.parse (satellite)
+
+
+def test_parallel_config_parse_modes():
+    assert ParallelConfig.parse("threads=4") == ParallelConfig("threads", 4)
+    assert ParallelConfig.parse("sequential") == ParallelConfig("sequential", 0)
+    assert ParallelConfig.parse("processes") == ParallelConfig("processes", 0)
+
+
+def test_parallel_config_parse_rejects_unknown_mode():
+    with pytest.raises(ValueError, match="sequential, threads, processes"):
+        ParallelConfig.parse("spark")
+
+
+def test_parallel_config_parse_rejects_bad_workers():
+    with pytest.raises(ValueError, match=">= 0"):
+        ParallelConfig.parse("threads=-2")
+    with pytest.raises(ValueError, match="integer"):
+        ParallelConfig.parse("threads=four")
+
+
+# --------------------------------------------- Retry-After clamp (satellite)
+
+
+def test_parse_retry_after_past_http_date_clamped():
+    from email.utils import formatdate
+
+    from spark_bam_tpu.core.remote import _parse_retry_after
+
+    past = formatdate(time.time() - 3600, usegmt=True)
+    assert _parse_retry_after(past) == 0.0
+    future = formatdate(time.time() + 30, usegmt=True)
+    assert 0.0 < _parse_retry_after(future) <= 30.0
+    assert _parse_retry_after("12") == 12.0
+    assert _parse_retry_after(None) == 0.0
+
+
+# ------------------------------------------------------------- retryability
+
+
+def test_retryable_classification():
+    assert retryable(OSError("transient"))
+    assert retryable(TimeoutError())
+    assert retryable(ChaosError("injected"))
+    assert not retryable(FileNotFoundError())
+    assert not retryable(PermissionError())
+    assert not retryable(BlockCorruptionError())  # Unrecoverable marker
+    assert not retryable(ValueError())
+    assert not retryable(EOFError())
+
+
+# ---------------------------------------------------------------- executor
+
+
+@pytest.mark.parametrize("mode", ["sequential", "threads"])
+def test_transient_errors_recover_within_budget(mode):
+    calls = {}
+    lock = threading.Lock()
+
+    def flaky(i):
+        with lock:
+            calls[i] = calls.get(i, 0) + 1
+            n = calls[i]
+        if i % 2 == 0 and n <= 2:
+            raise OSError(f"transient #{n} on {i}")
+        return i * 10
+
+    results, report = run_partitions(
+        flaky, list(range(6)), ParallelConfig(mode, 3), FAST
+    )
+    assert results == [i * 10 for i in range(6)]
+    assert report.retries == 6  # 3 even partitions × 2 retries each
+    assert not report.quarantined
+    for p in report.partitions:
+        assert p.status == "ok"
+        assert p.attempts[-1].outcome == "ok"
+
+
+@pytest.mark.parametrize("mode", ["sequential", "threads"])
+def test_strict_raises_when_budget_exhausted(mode):
+    def always(i):
+        raise OSError(f"always failing {i}")
+
+    with pytest.raises(OSError, match="always failing"):
+        run_partitions(always, [0, 1], ParallelConfig(mode, 2), FAST)
+
+
+@pytest.mark.parametrize("mode", ["sequential", "threads"])
+def test_tolerant_quarantines_and_continues(mode):
+    def poisoned(i):
+        if i == 1:
+            raise OSError("always failing")
+        return i
+
+    results, report = run_partitions(
+        poisoned, [0, 1, 2, 3], ParallelConfig(mode, 2), FAST_TOLERANT
+    )
+    assert results == [0, None, 2, 3]
+    assert report.quarantined == [1]
+    assert report.partitions[1].status == "quarantined"
+    assert "always failing" in report.partitions[1].error
+    # Budget was spent before giving up: 1 initial + max_retries attempts.
+    assert len(report.partitions[1].attempts) == FAST.max_retries + 1
+
+
+@pytest.mark.parametrize("mode", ["sequential", "threads"])
+def test_nonretryable_error_fails_in_one_attempt(mode):
+    def bad(i):
+        raise ValueError("deterministic bug")
+
+    _, report = run_partitions(
+        bad, [0], ParallelConfig(mode, 2), FAST_TOLERANT
+    )
+    assert report.quarantined == [0]
+    assert len(report.partitions[0].attempts) == 1
+
+
+def test_unrecoverable_corruption_not_retried():
+    attempts = []
+
+    def corrupt(i):
+        attempts.append(i)
+        raise BlockCorruptionError("CRC mismatch")
+
+    _, report = run_partitions(
+        corrupt, [0], ParallelConfig("sequential"), FAST_TOLERANT
+    )
+    assert attempts == [0]  # no retry burned on deterministic damage
+    assert report.quarantined == [0]
+
+
+def test_map_partitions_wrapper_returns_results_only():
+    assert map_partitions(
+        lambda x: x + 1, [1, 2, 3], ParallelConfig("sequential")
+    ) == [2, 3, 4]
+
+
+def test_executor_rejects_unknown_mode():
+    with pytest.raises(ValueError, match="Unknown parallel mode"):
+        run_partitions(lambda x: x, [1, 2], ParallelConfig("spark", 2))
+
+
+@pytest.mark.slow
+def test_hedge_fires_on_straggler():
+    """A partition exceeding hedge_after × median completed latency gets a
+    speculative twin; the twin's fast finish resolves the partition without
+    waiting out the straggler."""
+    calls = {}
+    lock = threading.Lock()
+
+    def work(i):
+        with lock:
+            calls[i] = calls.get(i, 0) + 1
+            first = calls[i] == 1
+        if i == 3 and first:
+            time.sleep(2.0)  # the straggler's primary attempt
+        else:
+            time.sleep(0.02)
+        return i
+
+    t0 = time.monotonic()
+    results, report = run_partitions(
+        work,
+        list(range(4)),
+        ParallelConfig("threads", 5),
+        FaultPolicy(hedge_after=3.0, backoff_base=0.0),
+    )
+    wall = time.monotonic() - t0
+    assert results == [0, 1, 2, 3]
+    assert report.hedges == 1
+    spec = [a for a in report.partitions[3].attempts if a.speculative]
+    assert spec and spec[0].outcome == "ok"
+    assert wall < 1.9, f"hedge did not cut the straggler wait ({wall:.2f}s)"
+
+
+@pytest.mark.slow
+def test_deadline_times_out_and_retries():
+    """An attempt over the per-attempt deadline is written off as a timeout
+    and a fresh attempt launched."""
+    calls = {}
+    lock = threading.Lock()
+
+    def work(i):
+        with lock:
+            calls[i] = calls.get(i, 0) + 1
+            first = calls[i] == 1
+        if first:
+            time.sleep(5.0)
+        return i
+
+    results, report = run_partitions(
+        work, [0, 1], ParallelConfig("threads", 4),
+        FaultPolicy(deadline=0.3, backoff_base=0.0),
+    )
+    assert results == [0, 1]
+    outcomes = [a.outcome for a in report.partitions[0].attempts]
+    assert "timeout" in outcomes and outcomes[-1] == "ok"
+
+
+# ------------------------------------------------------------------- chaos
+
+
+def test_parse_chaos_spec():
+    seed, spec = parse_chaos("42:io=0.1,latency=0.05x25,short=0.02,corrupt=1e-6")
+    assert seed == 42
+    assert spec == ChaosSpec(
+        io=0.1, latency=0.05, latency_ms=25.0, short=0.02, corrupt=1e-6
+    )
+    with pytest.raises(ValueError, match="SEED:SPEC"):
+        parse_chaos("nope:io=1")
+    with pytest.raises(ValueError, match="Unknown chaos key"):
+        parse_chaos("1:fire=0.5")
+
+
+class _MemChannel:
+    """Minimal in-memory ByteChannel for chaos unit tests."""
+
+    def __init__(self, data: bytes):
+        self._data = data
+
+    def read_at(self, pos, n):
+        return self._data[pos: pos + n]
+
+    @property
+    def size(self):
+        return len(self._data)
+
+    def close(self):
+        pass
+
+
+def _drain(ch, step=100):
+    """Read the channel range by range, retrying transient faults."""
+    out = bytearray()
+    pos = 0
+    while pos < ch.size:
+        try:
+            out += ch.read_at(pos, min(step, ch.size - pos))
+        except ChaosError:
+            continue
+        pos += step
+    return bytes(out)
+
+
+def test_chaos_channel_deterministic_replay():
+    """Same seed ⇒ identical fault offsets, tallies, and corrupted bytes;
+    different seed ⇒ a different fault set. The fast seeded smoke test of
+    the chaos harness (default suite)."""
+    data = bytes(range(256)) * 40
+    runs = []
+    for _ in range(2):
+        state = ChaosState(7, ChaosSpec.parse("io=0.2,short=0.1,corrupt=1e-3"))
+        ch = ChaosChannel(_MemChannel(data), 7, state.spec, state=state)
+        runs.append((_drain(ch), dict(state.injected), sorted(state.consumed)))
+    assert runs[0] == runs[1]
+    assert runs[0][1]["io"] > 0 and runs[0][1]["corrupt"] > 0
+
+    other = ChaosState(8, ChaosSpec.parse("io=0.2,short=0.1,corrupt=1e-3"))
+    ch = ChaosChannel(_MemChannel(data), 8, other.spec, state=other)
+    assert (_drain(ch), dict(other.injected)) != runs[0][:2]
+
+
+def test_chaos_transient_faults_fire_once_per_region():
+    """A transient fault consumes its 4 KiB region: the retry that re-reads
+    the same offset succeeds (that's what makes it *transient*)."""
+    data = b"x" * (64 << 10)
+    state = ChaosState(3, ChaosSpec(io=1.0))  # every region faults once
+    ch = ChaosChannel(_MemChannel(data), 3, state.spec, state=state)
+    with pytest.raises(ChaosError):
+        ch.read_at(0, 100)
+    assert ch.read_at(0, 100) == data[:100]          # consumed
+    assert ch.read_at(1000, 100) == data[:100]       # same region: clear
+    with pytest.raises(ChaosError):
+        ch.read_at(8192, 100)                        # next region: fresh fault
+
+
+def test_chaos_corruption_is_persistent_and_pure():
+    """Corruption is a pure per-byte function: every read of an offset sees
+    the same damaged value — unlike transients, retries don't help."""
+    data = bytes(1000)
+    state = ChaosState(5, ChaosSpec(corrupt=0.01))
+    ch = ChaosChannel(_MemChannel(data), 5, state.spec, state=state)
+    a = ch.read_at(0, 1000)
+    b = ch.read_at(0, 1000)
+    assert a == b != data
+    # Reading in pieces lands the same damage at the same offsets.
+    assert b"".join(ch.read_at(p, 100) for p in range(0, 1000, 100)) == a
+
+
+# ----------------------------------------------------- end-to-end via load
+
+
+@pytest.fixture(scope="module")
+def synth_bam(tmp_path_factory):
+    path = tmp_path_factory.mktemp("faults") / "synth.bam"
+    header = BamHeader(
+        ContigLengths({0: ("chr1", 1_000_000)}), Pos(0, 0), 0,
+        "@HD\tVN:1.6\n@SQ\tSN:chr1\tLN:1000000\n",
+    )
+
+    def records():
+        for i in range(1200):
+            yield BamRecord(
+                ref_id=0, pos=100 + i * 50, mapq=60, bin=0, flag=0,
+                next_ref_id=-1, next_pos=-1, tlen=0,
+                read_name=f"r{i}", cigar=[(100, 0)],
+                seq="ACGT" * 25, qual=bytes([30]) * 100,
+            )
+
+    write_bam(path, header, records(), block_payload=5000)
+    return path
+
+
+@pytest.mark.parametrize("mode", ["sequential", "threads"])
+@pytest.mark.parametrize("seed", [7, 13, 23])
+def test_load_bam_byte_identical_under_transient_chaos(synth_bam, mode, seed):
+    """The acceptance bar: 10% injected transient-IOError rate, fixed seed,
+    default FaultPolicy ⇒ byte-identical records to the fault-free run.
+    (Seed 23 faults offset 0 — the driver-side header read — proving the
+    pre-partition reads retry too.)"""
+    baseline = [
+        r.encode()
+        for r in load_bam(synth_bam, split_size=4_000, config=Config()).collect()
+    ]
+    assert len(baseline) == 1200
+    with chaos(f"{seed}:io=0.1") as state:
+        ds = load_bam(
+            synth_bam, split_size=4_000, config=Config(),
+            parallel=ParallelConfig(mode, 4),
+        )
+        got = [r.encode() for r in ds.collect()]
+    assert state.injected["io"] > 0, "chaos must actually have fired"
+    assert got == baseline
+    assert ds.last_report.retries >= 1
+    assert not ds.last_report.quarantined
+
+
+def test_load_bam_same_seed_same_story(synth_bam):
+    """Deterministic replay through the whole stack: two runs with one seed
+    inject the identical fault set and land identical bytes."""
+    cfg = Config(faults="backoff=0.001,jitter=0")
+    runs = []
+    for _ in range(2):
+        with chaos("7:io=0.1,latency=0.01x1") as state:
+            ds = load_bam(
+                synth_bam, split_size=4_000, config=cfg,
+                parallel=ParallelConfig("sequential"),
+            )
+            runs.append((
+                [r.encode() for r in ds.collect()],
+                dict(state.injected),
+                sorted(state.consumed),
+            ))
+    assert runs[0] == runs[1]
+
+
+def test_faults_metrics_flow_to_registry(synth_bam):
+    """faults.retries / chaos.io_errors counters and the attempt-latency
+    histogram land in the PR-1 observability registry."""
+    obs.shutdown()
+    reg = obs.configure()
+    try:
+        with chaos("7:io=0.1"):
+            load_bam(
+                synth_bam, split_size=4_000,
+                config=Config(faults="backoff=0.001,jitter=0"),
+                parallel=ParallelConfig("sequential"),
+            ).count()
+        snap = reg.snapshot()
+        counters = {c["name"]: c["value"] for c in snap["counters"]}
+        assert counters.get("faults.retries", 0) >= 1
+        assert counters.get("chaos.io_errors", 0) >= 1
+        hists = {h["name"] for h in snap["hists"]}
+        assert "faults.attempt_ms" in hists
+    finally:
+        obs.shutdown()
+
+
+def test_cli_chaos_and_faults_flags(synth_bam, capsys):
+    from spark_bam_tpu.cli.main import main
+
+    rc = main([
+        "count-reads", "-m", "4KB",
+        "--chaos", "7:io=0.1", "--faults", "backoff=0.001,jitter=0",
+        str(synth_bam),
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "Read counts matched: 1200" in out
+    assert "fault tolerance:" in out and "retries" in out
+    assert "chaos(seed=7): injected io=" in out
+
+
+def test_cli_rejects_bad_fault_specs(synth_bam, capsys):
+    from spark_bam_tpu.cli.main import main
+
+    assert main(["count-reads", "--faults", "bogus=1", str(synth_bam)]) == 2
+    assert "Unknown fault-policy key" in capsys.readouterr().err
+    assert main(["count-reads", "--chaos", "x:io=1", str(synth_bam)]) == 2
+    assert "Bad chaos seed" in capsys.readouterr().err

@@ -166,3 +166,75 @@ def test_header_only_bam_all_paths(tmp_path):
     ) == 0
     _, idx = index_bam(p)
     assert len(idx.references) == 1 and idx.n_no_coor == 0
+
+
+# --------------------------------------------------------------------------
+# Corrupted mid-file BGZF block: strict raises, tolerant re-syncs past the
+# damaged block and keeps every record outside it (docs/robustness.md).
+# --------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def damaged_bam(tmp_path_factory):
+    """A synthesized BAM with one mid-file block's payload bytes flipped
+    (CRC now fails). Returns (path, total_records)."""
+    from spark_bam_tpu.bam.header import BamHeader, ContigLengths
+    from spark_bam_tpu.bam.record import BamRecord
+    from spark_bam_tpu.bam.writer import write_bam
+    from spark_bam_tpu.bgzf.index_blocks import blocks_metadata
+    from spark_bam_tpu.core.pos import Pos
+
+    path = tmp_path_factory.mktemp("damage") / "damaged.bam"
+    header = BamHeader(
+        ContigLengths({0: ("chr1", 1_000_000)}), Pos(0, 0), 0,
+        "@HD\tVN:1.6\n@SQ\tSN:chr1\tLN:1000000\n",
+    )
+
+    def records():
+        for i in range(1200):
+            yield BamRecord(
+                ref_id=0, pos=100 + i * 50, mapq=60, bin=0, flag=0,
+                next_ref_id=-1, next_pos=-1, tlen=0,
+                read_name=f"r{i}", cigar=[(100, 0)],
+                seq="ACGT" * 25, qual=bytes([30]) * 100,
+            )
+
+    write_bam(path, header, records(), block_payload=5000)
+    metas = list(blocks_metadata(path))
+    assert len(metas) > 8, "need enough blocks for a mid-file casualty"
+    data = bytearray(path.read_bytes())
+    data[metas[4].start + 30] ^= 0xFF  # inside block 4's deflate payload
+    path.write_bytes(bytes(data))
+    return path, 1200
+
+
+def test_corrupted_block_strict_mode_raises(damaged_bam):
+    from spark_bam_tpu.core.config import Config
+    from spark_bam_tpu.core.faults import BlockCorruptionError
+
+    path, _ = damaged_bam
+    with pytest.raises(BlockCorruptionError):
+        load_bam(path, split_size="4KB", config=Config()).collect()
+
+
+def test_corrupted_block_tolerant_mode_resyncs(damaged_bam):
+    """Tolerant mode loses only the records inside the damaged block —
+    contiguous, order preserved — and quarantines no whole partition."""
+    from spark_bam_tpu.core.config import Config
+    from spark_bam_tpu.parallel.executor import ParallelConfig
+
+    path, total = damaged_bam
+    for mode in ("sequential", "threads"):
+        ds = load_bam(
+            path, split_size="4KB", config=Config(faults="mode=tolerant"),
+            parallel=ParallelConfig(mode, 4),
+        )
+        names = [r.read_name for r in ds.collect()]
+        assert 0 < len(names) < total, "some but not all records survive"
+        lost = set(f"r{i}" for i in range(total)) - set(names)
+        idx = sorted(int(n[1:]) for n in lost)
+        assert idx == list(range(idx[0], idx[-1] + 1)), (
+            "lost records must be one contiguous damaged-block run"
+        )
+        assert names == sorted(names, key=lambda n: int(n[1:]))
+        assert not ds.last_report.quarantined
