@@ -1319,6 +1319,59 @@ def split_resolution_leg(split_size: int = 2 << 20):
     }
 
 
+def cache_leg(path: str, split_size: int = 2 << 20):
+    """Cold-vs-warm split-index cache A/B (host-side): the same file
+    loaded twice under ``cache=readwrite`` with a throwaway
+    ``SPARK_BAM_CACHE_DIR``. The cold leg computes and writes the ``.sbi``
+    sidecar; the warm leg must serve every split start from it — the
+    per-stage breakdowns make the claim auditable (warm shows zero
+    ``load.split_resolutions`` and no ``check.find_record_start`` spans)
+    and both legs must count the same records (docs/caching.md)."""
+    import shutil
+    import tempfile
+
+    from spark_bam_tpu import obs
+    from spark_bam_tpu.core.config import Config as C
+    from spark_bam_tpu.load.api import load_reads_and_positions
+
+    tmp = tempfile.mkdtemp(prefix="sbt_cache_leg_")
+    old = os.environ.get("SPARK_BAM_CACHE_DIR")
+    os.environ["SPARK_BAM_CACHE_DIR"] = tmp
+    try:
+        cfg = C(split_size=split_size, cache="readwrite")
+
+        def leg():
+            obs.shutdown()
+            reg = obs.configure()
+            t0 = time.perf_counter()
+            n = load_reads_and_positions(path, config=cfg).count()
+            wall = time.perf_counter() - t0
+            return n, wall, _obs_stages(reg)
+
+        n_cold, cold_s, cold_stages = leg()
+        n_warm, warm_s, warm_stages = leg()
+        if n_cold != n_warm:
+            raise AssertionError(
+                f"warm cache changed the record count: {n_cold} vs {n_warm}"
+            )
+        return {
+            "cache_cold_s": round(cold_s, 3),
+            "cache_warm_s": round(warm_s, 3),
+            "cache_warm_speedup": round(cold_s / max(warm_s, 1e-9), 2),
+            "cache_warm_split_resolutions": warm_stages["counters"].get(
+                "load.split_resolutions", 0
+            ),
+            "cache_reads": n_cold,
+            "cache_stages": {"cold": cold_stages, "warm": warm_stages},
+        }
+    finally:
+        if old is None:
+            os.environ.pop("SPARK_BAM_CACHE_DIR", None)
+        else:
+            os.environ["SPARK_BAM_CACHE_DIR"] = old
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def cpu_e2e_rate(path: Path, cap_bytes: int = CPU_E2E_CAP_BYTES):
     """The same count-reads workload on the native CPU checker: pipelined
     host inflate + sequential native eager check of every position.
@@ -1718,6 +1771,12 @@ def _main_measure(record, warnings, errors):
         record.update(split_resolution_leg())
     except Exception as e:
         warnings.append(f"split resolution leg: {type(e).__name__}: {e}")
+    # Cold-vs-warm split-index cache A/B (host-side; equal-count gated).
+    if quick_path:
+        try:
+            record.update(cache_leg(quick_path))
+        except Exception as e:
+            warnings.append(f"cache leg: {type(e).__name__}: {e}")
 
     pallas = results.get("pallas")
     if pallas is not None:

@@ -15,9 +15,15 @@ from typing import Iterable
 
 from spark_bam_tpu.bgzf.block import Metadata
 from spark_bam_tpu.bgzf.stream import MetadataStream
-from spark_bam_tpu.core.channel import open_channel, path_exists
+from spark_bam_tpu.core.channel import open_channel, path_exists, path_size
+from spark_bam_tpu.core.faults import Unrecoverable
 
 log = logging.getLogger(__name__)
+
+
+class StaleBlocksIndexError(IOError, Unrecoverable):
+    """Strict mode: the ``.blocks`` sidecar contradicts the BAM it names.
+    Deterministic — retrying the read cannot reconcile them."""
 
 
 def format_block_line(meta: Metadata) -> str:
@@ -49,8 +55,7 @@ def index_blocks(
     count = 0
     last_beat = time.monotonic()
     # Write-then-rename (pid-suffixed: concurrent indexers must not
-    # interleave): a crash mid-index must never leave a truncated sidecar
-    # (blocks_metadata trusts it blindly, as the reference does).
+    # interleave): a crash mid-index must never leave a truncated sidecar.
     tmp_path = f"{out_path}.tmp{os.getpid()}"
     try:
         with open_channel(bam_path) as ch, open(tmp_path, "w") as out:
@@ -70,10 +75,50 @@ def index_blocks(
     return out_path, count
 
 
-def blocks_metadata(bam_path) -> Iterable[Metadata]:
-    """All block Metadata of a BAM: from the sidecar if present, else by scan."""
+def validate_blocks_index(blocks: list[Metadata], file_size: int) -> str | None:
+    """Why ``blocks`` cannot describe a BAM of ``file_size`` bytes, or None
+    when it checks out: non-empty, starting at 0, a contiguous chain, and
+    covering the file up to an optional 28-byte BGZF EOF sentinel (which
+    ``MetadataStream`` excludes from the index)."""
+    if not blocks:
+        return "empty index for a non-empty file" if file_size else None
+    if blocks[0].start != 0:
+        return f"first block starts at {blocks[0].start}, not 0"
+    for prev, cur in zip(blocks, blocks[1:]):
+        if prev.start + prev.compressed_size != cur.start:
+            return (
+                f"gap/overlap at offset {cur.start}: previous block ends at "
+                f"{prev.start + prev.compressed_size}"
+            )
+    last_end = blocks[-1].start + blocks[-1].compressed_size
+    if file_size - last_end not in (0, 28):
+        return (
+            f"index covers {last_end} of {file_size} bytes "
+            "(not an EOF-sentinel remainder)"
+        )
+    return None
+
+
+def blocks_metadata(bam_path, strict: bool = False) -> Iterable[Metadata]:
+    """All block Metadata of a BAM: from the sidecar when present *and*
+    consistent with the file (start-chain contiguity + size coverage —
+    a stale sidecar from an overwritten BAM must not poison the split
+    plan), else by scan. ``strict`` raises on a stale sidecar instead of
+    silently rescanning, mirroring FaultPolicy's strict mode."""
     sidecar = str(bam_path) + ".blocks"
     if path_exists(sidecar):
-        return read_blocks_index(sidecar)
+        blocks = read_blocks_index(sidecar)
+        reason = validate_blocks_index(blocks, path_size(bam_path))
+        if reason is None:
+            return blocks
+        if strict:
+            raise StaleBlocksIndexError(f"{sidecar}: {reason}")
+        from spark_bam_tpu import obs
+
+        obs.count("cache.invalidations")
+        log.warning(
+            "ignoring stale .blocks sidecar %s (%s); rescanning", sidecar,
+            reason,
+        )
     with open_channel(bam_path) as ch:
         return list(MetadataStream(ch))

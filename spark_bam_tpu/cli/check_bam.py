@@ -44,6 +44,16 @@ def run(
     else:
         expected, actual = ctx.eager_verdict, ctx.seqdoop_verdict
     ctx.print_header_and_confusion(expected, actual)
+    _print_cache_status(ctx)
+
+
+def _print_cache_status(ctx: CheckerContext) -> None:
+    """check-bam doesn't consume the split cache, so this probes the
+    sidecar: the operator sees whether the next load would be warm and,
+    if not, why (docs/caching.md)."""
+    from spark_bam_tpu.sbi.store import cache_status_line
+
+    ctx.printer.echo(cache_status_line(ctx.path, ctx.config))
 
 
 def _run_sharded(ctx: CheckerContext) -> None:
@@ -64,6 +74,7 @@ def _run_sharded(ctx: CheckerContext) -> None:
     p = ctx.printer
     print_report_header(p, stats["positions"], compressed, num_reads)
     p.echo(f"checked across {stats['devices']} device(s)")
+    _print_cache_status(ctx)
     if not stats["false_positives"] and not stats["false_negatives"]:
         p.echo("All calls matched!")
         return

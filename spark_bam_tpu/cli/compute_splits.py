@@ -48,6 +48,14 @@ def print_host_plan(ctx: CheckerContext, num_hosts: int, devices_per_host: int) 
     p.echo("")
 
 
+def _print_cache_status(ctx: CheckerContext) -> None:
+    """Why this run was warm or cold (hit/miss/invalidated + reason) —
+    the operator-facing face of the split-index cache (docs/caching.md)."""
+    from spark_bam_tpu.sbi.store import cache_status_line
+
+    ctx.printer.echo(cache_status_line(ctx.path, ctx.config))
+
+
 def run(
     ctx: CheckerContext,
     split_size: int,
@@ -73,11 +81,14 @@ def run(
         _print_splits(p, splits, ratio)
     elif spark_bam and not hadoop_bam:
         ms, splits = timed_spark()
-        p.echo(f"Get spark-bam splits: {ms}ms", "")
+        p.echo(f"Get spark-bam splits: {ms}ms")
+        _print_cache_status(ctx)
+        p.echo("")
         _print_splits(p, splits, ratio)
     else:
         our_ms, ours = timed_spark()
         p.echo(f"Get spark-bam splits: {our_ms}ms")
+        _print_cache_status(ctx)
         their_ms, theirs = timed_hadoop()
         p.echo(f"Get hadoop-bam splits: {their_ms}ms")
         p.echo("")

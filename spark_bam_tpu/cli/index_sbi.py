@@ -1,0 +1,88 @@
+"""``index``: build a ``.sbi`` split-index sidecar ahead of time.
+
+The warm-start analog of hadoop-bam's ``.sbi`` writer: pay the block
+scan + boundary resolution once, up front, so the first ``load_bam`` /
+``compute-splits`` against the file is already served from the cache
+(docs/caching.md). ``--record-starts`` additionally runs the vectorized
+checker once over the whole file and indexes every record-start virtual
+position — the section ``load.tpu_load.record_starts`` consumes.
+"""
+
+from __future__ import annotations
+
+import os
+
+from spark_bam_tpu.bgzf.index_blocks import blocks_metadata
+from spark_bam_tpu.bam.header import read_header
+from spark_bam_tpu.core.config import Config, format_bytes
+from spark_bam_tpu.load.splits import file_splits
+from spark_bam_tpu.sbi.format import (
+    PLAN_POS,
+    SbiIndex,
+    encode_sbi,
+    fingerprint_of,
+    record_starts_to_virtual,
+)
+from spark_bam_tpu.sbi.plan import build_split_plan
+from spark_bam_tpu.sbi.store import CacheStore
+
+
+def run(
+    path,
+    p,
+    split_size: int,
+    config: Config = Config(),
+    out=None,
+    record_starts: bool = False,
+) -> None:
+    header = read_header(path)
+    blocks = list(blocks_metadata(path))
+    splits = file_splits(path, split_size)
+    entries = build_split_plan(path, splits, header, config)
+    index = SbiIndex(
+        fingerprint_of(path, config),
+        blocks=blocks,
+        split_plans={split_size: entries},
+    )
+    n_record_starts = None
+    if record_starts:
+        from spark_bam_tpu.load.tpu_load import record_starts as tpu_starts
+
+        # Cache off for the inner call: this IS the build, and recursing
+        # into a half-written sidecar would be circular.
+        result = tpu_starts(path, config.replace(cache=""))
+        index.record_starts = record_starts_to_virtual(
+            result.view, result.starts
+        )
+        n_record_starts = len(result.starts)
+
+    if out is not None:
+        # Explicit destination: plain atomic write, no store semantics.
+        tmp = f"{out}.tmp{os.getpid()}"
+        try:
+            with open(tmp, "wb") as f:
+                f.write(encode_sbi(index))
+            os.replace(tmp, out)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+        dest = str(out)
+    else:
+        dest = CacheStore.from_env(policy=config.fault_policy).merge_and_store(
+            path, config, index
+        )
+        if dest is None:
+            p.echo(
+                f"error: cannot place a sidecar for {path} "
+                "(remote BAM without SPARK_BAM_CACHE_DIR)"
+            )
+            return
+    resolved = sum(1 for e in entries if e.kind == PLAN_POS)
+    parts = [
+        f"{len(blocks)} blocks",
+        f"split plan @{format_bytes(split_size)} "
+        f"({len(entries)} boundaries, {resolved} resolved)",
+    ]
+    if n_record_starts is not None:
+        parts.append(f"{n_record_starts} record starts")
+    p.echo(f"Wrote {dest}: " + ", ".join(parts))

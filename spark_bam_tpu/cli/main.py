@@ -8,6 +8,8 @@
     spark-bam-tpu compare-splits [-m SIZE] BAMS-FILE
     spark-bam-tpu count-reads [-m SIZE] [-n N] [-s] PATH
     spark-bam-tpu time-load [-m SIZE] PATH
+    spark-bam-tpu index [-m SIZE] [--record-starts] PATH   (beyond the 10:
+        ahead-of-time .sbi split-index cache builder, docs/caching.md)
     spark-bam-tpu index-blocks PATH
     spark-bam-tpu index-records PATH
     spark-bam-tpu htsjdk-rewrite IN OUT
@@ -53,9 +55,19 @@ def _add_faults(sub):
     )
 
 
+def _add_cache(sub):
+    sub.add_argument(
+        "--cache", default=None, metavar="MODE",
+        help="split-index (.sbi) cache mode: off|read|write|readwrite, "
+             "optional ',strict' suffix raises on stale sidecars "
+             "(SPARK_BAM_CACHE env var works too; docs/caching.md)",
+    )
+
+
 def _add_common(sub, split_default=None):
     _add_metrics(sub)
     _add_faults(sub)
+    _add_cache(sub)
     sub.add_argument("-m", "--max-split-size", default=split_default,
                      help="split size (byte shorthand like 2MB ok)")
     sub.add_argument("-l", "--print-limit", type=int, default=10)
@@ -152,6 +164,28 @@ def build_parser() -> argparse.ArgumentParser:
     sub.add_argument("-o", "--out", default=None)
     sub.add_argument("path")
 
+    # Ahead-of-time .sbi builder: warm the split-index cache so the first
+    # load is already served from the sidecar (docs/caching.md).
+    sub = sp.add_parser("index")
+    _add_metrics(sub)
+    _add_faults(sub)
+    sub.add_argument("-m", "--max-split-size", default=None,
+                     help="split size to plan for (byte shorthand like 2MB ok)")
+    sub.add_argument("-o", "--out", default=None,
+                     help="write the .sbi here instead of the resolved "
+                          "cache location")
+    sub.add_argument("-w", "--warn", action="store_true",
+                     help="root log level WARN")
+    sub.add_argument(
+        "--record-starts", action="store_true",
+        help="also index every record-start virtual position (runs the "
+             "vectorized checker once over the file)",
+    )
+    sub.add_argument("-z", "--bgzf-blocks-to-check", type=int, default=None)
+    sub.add_argument("--reads-to-check", type=int, default=None)
+    sub.add_argument("--max-read-size", type=int, default=None)
+    sub.add_argument("path")
+
     sub = sp.add_parser("index-records")
     _add_metrics(sub)
     sub.add_argument("-o", "--out", default=None)
@@ -215,12 +249,22 @@ def main(argv=None) -> int:
         if getattr(args, "faults", None):
             FaultPolicy.parse(args.faults)  # fail before any work starts
             config = config.replace(faults=args.faults)
+        if getattr(args, "cache", None) is not None:
+            from spark_bam_tpu.sbi.store import CacheMode
+
+            CacheMode.parse(args.cache)  # fail before any work starts
+            config = config.replace(cache=args.cache)
         if getattr(args, "chaos", None):
             chaos_state = install_chaos(args.chaos)
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
     reset_last_report()
+    # Cache-status events are per-run (module-global): clear leftovers so
+    # the status line describes THIS invocation only.
+    from spark_bam_tpu.sbi.store import reset_cache_events
+
+    reset_cache_events()
 
     # --metrics-out (or the env var) turns the process-wide registry on
     # for this run; everything below the root ``cli.<command>`` span
@@ -304,6 +348,14 @@ def main(argv=None) -> int:
 
             out_path, count = index_blocks(args.path, args.out)
             print(f"Wrote {count} blocks to {out_path}", file=sys.stderr)
+        elif cmd == "index":
+            from spark_bam_tpu.cli import index_sbi
+
+            index_sbi.run(
+                args.path, p,
+                config.split_size_or(Config.LOAD_SPLIT_SIZE_DEFAULT),
+                config, out=args.out, record_starts=args.record_starts,
+            )
         elif cmd == "index-records":
             from spark_bam_tpu.bam.index_records import index_records
 

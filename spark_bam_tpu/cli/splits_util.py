@@ -9,6 +9,10 @@ serves all boundaries — right for fixture-sized files and the only
 option without the native library). Ends tile to the next start
 (reference cli/.../spark/LoadReads.scala:164-174,
 CanLoadBam.scala:262-274).
+
+Both engines emit the raw per-boundary ``PlanEntry`` plan (sbi/plan.py)
+so a ``--cache``-enabled run serves warm ``compute-splits`` straight
+from the ``.sbi`` sidecar and writes through on a miss.
 """
 
 from __future__ import annotations
@@ -21,9 +25,16 @@ from spark_bam_tpu.cli.app import CheckerContext
 from spark_bam_tpu.core.channel import open_channel
 from spark_bam_tpu.core.pos import Pos
 from spark_bam_tpu.load.splits import Split
+from spark_bam_tpu.sbi.format import (
+    PLAN_NONE,
+    PLAN_POS,
+    PLAN_UNRESOLVED,
+    PlanEntry,
+)
+from spark_bam_tpu.sbi.plan import plan_split_starts
 
 
-def _splits_native(ctx: CheckerContext, split_size: int) -> list[Pos] | None:
+def _plan_native(ctx: CheckerContext, split_size: int) -> list[PlanEntry] | None:
     """Per-boundary resolution via ``load.api._resolve_split_start``
     (native scan + exact confirmation; individual boundaries may demote
     to the Python oracle, staying correct). None when the native library
@@ -38,48 +49,84 @@ def _splits_native(ctx: CheckerContext, split_size: int) -> list[Pos] | None:
         return None
     size = ctx.compressed_size
     header = ctx.header
-    starts: list[Pos] = []
+    entries: list[PlanEntry] = []
     for s in range(0, size, split_size):
         fs = FileSplit(str(ctx.path), s, min(s + split_size, size))
         try:
             pos = _resolve_split_start(ctx.path, fs, header, ctx.config)
         except NoReadFoundException:
-            continue  # no read within max_read_size of this boundary
-        if pos is None:
-            continue  # split owns no blocks, or clean EOF
-        if not starts or starts[-1] != pos:
-            starts.append(pos)
-    return starts
+            # No read within max_read_size of this boundary.
+            entries.append(PlanEntry(s, PLAN_UNRESOLVED, None))
+            continue
+        entries.append(
+            PlanEntry(s, PLAN_NONE if pos is None else PLAN_POS, pos)
+        )
+    return entries
+
+
+def _plan_vectorized(ctx: CheckerContext, split_size: int) -> list[PlanEntry]:
+    """Boundary resolution against the whole-file eager verdicts."""
+    size = ctx.compressed_size
+    true_flat = ctx.true_flat_eager
+    entries: list[PlanEntry] = []
+    with open_channel(ctx.path) as ch:
+        for s in range(0, size, split_size):
+            e = min(s + split_size, size)
+            block = find_block_start(
+                ch, s, ctx.config.bgzf_blocks_to_check, path=ctx.path
+            )
+            if block >= e:
+                entries.append(PlanEntry(s, PLAN_NONE, None))
+                continue
+            flat = ctx.view.flat_of_pos(block, 0)
+            j = int(np.searchsorted(true_flat, flat))
+            if j >= len(true_flat):
+                entries.append(PlanEntry(s, PLAN_NONE, None))
+                continue
+            if true_flat[j] - flat >= ctx.config.max_read_size:
+                # The live scan would exhaust its budget here.
+                entries.append(PlanEntry(s, PLAN_UNRESOLVED, None))
+                continue
+            start = Pos(*ctx.view.pos_of_flat(int(true_flat[j])))
+            entries.append(PlanEntry(s, PLAN_POS, start))
+    return entries
+
+
+def split_plan(ctx: CheckerContext, split_size: int) -> list[PlanEntry]:
+    """The raw per-boundary plan, cache-aware: a valid ``.sbi`` sidecar
+    serves it with zero checker work; a miss computes and (in a write
+    mode) persists it."""
+    config = ctx.config
+    mode = config.cache_mode
+    store = None
+    if mode.enabled:
+        from spark_bam_tpu.sbi.store import CacheStore
+
+        store = CacheStore.from_env(policy=config.fault_policy)
+        if mode.read:
+            index = store.load(ctx.path, config, strict=mode.strict)
+            if index is not None and split_size in index.split_plans:
+                return index.split_plans[split_size]
+    entries = _plan_native(ctx, split_size)
+    if entries is None:
+        entries = _plan_vectorized(ctx, split_size)
+    if store is not None and mode.write:
+        from spark_bam_tpu.sbi.format import SbiIndex, fingerprint_of
+
+        store.merge_and_store(
+            ctx.path, config,
+            SbiIndex(
+                fingerprint_of(ctx.path, config),
+                split_plans={split_size: entries},
+            ),
+        )
+    return entries
 
 
 def spark_bam_splits(ctx: CheckerContext, split_size: int) -> list[Split]:
-    size = ctx.compressed_size
-    starts = _splits_native(ctx, split_size)
-    if starts is None:
-        true_flat = ctx.true_flat_eager
-        starts = []
-        with open_channel(ctx.path) as ch:
-            for s in range(0, size, split_size):
-                e = min(s + split_size, size)
-                block = find_block_start(
-                    ch, s, ctx.config.bgzf_blocks_to_check, path=ctx.path
-                )
-                if block >= e:
-                    continue
-                flat = ctx.view.flat_of_pos(block, 0)
-                j = int(np.searchsorted(true_flat, flat))
-                if j >= len(true_flat):
-                    continue
-                if true_flat[j] - flat >= ctx.config.max_read_size:
-                    continue
-                start = Pos(*ctx.view.pos_of_flat(int(true_flat[j])))
-                if not starts or starts[-1] != start:
-                    starts.append(start)
-    eof = Pos(size, 0)
-    return [
-        Split(start, starts[i + 1] if i + 1 < len(starts) else eof)
-        for i, start in enumerate(starts)
-    ]
+    entries = split_plan(ctx, split_size)
+    starts, ends = plan_split_starts(entries, ctx.compressed_size)
+    return [Split(s, e) for s, e in zip(starts, ends)]
 
 
 def diff_splits(ours: list[Split], theirs: list[Split]) -> list[tuple[str, Split]]:

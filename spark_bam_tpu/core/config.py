@@ -97,6 +97,12 @@ class Config:
     # defaults). Kept as the string form so the frozen dataclass stays
     # hashable/env-roundtrippable; ``fault_policy`` parses it (cached).
     faults: str = ""
+    # --- split-index cache (sbi/; docs/caching.md) ---
+    # "off | read | write | readwrite" with optional ",strict" suffix
+    # ("" = off). Same string-spec pattern as ``faults``; ``cache_mode``
+    # parses it. Sidecar location/budget come from SPARK_BAM_CACHE_DIR /
+    # SPARK_BAM_CACHE_BUDGET (store-level, not Config knobs).
+    cache: str = ""
     # --- misc ---
     warn: bool = False                  # root log-level toggle (args/LogArgs.scala:30-33)
     # Accepted for config-surface parity (PostPartitionArgs -p, default
@@ -123,6 +129,13 @@ class Config:
         from spark_bam_tpu.core.faults import FaultPolicy
 
         return FaultPolicy.parse(self.faults)
+
+    @property
+    def cache_mode(self):
+        """The parsed ``CacheMode`` for this config's ``cache`` spec."""
+        from spark_bam_tpu.sbi.store import CacheMode
+
+        return CacheMode.parse(self.cache)
 
     def split_size_or(self, default: int) -> int:
         return self.split_size if self.split_size is not None else default
@@ -161,8 +174,10 @@ class Config:
         return base.replace(**kw)
 
     # SPARK_BAM_* sub-namespaces that are NOT Config knobs (cloud backend
-    # endpoints/tokens, core/cloud.py) — from_env must not trip on them.
-    _ENV_NON_CONFIG = ("gs_", "s3_", "profile_")
+    # endpoints/tokens in core/cloud.py; cache-store location/budget in
+    # sbi/store.py) — from_env must not trip on them. Note the bare
+    # SPARK_BAM_CACHE still maps to the ``cache`` knob.
+    _ENV_NON_CONFIG = ("gs_", "s3_", "profile_", "cache_")
 
     @classmethod
     def from_env(cls, env=os.environ, base: "Config | None" = None) -> "Config":

@@ -50,6 +50,9 @@ def _resolve_split_start(path, split: FileSplit, header: BamHeader, config: Conf
     checker alone costs thousands of seconds). ``backend="python"`` pins
     the Python oracle; both produce identical positions.
     """
+    # The warm-cache acceptance gate: a cache-served load must never get
+    # here (tests assert this counter stays 0 on warm loads).
+    obs.count("load.split_resolutions")
     with obs.span("bgzf.read", kind="find_block_start", split=split.start):
         with open_channel(path) as ch:
             block_start = find_block_start(
@@ -242,9 +245,18 @@ def _tolerant_record_resync(path, gap: BlockGapError, header: BamHeader,
         checker.close()
 
 
-def _iter_split_records(path, split: FileSplit, header: BamHeader, config: Config):
-    with obs.span("load.partition", split=split.start):
-        start_pos = _resolve_split_start(path, split, header, config)
+#: "no cached verdict for this boundary" — distinct from None, which is a
+#: *cached* "this split owns no record start".
+_UNRESOLVED = object()
+
+
+def _iter_split_records(
+    path, split: FileSplit, header: BamHeader, config: Config,
+    start_pos=_UNRESOLVED,
+):
+    if start_pos is _UNRESOLVED:
+        with obs.span("load.partition", split=split.start):
+            start_pos = _resolve_split_start(path, split, header, config)
     if start_pos is None:
         return
     tolerant = config.fault_policy.tolerant
@@ -286,6 +298,38 @@ def _iter_split_records(path, split: FileSplit, header: BamHeader, config: Confi
         obs.count("load.partitions")
 
 
+def _consult_split_cache(path, splits, header, config: Config, size: int):
+    """``{split: Pos | None}`` of cache-served (or freshly built and
+    written-through) record starts; ``{}`` when the cache is off or can't
+    serve these splits — absent splits resolve live, the cold path.
+    Governed by ``Config.cache`` (docs/caching.md)."""
+    mode = config.cache_mode
+    if not mode.enabled:
+        return {}
+    from spark_bam_tpu import sbi
+    from spark_bam_tpu.sbi import plan as sbi_plan
+
+    store = sbi.CacheStore.from_env(policy=config.fault_policy)
+    if mode.read:
+        index = store.load(path, config, strict=mode.strict)
+        if index is not None and size in index.split_plans:
+            starts = sbi_plan.plan_to_starts(splits, index.split_plans[size])
+            if starts is not None:
+                return starts
+    if not mode.write:
+        return {}
+    # Miss with write-through: resolve the whole plan driver-side (the
+    # same work the partitions would each do lazily) and persist it.
+    entries = sbi_plan.build_split_plan(path, splits, header, config)
+    store.merge_and_store(
+        path, config,
+        sbi.SbiIndex(
+            sbi.fingerprint_of(path, config), split_plans={size: entries}
+        ),
+    )
+    return sbi_plan.plan_to_starts(splits, entries) or {}
+
+
 def load_reads_and_positions(
     path,
     split_size=None,
@@ -300,9 +344,13 @@ def load_reads_and_positions(
     # the same policy so a transient fault here doesn't kill the job.
     header = with_retries(lambda: read_header(path), policy, "read_header")
     splits = with_retries(lambda: file_splits(path, size), policy, "file_splits")
+    starts_by_split = _consult_split_cache(path, splits, header, config, size)
     return Dataset(
         splits,
-        lambda split: _iter_split_records(path, split, header, config),
+        lambda split: _iter_split_records(
+            path, split, header, config,
+            start_pos=starts_by_split.get(split, _UNRESOLVED),
+        ),
         parallel,
         policy=config.fault_policy,
     )

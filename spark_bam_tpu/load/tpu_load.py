@@ -46,15 +46,46 @@ class TpuLoadResult:
         return [Pos(int(b), int(o)) for b, o in zip(blocks, offs)]
 
 
+def _cached_record_starts(view, path, config, store, strict):
+    """Flat record-start offsets from a valid ``.sbi`` sidecar, or None.
+    Cached positions are stored virtual (portable across re-flattenings);
+    the conversion is vectorized against the view's block tables."""
+    from spark_bam_tpu.sbi.format import SbiFormatError, record_starts_to_flat
+
+    index = store.load(path, config, strict=strict)
+    if index is None or index.record_starts is None:
+        return None
+    try:
+        return record_starts_to_flat(view, index.record_starts)
+    except SbiFormatError:
+        # Position names a block the file lacks — the fingerprint should
+        # preclude this; recompute rather than trust it.
+        return None
+
+
 def record_starts(
     path, config: Config = Config(), checker: TpuChecker | None = None
 ) -> TpuLoadResult:
     """Whole-file record starts with the flat view retained (small files /
     callers that need the bytes, e.g. columnar parsing). For inputs larger
     than memory use ``record_starts_streaming`` / ``count_reads_tpu``, which
-    run in O(window) host memory."""
+    run in O(window) host memory. With ``Config.cache`` enabled, a valid
+    ``.sbi`` sidecar supplies the starts with zero checker work."""
     header = read_header(path)
     view = flatten_file(path)
+    mode = config.cache_mode
+    store = None
+    if mode.enabled:
+        from spark_bam_tpu.sbi.store import CacheStore
+
+        store = CacheStore.from_env(policy=config.fault_policy)
+        if mode.read:
+            starts = _cached_record_starts(
+                view, path, config, store, mode.strict
+            )
+            if starts is not None:
+                obs.count("load.record_starts", len(starts))
+                return TpuLoadResult(view, header, starts)
     if checker is None:
         # Size the window to the input: a small file in one kernel call, big
         # files stream through config.window_size windows. Power-of-two sizes
@@ -73,6 +104,20 @@ def record_starts(
     starts = np.flatnonzero(res.verdict)
     starts = starts[starts >= header_end]
     obs.count("load.record_starts", len(starts))
+    if store is not None and mode.write:
+        from spark_bam_tpu.sbi.format import (
+            SbiIndex,
+            fingerprint_of,
+            record_starts_to_virtual,
+        )
+
+        store.merge_and_store(
+            path, config,
+            SbiIndex(
+                fingerprint_of(path, config),
+                record_starts=record_starts_to_virtual(view, starts),
+            ),
+        )
     return TpuLoadResult(view, header, starts)
 
 

@@ -1,0 +1,54 @@
+"""Persistent split-index cache (``.sbi`` sidecars).
+
+The reference lineage's splitting-BAM index — hadoop-bam's ``.sbi``,
+spark-bam's ``IndexBlocks``/``IndexRecords`` sidecars — turned repeated
+loads of the same file into pure record streaming. This package is that
+idea as a *validated, write-through cache*: a versioned binary format
+(``sbi.format``) holding the file fingerprint, BGZF block metadata,
+resolved split plans, and record-start virtual positions; and a
+``CacheStore`` (``sbi.store``) that resolves sidecars next to the BAM or
+content-addressed under ``SPARK_BAM_CACHE_DIR``, validates on read
+(stale or corrupt ⇒ invalidate and recompute, never a wrong answer),
+writes atomically, and evicts by LRU under a byte budget.
+
+Wiring: ``load/api.py`` and ``load/tpu_load.py`` consult before split
+computation and write through after, governed by ``Config.cache`` /
+``SPARK_BAM_CACHE`` / ``--cache``; the ``index`` CLI subcommand builds
+sidecars ahead of time. Semantics in ``docs/caching.md``.
+"""
+
+from spark_bam_tpu.sbi.format import (
+    Fingerprint,
+    PlanEntry,
+    SbiFormatError,
+    SbiIndex,
+    config_digest,
+    decode_sbi,
+    encode_sbi,
+    fingerprint_of,
+)
+from spark_bam_tpu.sbi.store import (
+    CacheMode,
+    CacheStore,
+    StaleCacheError,
+    cache_events,
+    cache_status_line,
+    reset_cache_events,
+)
+
+__all__ = [
+    "CacheMode",
+    "CacheStore",
+    "Fingerprint",
+    "PlanEntry",
+    "SbiFormatError",
+    "SbiIndex",
+    "StaleCacheError",
+    "cache_events",
+    "cache_status_line",
+    "config_digest",
+    "decode_sbi",
+    "encode_sbi",
+    "fingerprint_of",
+    "reset_cache_events",
+]

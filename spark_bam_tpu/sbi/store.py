@@ -1,0 +1,366 @@
+"""Sidecar resolution, validation, atomic writes, LRU eviction.
+
+``CacheStore`` is the one place ``.sbi`` sidecars are read and written:
+
+- **Resolution**: next to the BAM (``<path>.sbi``) by default, or
+  content-addressed under a shared ``SPARK_BAM_CACHE_DIR`` (set the env
+  var, or pass ``cache_dir``) — the shared-dir mode is what read-only
+  inputs and multi-tenant hosts want, and the only mode that can cache
+  remote (URL) BAMs.
+- **Validation**: every read re-fingerprints the BAM (size, mtime,
+  head-CRC, checker-config digest) and CRC-checks the sidecar bytes.
+  Any mismatch or corruption invalidates — the cache recomputes, it
+  never changes results. Strict mode (``--cache readwrite,strict``)
+  raises ``StaleCacheError`` instead, mirroring ``FaultPolicy``'s
+  strict-vs-tolerant split for operators who want staleness loud.
+- **Atomicity**: write-to-tmp + ``os.replace`` with a pid+sequence
+  suffix (the ``bgzf/index_blocks.py`` pattern, hardened for in-process
+  concurrency) — racing writers never yield a torn file.
+- **Eviction**: shared-dir caches keep a byte budget
+  (``SPARK_BAM_CACHE_BUDGET``, byte shorthand ok); least-recently-used
+  sidecars are evicted after each write (reads touch mtime).
+
+Remote sidecar reads go through ``core/faults.with_retries`` so a
+transient transport blip costs a retry, not a cold load. Metrics:
+``cache.hits`` / ``cache.misses`` / ``cache.invalidations`` /
+``cache.evictions`` counters, a ``cache.bytes`` gauge, and
+``cache.read_ms`` / ``cache.write_ms`` histograms.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import itertools
+import logging
+import os
+import threading
+import time
+from dataclasses import dataclass
+
+from spark_bam_tpu import obs
+from spark_bam_tpu.core.channel import is_url, open_channel, path_exists
+from spark_bam_tpu.core.faults import FaultPolicy, Unrecoverable, with_retries
+from spark_bam_tpu.sbi.format import (
+    SbiFormatError,
+    SbiIndex,
+    decode_sbi,
+    encode_sbi,
+    fingerprint_of,
+)
+
+log = logging.getLogger(__name__)
+
+
+class StaleCacheError(IOError, Unrecoverable):
+    """Strict cache mode: the sidecar exists but is stale or corrupt.
+    Deterministic (re-reading won't fix the fingerprint), hence
+    ``Unrecoverable`` — the executor fails fast instead of retrying."""
+
+
+@dataclass(frozen=True)
+class CacheMode:
+    """Parsed ``--cache`` / ``Config.cache`` / ``SPARK_BAM_CACHE`` spec."""
+
+    read: bool = False
+    write: bool = False
+    strict: bool = False
+
+    @property
+    def enabled(self) -> bool:
+        return self.read or self.write
+
+    _NAMES = ("off", "read", "write", "readwrite")
+
+    @staticmethod
+    def parse(spec: str) -> "CacheMode":
+        """``off | read | write | readwrite`` with an optional ``,strict``
+        suffix; ``""`` ⇒ off."""
+        tokens = [t.strip() for t in (spec or "").split(",") if t.strip()]
+        mode, strict = "off", False
+        for tok in tokens:
+            if tok == "strict":
+                strict = True
+            elif tok in CacheMode._NAMES:
+                mode = tok
+            else:
+                raise ValueError(
+                    f"Unknown cache mode {tok!r}: expected one of "
+                    f"{', '.join(CacheMode._NAMES)} (+ optional ',strict')"
+                )
+        return CacheMode(
+            read=mode in ("read", "readwrite"),
+            write=mode in ("write", "readwrite"),
+            strict=strict,
+        )
+
+
+# ------------------------------------------------------------ status events
+@dataclass(frozen=True)
+class CacheEvent:
+    """One cache interaction, kept for the CLI status line."""
+
+    state: str   # hit | miss | invalidated | written | skipped | evicted
+    reason: str
+    path: str
+
+
+_events: list[CacheEvent] = []
+_events_lock = threading.Lock()
+
+
+def _record(state: str, reason: str, path: str) -> None:
+    with _events_lock:
+        _events.append(CacheEvent(state, reason, path))
+
+
+def cache_events() -> list[CacheEvent]:
+    with _events_lock:
+        return list(_events)
+
+
+def reset_cache_events() -> None:
+    with _events_lock:
+        _events.clear()
+
+
+def cache_status_line(path, config) -> str:
+    """One operator-facing line: why this run's load was warm or cold.
+    When the run never consulted the cache (e.g. check-bam), probes the
+    sidecar so the line still says what a load *would* find."""
+    mode = config.cache_mode
+    if not mode.enabled:
+        return "cache: off (enable with --cache readwrite; docs/caching.md)"
+    events = cache_events()
+    if not events:
+        store = CacheStore.from_env()
+        state, reason = store.probe(path, config)
+        return f"cache: {state} ({reason})"
+    parts = [f"{e.state} ({e.reason})" for e in events]
+    return "cache: " + "; ".join(parts)
+
+
+# ------------------------------------------------------------------- store
+_TMP_SEQ = itertools.count()
+
+
+class CacheStore:
+    def __init__(
+        self,
+        cache_dir: str | None = None,
+        budget_bytes: int | None = None,
+        policy: FaultPolicy | None = None,
+    ):
+        self.cache_dir = str(cache_dir) if cache_dir else None
+        self.budget_bytes = budget_bytes
+        self.policy = policy or FaultPolicy()
+
+    @staticmethod
+    def from_env(env=None, policy: FaultPolicy | None = None) -> "CacheStore":
+        from spark_bam_tpu.core.config import parse_bytes
+
+        env = env if env is not None else os.environ
+        budget = env.get("SPARK_BAM_CACHE_BUDGET")
+        return CacheStore(
+            cache_dir=env.get("SPARK_BAM_CACHE_DIR") or None,
+            budget_bytes=parse_bytes(budget) if budget else None,
+            policy=policy,
+        )
+
+    # ------------------------------------------------------------ locate
+    def sidecar_path(self, bam_path) -> str:
+        """Where ``bam_path``'s index lives: content-addressed under the
+        shared dir when configured, else adjacent to the BAM."""
+        s = str(bam_path)
+        if self.cache_dir:
+            if not is_url(s):
+                s = os.path.abspath(s)
+            digest = hashlib.sha256(s.encode()).hexdigest()[:32]
+            return os.path.join(self.cache_dir, digest + ".sbi")
+        return s + ".sbi"
+
+    def _writable(self, bam_path) -> bool:
+        # Adjacent writes need a local filesystem; URL BAMs cache only
+        # under a shared local cache dir.
+        return bool(self.cache_dir) or not is_url(str(bam_path))
+
+    # -------------------------------------------------------------- read
+    def _read_bytes(self, sidecar: str) -> bytes:
+        """Sidecar bytes through the channel seam (chaos-injectable;
+        remote reads retried under the fault policy)."""
+
+        def read():
+            with open_channel(sidecar) as ch:
+                return bytes(ch.read_at(0, ch.size))
+
+        if is_url(sidecar):
+            return with_retries(read, self.policy, "read_sbi")
+        return read()
+
+    def load(
+        self, bam_path, config, strict: bool = False, _quiet: bool = False
+    ) -> SbiIndex | None:
+        """The validated index for ``bam_path``, or None (miss / stale /
+        corrupt — counted and recorded; ``strict`` raises on the latter
+        two). A hit touches the sidecar's mtime so LRU eviction tracks
+        use, and observes ``cache.read_ms``."""
+        sidecar = self.sidecar_path(bam_path)
+        t0 = time.perf_counter()
+        if not path_exists(sidecar):
+            if not _quiet:
+                obs.count("cache.misses")
+                _record("miss", "no .sbi sidecar", sidecar)
+            return None
+        try:
+            index = decode_sbi(self._read_bytes(sidecar))
+        except SbiFormatError as e:
+            return self._invalid(
+                f"corrupt sidecar: {e}", sidecar, strict, _quiet
+            )
+        current = with_retries(
+            lambda: fingerprint_of(bam_path, config), self.policy,
+            "fingerprint",
+        )
+        reason = index.fingerprint.mismatch(current)
+        if reason is not None:
+            return self._invalid(f"stale sidecar: {reason}", sidecar, strict,
+                                 _quiet)
+        if not _quiet:
+            obs.count("cache.hits")
+            obs.observe(
+                "cache.read_ms", (time.perf_counter() - t0) * 1e3, unit="ms"
+            )
+            _record("hit", "fingerprint ok", sidecar)
+            if self.cache_dir and not is_url(sidecar):
+                try:
+                    os.utime(sidecar)
+                except OSError:
+                    pass
+        return index
+
+    def _invalid(self, reason: str, sidecar: str, strict: bool,
+                 quiet: bool) -> None:
+        if not quiet:
+            obs.count("cache.invalidations")
+            _record("invalidated", reason, sidecar)
+        if strict:
+            raise StaleCacheError(f"{sidecar}: {reason}")
+        log.info("split-index cache invalidated: %s (%s)", sidecar, reason)
+        return None
+
+    def probe(self, bam_path, config) -> tuple[str, str]:
+        """Validation-only peek (no counters, no status events): the
+        (state, reason) a real load would see — the check-bam status line."""
+        sidecar = self.sidecar_path(bam_path)
+        if not path_exists(sidecar):
+            return "miss", f"no sidecar at {sidecar}; build with 'index'"
+        try:
+            index = decode_sbi(self._read_bytes(sidecar))
+        except SbiFormatError as e:
+            return "invalidated", f"corrupt sidecar: {e}"
+        reason = index.fingerprint.mismatch(
+            with_retries(
+                lambda: fingerprint_of(bam_path, config), self.policy,
+                "fingerprint",
+            )
+        )
+        if reason is not None:
+            return "invalidated", f"stale sidecar: {reason}"
+        sections = []
+        if index.blocks is not None:
+            sections.append(f"{len(index.blocks)} blocks")
+        if index.split_plans:
+            sections.append(
+                "split plans for "
+                + "/".join(str(s) for s in sorted(index.split_plans))
+            )
+        if index.record_starts is not None:
+            sections.append(f"{len(index.record_starts)} record starts")
+        return "hit", "; ".join(sections) or "empty index"
+
+    # ------------------------------------------------------------- write
+    def store(self, bam_path, index: SbiIndex) -> str | None:
+        """Atomic write-through; returns the sidecar path, or None when
+        this store cannot hold ``bam_path`` (URL BAM without a shared
+        cache dir). Evicts over-budget shared-dir entries afterwards."""
+        if not self._writable(bam_path):
+            _record(
+                "skipped",
+                "remote BAM needs SPARK_BAM_CACHE_DIR for caching",
+                str(bam_path),
+            )
+            return None
+        sidecar = self.sidecar_path(bam_path)
+        t0 = time.perf_counter()
+        blob = encode_sbi(index)
+        if self.cache_dir:
+            os.makedirs(self.cache_dir, exist_ok=True)
+        # pid + in-process sequence: unique even for threads racing on the
+        # same sidecar; os.replace keeps every reader's view untorn.
+        tmp = f"{sidecar}.tmp{os.getpid()}.{next(_TMP_SEQ)}"
+        try:
+            with open(tmp, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, sidecar)
+        finally:
+            if os.path.exists(tmp):  # failure path only; replace moved it
+                os.unlink(tmp)
+        obs.observe(
+            "cache.write_ms", (time.perf_counter() - t0) * 1e3, unit="ms"
+        )
+        obs.gauge("cache.bytes").set(len(blob))
+        _record("written", f"{len(blob)} bytes", sidecar)
+        self._evict(keep=sidecar)
+        return sidecar
+
+    def merge_and_store(self, bam_path, config, index: SbiIndex) -> str | None:
+        """Write-through that preserves sections an existing *valid*
+        sidecar already holds (quiet reload: no hit/miss accounting)."""
+        existing = None
+        if self._writable(bam_path):
+            try:
+                existing = self.load(bam_path, config, _quiet=True)
+            except Exception:  # unreadable existing index: overwrite it
+                existing = None
+        if existing is not None:
+            index.merge_from(existing)
+        return self.store(bam_path, index)
+
+    # ----------------------------------------------------------- evict
+    def _evict(self, keep: str | None = None) -> None:
+        """Drop least-recently-used shared-dir sidecars past the budget.
+        The entry just written is exempt — evicting it would make a
+        too-small budget cache-bust every write it just did."""
+        if not (self.cache_dir and self.budget_bytes):
+            return
+        try:
+            entries = [
+                (os.path.join(self.cache_dir, name))
+                for name in os.listdir(self.cache_dir)
+                if name.endswith(".sbi")
+            ]
+            stats = []
+            for p in entries:
+                try:
+                    st = os.stat(p)
+                    stats.append((st.st_mtime_ns, st.st_size, p))
+                except OSError:
+                    continue
+            total = sum(s for _, s, _ in stats)
+            obs.gauge("cache.bytes").set(total)
+            if total <= self.budget_bytes:
+                return
+            for _, size, p in sorted(stats):
+                if p == keep:
+                    continue
+                try:
+                    os.unlink(p)
+                except OSError:
+                    continue
+                obs.count("cache.evictions")
+                _record("evicted", f"{size} bytes over budget", p)
+                total -= size
+                if total <= self.budget_bytes:
+                    break
+            obs.gauge("cache.bytes").set(total)
+        except OSError:
+            pass  # eviction is best-effort; the cache stays correct

@@ -1,0 +1,440 @@
+"""Persistent split-index cache (spark_bam_tpu/sbi/): format, store,
+load-path integration, corruption/staleness, concurrency, CLI."""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from spark_bam_tpu import obs
+from spark_bam_tpu.bgzf.block import Metadata
+from spark_bam_tpu.core.config import Config
+from spark_bam_tpu.core.pos import Pos
+from spark_bam_tpu.sbi.format import (
+    PLAN_NONE,
+    PLAN_POS,
+    PLAN_UNRESOLVED,
+    Fingerprint,
+    PlanEntry,
+    SbiFormatError,
+    SbiIndex,
+    config_digest,
+    decode_sbi,
+    encode_sbi,
+    fingerprint_of,
+)
+from spark_bam_tpu.sbi.store import (
+    CacheMode,
+    CacheStore,
+    StaleCacheError,
+    cache_events,
+    cache_status_line,
+    reset_cache_events,
+)
+from tests.bam_factories import random_bam
+
+
+@pytest.fixture
+def bam(tmp_path):
+    path = str(tmp_path / "t.bam")
+    random_bam(path, seed=21)
+    return path
+
+
+@pytest.fixture
+def reg():
+    obs.shutdown()
+    r = obs.configure()
+    reset_cache_events()
+    yield r
+    obs.shutdown()
+    reset_cache_events()
+
+
+def counters(r):
+    return {c["name"]: c["value"] for c in r.snapshot()["counters"]}
+
+
+CFG = Config(split_size=256 << 10, cache="readwrite")
+CFG_OFF = Config(split_size=256 << 10)
+
+
+def load_pairs(path, config):
+    from spark_bam_tpu.load.api import load_reads_and_positions
+
+    return list(load_reads_and_positions(path, config=config))
+
+
+# ----------------------------------------------------------------- format
+
+def _sample_index(cfg=Config()):
+    return SbiIndex(
+        Fingerprint(1000, 2000, 3000, config_digest(cfg)),
+        blocks=[Metadata(0, 50, 120), Metadata(50, 60, 80)],
+        split_plans={
+            2 << 20: [
+                PlanEntry(0, PLAN_POS, Pos(0, 104)),
+                PlanEntry(100, PLAN_NONE, None),
+                PlanEntry(200, PLAN_UNRESOLVED, None),
+            ]
+        },
+        record_starts=np.array([104, 9999, (7 << 16) | 3], dtype=np.uint64),
+    )
+
+
+def test_format_roundtrip():
+    idx = _sample_index()
+    back = decode_sbi(encode_sbi(idx))
+    assert back.fingerprint == idx.fingerprint
+    assert back.blocks == idx.blocks
+    assert back.split_plans == idx.split_plans
+    assert np.array_equal(back.record_starts, idx.record_starts)
+
+
+@pytest.mark.parametrize("mutate", [
+    lambda b: b[: len(b) // 2],                      # truncated
+    lambda b: b[:-1],                                # missing trailer byte
+    lambda b: bytes([b[0] ^ 0xFF]) + b[1:],          # bad magic
+    lambda b: b[:30] + bytes([b[30] ^ 0x01]) + b[31:],  # bit flip
+])
+def test_format_rejects_damage(mutate):
+    blob = encode_sbi(_sample_index())
+    with pytest.raises(SbiFormatError):
+        decode_sbi(mutate(blob))
+
+
+def test_config_digest_covers_checker_knobs():
+    base = config_digest(Config())
+    assert config_digest(Config(reads_to_check=11)) != base
+    assert config_digest(Config(bgzf_blocks_to_check=6)) != base
+    assert config_digest(Config(max_read_size=1)) != base
+    # Knobs that don't move split positions must NOT invalidate.
+    assert config_digest(Config(split_size=1 << 20, warn=True)) == base
+
+
+def test_cache_mode_parse():
+    assert CacheMode.parse("") == CacheMode()
+    assert CacheMode.parse("off") == CacheMode()
+    assert CacheMode.parse("read") == CacheMode(read=True)
+    assert CacheMode.parse("write") == CacheMode(write=True)
+    rw = CacheMode.parse("readwrite")
+    assert rw.read and rw.write and not rw.strict
+    assert CacheMode.parse("readwrite,strict").strict
+    with pytest.raises(ValueError):
+        CacheMode.parse("sideways")
+    assert Config(cache="readwrite").cache_mode == rw
+    assert not Config().cache_mode.enabled
+
+
+def test_from_env_ignores_store_level_vars(monkeypatch):
+    monkeypatch.setenv("SPARK_BAM_CACHE", "read")
+    monkeypatch.setenv("SPARK_BAM_CACHE_DIR", "/nonexistent/cache")
+    monkeypatch.setenv("SPARK_BAM_CACHE_BUDGET", "1MB")
+    cfg = Config.from_env()
+    assert cfg.cache == "read"
+
+
+# ------------------------------------------------------- warm-load contract
+
+def test_warm_load_zero_resolutions_and_identical(bam, reg):
+    baseline = load_pairs(bam, CFG_OFF)
+    assert counters(reg).get("load.split_resolutions", 0) > 0
+    obs.shutdown()
+
+    obs.configure()
+    cold = load_pairs(bam, CFG)  # miss → compute → write-through
+    obs.shutdown()
+    assert cold == baseline
+    assert os.path.exists(bam + ".sbi")
+
+    r = obs.configure()
+    warm = load_pairs(bam, CFG)
+    c = counters(r)
+    assert warm == baseline
+    # The acceptance gate: zero checker invocations on a warm load.
+    assert c.get("load.split_resolutions", 0) == 0
+    assert c.get("cache.hits") == 1
+
+
+def test_read_only_mode_never_writes(bam, reg):
+    load_pairs(bam, Config(split_size=256 << 10, cache="read"))
+    assert not os.path.exists(bam + ".sbi")
+    assert counters(reg).get("cache.misses") == 1
+
+
+def test_stale_sidecar_invalidated_not_trusted(bam, reg):
+    load_pairs(bam, CFG)
+    os.utime(bam, ns=(1234, 1234))  # simulate overwrite
+    r2 = obs.configure() if not obs.enabled() else obs.registry()
+    again = load_pairs(bam, CFG)
+    c = counters(r2)
+    assert c.get("cache.invalidations") == 1
+    assert c.get("load.split_resolutions", 0) > 0  # recomputed, not trusted
+    assert again == load_pairs(bam, CFG_OFF)
+
+
+def test_strict_mode_raises_on_stale(bam, reg):
+    load_pairs(bam, CFG)
+    os.utime(bam, ns=(1234, 1234))
+    with pytest.raises(StaleCacheError):
+        load_pairs(bam, Config(split_size=256 << 10, cache="readwrite,strict"))
+
+
+def test_checker_config_change_invalidates(bam, reg):
+    load_pairs(bam, CFG)
+    changed = CFG.replace(reads_to_check=3)
+    load_pairs(bam, changed)
+    assert counters(reg).get("cache.invalidations") == 1
+
+
+def test_corrupt_sidecar_detected_and_recomputed(bam, reg):
+    """A bit-flipped .sbi (seeded ChaosChannel as the corruption source)
+    is detected, invalidated, and the load output stays byte-identical
+    to the no-cache path."""
+    from spark_bam_tpu.core.channel import MMapChannel
+    from spark_bam_tpu.core.faults import ChaosChannel, ChaosSpec
+
+    baseline = load_pairs(bam, CFG_OFF)
+    obs.shutdown()
+    obs.configure()
+    load_pairs(bam, CFG)  # writes the sidecar
+    obs.shutdown()
+
+    sidecar = bam + ".sbi"
+    clean = open(sidecar, "rb").read()
+    with ChaosChannel(
+        MMapChannel(sidecar), seed=7, spec=ChaosSpec(corrupt=2e-2)
+    ) as ch:
+        damaged = bytes(ch.read_at(0, ch.size))
+    assert damaged != clean  # the seed must actually flip something
+    with open(sidecar, "wb") as f:
+        f.write(damaged)
+
+    r = obs.configure()
+    warm = load_pairs(bam, CFG)
+    c = counters(r)
+    assert warm == baseline
+    assert c.get("cache.invalidations") == 1
+    assert c.get("load.split_resolutions", 0) > 0
+    # The write-through replaced the damaged sidecar; next load is warm.
+    obs.shutdown()
+    r2 = obs.configure()
+    assert load_pairs(bam, CFG) == baseline
+    assert counters(r2).get("load.split_resolutions", 0) == 0
+
+
+def test_truncated_sidecar_detected(bam, reg):
+    load_pairs(bam, CFG)
+    sidecar = bam + ".sbi"
+    blob = open(sidecar, "rb").read()
+    with open(sidecar, "wb") as f:
+        f.write(blob[: len(blob) // 2])
+    obs.shutdown()
+    r = obs.configure()
+    assert load_pairs(bam, CFG) == load_pairs(bam, CFG_OFF)
+    assert counters(r).get("cache.invalidations") == 1
+
+
+# ------------------------------------------------------------- concurrency
+
+def test_concurrent_writers_never_tear(bam, tmp_path):
+    """Writers racing os.replace on one sidecar: every observable file
+    state decodes cleanly (atomicity), including from racing threads of
+    ONE process (where a bare pid suffix would collide)."""
+    fp = fingerprint_of(bam, Config())
+    store = CacheStore()
+    sidecar = store.sidecar_path(bam)
+    stop = threading.Event()
+    errors = []
+
+    def writer(k):
+        idx = SbiIndex(
+            fp, blocks=[Metadata(0, k + 1, k + 2)] * (k + 1)
+        )
+        try:
+            for _ in range(50):
+                store.store(bam, idx)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+        finally:
+            stop.set()
+
+    def reader():
+        while not stop.is_set():
+            try:
+                decode_sbi(open(sidecar, "rb").read())
+            except FileNotFoundError:
+                continue
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=writer, args=(k,)) for k in range(4)]
+    threads.append(threading.Thread(target=reader))
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    final = decode_sbi(open(sidecar, "rb").read())  # never torn
+    assert final.fingerprint == fp
+    assert not [p for p in os.listdir(os.path.dirname(sidecar))
+                if ".sbi.tmp" in p]  # no tmp litter
+
+
+# ------------------------------------------------- store: location/eviction
+
+def test_content_addressed_under_cache_dir(bam, tmp_path, monkeypatch):
+    cache_dir = tmp_path / "cachedir"
+    monkeypatch.setenv("SPARK_BAM_CACHE_DIR", str(cache_dir))
+    load_pairs(bam, CFG)
+    assert not os.path.exists(bam + ".sbi")  # shared dir, not adjacent
+    entries = list(cache_dir.glob("*.sbi"))
+    assert len(entries) == 1
+    obs.shutdown()
+    r = obs.configure()
+    load_pairs(bam, CFG)
+    assert counters(r).get("load.split_resolutions", 0) == 0
+    obs.shutdown()
+
+
+def test_lru_eviction_respects_budget(tmp_path, monkeypatch, reg):
+    cache_dir = tmp_path / "cachedir"
+    monkeypatch.setenv("SPARK_BAM_CACHE_DIR", str(cache_dir))
+    store = CacheStore.from_env()
+    one = store.store("a.bam", _sample_index())
+    size_one = os.path.getsize(one)
+    monkeypatch.setenv("SPARK_BAM_CACHE_BUDGET", str(int(size_one * 1.5)))
+    store = CacheStore.from_env()
+    assert store.budget_bytes == int(size_one * 1.5)
+    os.utime(one, ns=(10**9, 10**9))  # make "a" clearly the oldest
+    two = store.store("b.bam", _sample_index())
+    assert not os.path.exists(one)  # LRU victim
+    assert os.path.exists(two)      # the fresh write is exempt
+    assert counters(reg).get("cache.evictions") == 1
+
+
+def test_remote_bam_without_cache_dir_skips_write(reg, monkeypatch):
+    monkeypatch.delenv("SPARK_BAM_CACHE_DIR", raising=False)
+    store = CacheStore.from_env()
+    assert store.store("https://example.com/x.bam", _sample_index()) is None
+    assert [e.state for e in cache_events()] == ["skipped"]
+
+
+# ------------------------------------------------------- blocks satellite
+
+def test_blocks_metadata_validates_sidecar(bam):
+    from spark_bam_tpu.bgzf.index_blocks import (
+        StaleBlocksIndexError,
+        blocks_metadata,
+        index_blocks,
+    )
+
+    out, n = index_blocks(bam)
+    assert len(list(blocks_metadata(bam))) == n
+    with open(out, "a") as f:  # stale garbage appended
+        f.write("999999999,100,100\n")
+    rescanned = list(blocks_metadata(bam))
+    assert len(rescanned) == n  # fell back to the scan, same answer
+    with pytest.raises(StaleBlocksIndexError):
+        blocks_metadata(bam, strict=True)
+    os.unlink(out)
+    assert len(list(blocks_metadata(bam))) == n  # plain scan path
+
+
+def test_validate_blocks_index_rules():
+    from spark_bam_tpu.bgzf.index_blocks import validate_blocks_index
+
+    chain = [Metadata(0, 100, 50), Metadata(100, 100, 50)]
+    assert validate_blocks_index(chain, 200) is None
+    assert validate_blocks_index(chain, 228) is None  # EOF sentinel
+    assert validate_blocks_index(chain, 300) is not None  # short coverage
+    assert validate_blocks_index([], 200) is not None
+    assert validate_blocks_index(
+        [Metadata(5, 100, 50)], 105
+    ) is not None  # doesn't start at 0
+    assert validate_blocks_index(
+        [Metadata(0, 100, 50), Metadata(150, 50, 20)], 200
+    ) is not None  # gap
+
+
+# ------------------------------------------------------------ TPU fast path
+
+def test_record_starts_cache_roundtrip(bam, reg):
+    from spark_bam_tpu.load.tpu_load import record_starts
+
+    cold = record_starts(bam, CFG)
+    warm = record_starts(bam, CFG)
+    assert np.array_equal(cold.starts, warm.starts)
+    c = counters(reg)
+    assert c.get("cache.hits") == 1
+    # Warm run did no checker work: exactly one check.window span (cold's).
+    spans = [e for e in reg.events() if e.get("name") == "check.window"]
+    assert len(spans) == 1
+
+
+# --------------------------------------------------------------------- CLI
+
+def test_cli_index_then_warm_compute_splits(bam, capsys):
+    from spark_bam_tpu.cli.main import main
+
+    assert main(["index", "-m", "256KB", bam]) == 0
+    out = capsys.readouterr().out
+    assert "Wrote" in out and ".sbi" in out
+    assert main(["compute-splits", "--cache", "read", "-s", "-m", "256KB",
+                 bam]) == 0
+    out = capsys.readouterr().out
+    assert "cache: hit" in out
+
+
+def test_cli_cache_line_reports_miss(bam, capsys):
+    from spark_bam_tpu.cli.main import main
+
+    assert main(["compute-splits", "--cache", "read", "-s", "-m", "256KB",
+                 bam]) == 0
+    out = capsys.readouterr().out
+    assert "cache: miss" in out
+
+
+def test_cli_check_bam_prints_cache_probe(bam, capsys):
+    from spark_bam_tpu.bam.index_records import index_records
+    from spark_bam_tpu.cli.main import main
+
+    index_records(bam)
+    assert main(["check-bam", "--cache", "read", "-s", bam]) == 0
+    out = capsys.readouterr().out
+    assert "cache: miss" in out
+    assert main(["index", "-m", "256KB", bam]) == 0
+    capsys.readouterr()
+    assert main(["check-bam", "--cache", "read", "-s", bam]) == 0
+    out = capsys.readouterr().out
+    assert "cache: hit" in out
+
+
+def test_cli_rejects_bad_cache_mode(bam, capsys):
+    from spark_bam_tpu.cli.main import main
+
+    assert main(["compute-splits", "--cache", "sideways", "-s", bam]) == 2
+
+
+def test_cache_status_line_off():
+    line = cache_status_line("whatever.bam", Config())
+    assert line.startswith("cache: off")
+
+
+def test_splits_identical_cold_warm_and_uncached(bam, capsys):
+    """compute-splits output (the split list itself) must be identical
+    across uncached, cold-cache, and warm-cache runs."""
+    from spark_bam_tpu.cli.app import CheckerContext
+    from spark_bam_tpu.cli.output import Printer
+    from spark_bam_tpu.cli.splits_util import spark_bam_splits
+
+    def splits_with(cfg):
+        ctx = CheckerContext(bam, cfg, Printer())
+        return spark_bam_splits(ctx, 256 << 10)
+
+    uncached = splits_with(CFG_OFF)
+    cold = splits_with(CFG)
+    warm = splits_with(CFG)
+    assert uncached == cold == warm
