@@ -12,10 +12,27 @@ from __future__ import annotations
 import struct
 from dataclasses import dataclass
 
+from spark_bam_tpu.core.guard import StructurallyInvalid, TruncatedInput
 from spark_bam_tpu.core.pos import Pos
 
 METADATA_BIN_ID = 37450  # magic bin holding per-reference metadata pseudo-chunks
 LINEAR_INDEX_SHIFT = 14  # 16 KiB linear-index windows
+
+
+def _bai_count(n: int, what: str, data: bytes, off: int, item_size: int,
+               path) -> int:
+    """Validate an index count before it sizes a loop or an allocation: a
+    corrupt ``n_intv`` used to size a multi-GB ``struct.unpack_from``."""
+    if n < 0:
+        raise StructurallyInvalid(
+            f".bai {what} is negative: {n}", path=str(path), pos=off
+        )
+    if off + n * item_size > len(data):
+        raise TruncatedInput(
+            f".bai {what} {n} needs {n * item_size} bytes, "
+            f"have {len(data) - off}", path=str(path), pos=off,
+        )
+    return n
 
 
 @dataclass(frozen=True)
@@ -45,19 +62,32 @@ class BaiIndex:
         with open(path, "rb") as f:
             data = f.read()
         if data[:4] != b"BAI\x01":
-            raise ValueError(f"Not a BAI index: bad magic {data[:4]!r}")
+            raise StructurallyInvalid(
+                f"Not a BAI index: bad magic {data[:4]!r}", path=str(path)
+            )
+        try:
+            return BaiIndex._parse(data, path)
+        except struct.error as e:
+            raise TruncatedInput(f"truncated .bai: {e}", path=str(path)) from e
+
+    @staticmethod
+    def _parse(data: bytes, path) -> "BaiIndex":
         off = 4
         (n_ref,) = struct.unpack_from("<i", data, off)
         off += 4
+        # 8 = the per-reference minimum (n_bin i32 + n_intv i32).
+        _bai_count(n_ref, "n_ref", data, off, 8, path)
         refs = []
         for _ in range(n_ref):
             (n_bin,) = struct.unpack_from("<i", data, off)
             off += 4
+            _bai_count(n_bin, "n_bin", data, off, 8, path)
             bins: dict[int, list[Chunk]] = {}
             meta: list[Chunk] = []
             for _ in range(n_bin):
                 bin_id, n_chunk = struct.unpack_from("<Ii", data, off)
                 off += 8
+                _bai_count(n_chunk, "n_chunk", data, off, 16, path)
                 chunks = []
                 for _ in range(n_chunk):
                     beg, end = struct.unpack_from("<QQ", data, off)
@@ -69,6 +99,7 @@ class BaiIndex:
                     bins[bin_id] = chunks
             (n_intv,) = struct.unpack_from("<i", data, off)
             off += 4
+            _bai_count(n_intv, "n_intv", data, off, 8, path)
             linear = list(struct.unpack_from(f"<{n_intv}Q", data, off))
             off += 8 * n_intv
             refs.append(Reference(bins, linear, meta))

@@ -13,6 +13,12 @@ from typing import Mapping
 
 from spark_bam_tpu.bgzf.stream import BlockStream, UncompressedBytes
 from spark_bam_tpu.core.channel import ByteChannel, open_channel
+from spark_bam_tpu.core.guard import (
+    StructurallyInvalid,
+    TruncatedInput,
+    check_count,
+    current_limits,
+)
 from spark_bam_tpu.core.pos import Pos
 
 
@@ -59,23 +65,42 @@ class BamHeader:
 
 
 def parse_header(u: UncompressedBytes, keep_text: bool = True) -> BamHeader:
-    """Parse from an uncompressed-byte stream positioned at 0."""
+    """Parse from an uncompressed-byte stream positioned at 0.
+
+    Every length/count field is bounds-checked against ``DecodeLimits``
+    before it sizes an allocation or a loop (a corrupt ``text_len`` used
+    to ``read_fully`` gigabytes; a corrupt ``num_refs`` used to iterate
+    2³¹ times); truncation mid-header raises ``TruncatedInput``.
+    """
+    lim = current_limits()
     magic = u.read_fully(4)
     if magic != b"BAM\x01":
-        raise ValueError(f"Not a BAM: bad magic {magic!r}")
-    text_len = u.read_i32()
-    if keep_text:
-        text = u.read_fully(text_len).decode("latin-1").rstrip("\x00")
-    else:
-        u.skip(text_len)
-        text = ""
-    num_refs = u.read_i32()
-    entries = {}
-    for idx in range(num_refs):
-        name_len = u.read_i32()
-        name = u.read_fully(name_len).rstrip(b"\x00").decode("latin-1")
-        length = u.read_i32()
-        entries[idx] = (name, length)
+        raise StructurallyInvalid(f"Not a BAM: bad magic {magic!r}")
+    try:
+        text_len = check_count(
+            u.read_i32(), "BAM header text_len", lim.max_header_text
+        )
+        if keep_text:
+            text = u.read_fully(text_len).decode("latin-1").rstrip("\x00")
+        else:
+            if u.skip(text_len) != text_len:
+                raise EOFError(f"header text: wanted {text_len} bytes")
+            text = ""
+        num_refs = check_count(
+            u.read_i32(), "BAM header num_refs", lim.max_refs
+        )
+        entries = {}
+        for idx in range(num_refs):
+            name_len = check_count(
+                u.read_i32(), f"BAM ref {idx} name_len", lim.max_name_len
+            )
+            name = u.read_fully(name_len).rstrip(b"\x00").decode("latin-1")
+            length = check_count(u.read_i32(), f"BAM ref {idx} length")
+            entries[idx] = (name, length)
+    except EOFError as e:
+        # A header is never optional: bytes that end inside it are a
+        # malformed file, not a clean truncation.
+        raise TruncatedInput(f"BAM header truncated: {e}") from e
     end_pos = u.cur_pos()
     if end_pos is None:
         # Header-only BAM: first-record position is one past the last byte.

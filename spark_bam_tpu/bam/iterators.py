@@ -19,8 +19,34 @@ from spark_bam_tpu.bgzf.stream import (
     SeekableUncompressedBytes,
     UncompressedBytes,
 )
+from spark_bam_tpu.core import guard
 from spark_bam_tpu.core.channel import ByteChannel
+from spark_bam_tpu.core.guard import (
+    LimitExceeded,
+    MalformedInputError,
+    RecordGapError,
+    StructurallyInvalid,
+    current_limits,
+)
 from spark_bam_tpu.core.pos import Pos
+
+#: Smallest well-formed record body: 32 fixed field bytes + the name's NUL.
+MIN_RECORD_BODY = 33
+
+
+def _check_length_prefix(remaining: int, lim, pos: Pos) -> int:
+    """Validate a record's length prefix before it sizes a read."""
+    if remaining < MIN_RECORD_BODY:
+        raise StructurallyInvalid(
+            f"BAM record block_size {remaining} smaller than its fixed "
+            f"fields", pos=pos,
+        )
+    if remaining > lim.max_record_bytes:
+        raise LimitExceeded(
+            f"BAM record block_size {remaining} exceeds limit "
+            f"{lim.max_record_bytes}", pos=pos,
+        )
+    return remaining
 
 
 class _RecordIteratorBase:
@@ -55,11 +81,14 @@ class PosStream(_RecordIteratorBase):
     """
 
     def __iter__(self) -> Iterator[Pos]:
+        lim = current_limits()
         while True:
             pos = self.cur_pos()
             if pos is None:
                 return
-            remaining = self.u.read_i32()  # EOFError propagates
+            remaining = _check_length_prefix(
+                self.u.read_i32(), lim, pos  # EOFError propagates
+            )
             self.u.skip(remaining)
             yield pos
 
@@ -69,21 +98,61 @@ class PosStream(_RecordIteratorBase):
 
 
 class RecordStream(_RecordIteratorBase):
-    """Yield (Pos, BamRecord) pairs."""
+    """Yield (Pos, BamRecord) pairs.
+
+    On a tolerant underlying stream (``FaultPolicy.mode=tolerant``) a
+    record that fails to decode is quarantined instead of raised: its
+    length prefix already positioned the stream at the next record, so
+    iteration skips exactly the damaged record, appends ``(pos, error)``
+    to ``self.quarantined`` and counts ``guard.quarantined_records``. An
+    untrustworthy length *prefix* can't be locally skipped — that raises
+    ``RecordGapError`` once so the load layer re-finds a provable record
+    boundary with the checker (load/api.py), the ``BlockGapError`` analog.
+    """
+
+    def __init__(self, u: UncompressedBytes, header: BamHeader | None = None):
+        super().__init__(u, header)
+        self.quarantined: list[tuple[Pos, MalformedInputError]] = []
 
     def __iter__(self) -> Iterator[tuple[Pos, BamRecord]]:
+        lim = current_limits()
+        tolerant = getattr(self.u.stream, "tolerant", False)
         while True:
             pos = self.cur_pos()
             if pos is None:
                 return
             try:
                 remaining = self.u.read_i32()
+            except EOFError:
+                return
+            try:
+                _check_length_prefix(remaining, lim, pos)
+            except MalformedInputError as e:
+                if not tolerant:
+                    raise
+                self.quarantined.append((pos, e))
+                guard.note_quarantined_records()
+                raise RecordGapError(pos, str(e)) from e
+            try:
                 body = self.u.read_fully(remaining)
             except EOFError:
                 return
-            rec, _ = BamRecord.decode(
-                remaining.to_bytes(4, "little", signed=True) + body
-            )
+            try:
+                rec, _ = BamRecord.decode(
+                    remaining.to_bytes(4, "little", signed=True) + body,
+                    limits=lim,
+                )
+            except MalformedInputError as e:
+                if not tolerant:
+                    if e.pos is None:
+                        e.pos = pos
+                        e.args = (f"{e} [at {pos}]",)
+                    raise
+                # The prefix was sane, so the stream already stands at the
+                # next record: lose exactly this one and continue.
+                self.quarantined.append((pos, e))
+                guard.note_quarantined_records()
+                continue
             yield pos, rec
 
     @staticmethod

@@ -24,6 +24,14 @@ from __future__ import annotations
 import struct
 from dataclasses import dataclass, field
 
+from spark_bam_tpu.core.guard import (
+    DecodeLimits,
+    LimitExceeded,
+    StructurallyInvalid,
+    TruncatedInput,
+    current_limits,
+)
+
 CIGAR_OPS = "MIDNSHP=X"
 SEQ_CODES = "=ACMGRSVTWYHKDBN"
 
@@ -50,8 +58,23 @@ class BamRecord:
 
     # ------------------------------------------------------------------ decode
     @staticmethod
-    def decode(buf: bytes | memoryview, offset: int = 0) -> tuple["BamRecord", int]:
-        """Decode one record; returns (record, bytes consumed incl. length prefix)."""
+    def decode(
+        buf: bytes | memoryview, offset: int = 0,
+        limits: DecodeLimits | None = None,
+    ) -> tuple["BamRecord", int]:
+        """Decode one record; returns (record, bytes consumed incl. length prefix).
+
+        Every length field is validated before it sizes a slice or a loop:
+        truncation raises ``TruncatedInput``, contradictory fields raise
+        ``StructurallyInvalid``, fields beyond ``limits`` raise
+        ``LimitExceeded`` — never a silent short slice (core/guard.py).
+        """
+        lim = limits or current_limits()
+        avail = len(buf) - offset
+        if avail < 36:  # length prefix + the 32 fixed field bytes
+            raise TruncatedInput(
+                f"BAM record fixed section: need 36 bytes, have {avail}"
+            )
         (
             block_size,
             ref_id,
@@ -66,6 +89,43 @@ class BamRecord:
             next_pos,
             tlen,
         ) = _FIXED.unpack_from(buf, offset)
+        if block_size < 32 + 1:  # fixed fields + the name's NUL
+            raise StructurallyInvalid(
+                f"BAM record block_size {block_size} smaller than its "
+                f"fixed fields"
+            )
+        if block_size > lim.max_record_bytes:
+            raise LimitExceeded(
+                f"BAM record block_size {block_size} exceeds limit "
+                f"{lim.max_record_bytes}"
+            )
+        if 4 + block_size > avail:
+            raise TruncatedInput(
+                f"BAM record: declared {4 + block_size} bytes, have {avail}"
+            )
+        if l_read_name == 0:
+            raise StructurallyInvalid(
+                "BAM record l_read_name is 0 (name must be NUL-terminated)"
+            )
+        if l_seq < 0:
+            raise StructurallyInvalid(f"BAM record l_seq is negative ({l_seq})")
+        if l_seq > lim.max_seq_len:
+            raise LimitExceeded(
+                f"BAM record l_seq {l_seq} exceeds limit {lim.max_seq_len}"
+            )
+        if n_cigar > lim.max_cigar_ops:
+            raise LimitExceeded(
+                f"BAM record n_cigar {n_cigar} exceeds limit "
+                f"{lim.max_cigar_ops}"
+            )
+        # The declared sub-regions must fit the declared extent — a short
+        # slice here used to yield a silently-wrong record.
+        need = 32 + l_read_name + 4 * n_cigar + (l_seq + 1) // 2 + l_seq
+        if need > block_size:
+            raise StructurallyInvalid(
+                f"BAM record fields need {need} bytes but block_size is "
+                f"{block_size}"
+            )
         p = offset + 36
         read_name = bytes(buf[p: p + l_read_name - 1]).decode("latin-1")
         p += l_read_name
@@ -181,7 +241,13 @@ class BamRecord:
 
 
 def render_tags(raw: bytes) -> list[str]:
-    """Render the raw tag block as SAM ``TAG:TYPE:VALUE`` strings."""
+    """Render the raw tag block as SAM ``TAG:TYPE:VALUE`` strings.
+
+    Total on arbitrary bytes: any inconsistency (short value, missing NUL,
+    negative/overflowing B-array count, unknown subtype) stops rendering
+    at that tag — never an unbounded loop or an untyped crash (the raw
+    bytes stay preserved on the record either way).
+    """
     out = []
     p = 0
     n = len(raw)
@@ -190,29 +256,42 @@ def render_tags(raw: bytes) -> list[str]:
         typ = chr(raw[p + 2])
         p += 3
         if typ == "A":
+            if p >= n:
+                break
             out.append(f"{tag}:A:{chr(raw[p])}")
             p += 1
         elif typ in "cCsSiI":
             fmt, size = {"c": ("<b", 1), "C": ("<B", 1), "s": ("<h", 2),
                          "S": ("<H", 2), "i": ("<i", 4), "I": ("<I", 4)}[typ]
+            if p + size > n:
+                break
             val = struct.unpack_from(fmt, raw, p)[0]
             out.append(f"{tag}:i:{val}")
             p += size
         elif typ == "f":
+            if p + 4 > n:
+                break
             val = struct.unpack_from("<f", raw, p)[0]
             out.append(f"{tag}:f:{val:g}")
             p += 4
         elif typ in "ZH":
-            end = raw.index(b"\x00", p)
+            end = raw.find(b"\x00", p)
+            if end < 0:
+                break
             out.append(f"{tag}:{typ}:{raw[p:end].decode('latin-1')}")
             p = end + 1
         elif typ == "B":
+            if p + 5 > n:
+                break
             sub = chr(raw[p])
             count = struct.unpack_from("<i", raw, p + 1)[0]
             p += 5
-            fmt, size = {"c": ("<b", 1), "C": ("<B", 1), "s": ("<h", 2),
-                         "S": ("<H", 2), "i": ("<i", 4), "I": ("<I", 4),
-                         "f": ("<f", 4)}[sub]
+            entry = {"c": ("<b", 1), "C": ("<B", 1), "s": ("<h", 2),
+                     "S": ("<H", 2), "i": ("<i", 4), "I": ("<I", 4),
+                     "f": ("<f", 4)}.get(sub)
+            if entry is None or count < 0 or p + count * entry[1] > n:
+                break
+            fmt, size = entry
             vals = [str(struct.unpack_from(fmt, raw, p + i * size)[0]) for i in range(count)]
             out.append(f"{tag}:B:{sub},{','.join(vals)}")
             p += count * size

@@ -24,12 +24,9 @@ from __future__ import annotations
 import numpy as np
 
 from spark_bam_tpu.bgzf.block import MAX_BLOCK_SIZE
-from spark_bam_tpu.bgzf.header import (
-    Header,
-    HeaderParseException,
-    HeaderSearchFailedException,
-)
+from spark_bam_tpu.bgzf.header import Header, HeaderSearchFailedException
 from spark_bam_tpu.core.channel import ByteChannel
+from spark_bam_tpu.core.guard import StructurallyInvalid
 
 
 def find_block_start(
@@ -67,7 +64,8 @@ def find_block_start(
             try:
                 _check_chain(ch, start + off, bgzf_blocks_to_check)
                 return start + off
-            except (HeaderParseException, EOFError):
+            except (StructurallyInvalid, EOFError):
+                # HeaderParseException or a bad XLEN/BSIZE: not a block start.
                 continue
     raise HeaderSearchFailedException(path, start, min(MAX_BLOCK_SIZE, size - start))
 
@@ -88,7 +86,7 @@ def find_block_start_sequential(
         try:
             _check_chain(ch, pos, bgzf_blocks_to_check)
             return pos
-        except (HeaderParseException, EOFError):
+        except (StructurallyInvalid, EOFError):
             continue
     raise HeaderSearchFailedException(path, start, min(MAX_BLOCK_SIZE, size - start))
 
@@ -134,9 +132,15 @@ def find_block_starts_np(
         & (buf[13:m + 13] == 67)
         & (buf[14:m + 14] == 2)
     )
+    # Match Header.parse's structural checks: XLEN must hold the BC
+    # subfield and BSIZE must cover header + footer (xlen + 20 bytes).
+    xlen = (
+        buf[10:m + 10].astype(np.int64) | (buf[11:m + 11].astype(np.int64) << 8)
+    )
     csize = (
         buf[16:m + 16].astype(np.int64) | (buf[17:m + 17].astype(np.int64) << 8)
     ) + 1
+    ok &= (xlen >= 6) & (csize >= xlen + 20)
     nxt = np.arange(m, dtype=np.int64) + csize
     # Chain n_chain-1 jumps: header at i valid & header at i+csize valid & ...
     chain_ok = ok.copy()

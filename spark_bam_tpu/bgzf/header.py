@@ -9,7 +9,12 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 
+from spark_bam_tpu.core.guard import StructurallyInvalid
+
 EXPECTED_HEADER_SIZE = 18
+#: Minimum XLEN: the mandatory 6-byte "BC" extra subfield (2 id + 2 len +
+#: 2 payload). Anything smaller cannot carry the block size.
+MIN_XLEN = 6
 
 # (index, expected byte): gzip magic + deflate + FEXTRA, then the BAM "BC" subfield
 _MAGIC_CHECKS = (
@@ -23,13 +28,14 @@ _MAGIC_CHECKS = (
 )
 
 
-class HeaderParseException(Exception):
+class HeaderParseException(StructurallyInvalid):
     """A fixed header byte didn't match.
 
     Message format matches the reference ("Position %d: %d != %d",
     bgzf/.../block/HeaderParseException.scala:5-11) — it is a user-visible
     contract (load tests assert "Position 0: 64 != 31" when a SAM is loaded
-    as BAM).
+    as BAM). Part of the ``MalformedInputError`` taxonomy (core/guard.py)
+    so block scanners and the fault model classify it uniformly.
     """
 
     def __init__(self, idx: int, actual: int, expected: int):
@@ -59,7 +65,8 @@ class Header:
 
     @staticmethod
     def parse(buf: bytes | memoryview) -> "Header":
-        """Parse from ≥18 bytes. Raises HeaderParseException / EOFError."""
+        """Parse from ≥18 bytes. Raises HeaderParseException /
+        StructurallyInvalid / EOFError."""
         if len(buf) < EXPECTED_HEADER_SIZE:
             raise EOFError(
                 f"Expected {EXPECTED_HEADER_SIZE} header bytes, got {len(buf)}"
@@ -69,13 +76,25 @@ class Header:
             if actual != expected:
                 raise HeaderParseException(idx, actual, expected)
         xlen = buf[10] | (buf[11] << 8)
-        extra = xlen - 6
+        if xlen < MIN_XLEN:
+            # No room for the mandatory BC subfield; a negative ``extra``
+            # here used to misparse the whole block geometry.
+            raise StructurallyInvalid(
+                f"BGZF XLEN {xlen} < {MIN_XLEN}: no BC subfield"
+            )
+        extra = xlen - MIN_XLEN
         for idx, expected in _MAGIC_CHECKS[4:]:
             actual = buf[idx]
             if actual != expected:
                 raise HeaderParseException(idx, actual, expected)
         compressed_size = (buf[16] | (buf[17] << 8)) + 1
-        return Header(EXPECTED_HEADER_SIZE + extra, compressed_size)
+        header_size = EXPECTED_HEADER_SIZE + extra
+        if compressed_size < header_size + 8:  # + CRC32/ISIZE footer
+            raise StructurallyInvalid(
+                f"BGZF BSIZE {compressed_size - 1} too small for its own "
+                f"header ({header_size} bytes) + footer"
+            )
+        return Header(header_size, compressed_size)
 
     @staticmethod
     def read(ch) -> "Header":

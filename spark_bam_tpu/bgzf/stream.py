@@ -22,14 +22,16 @@ from collections import OrderedDict
 from typing import Iterator, Optional
 
 from spark_bam_tpu import obs
-from spark_bam_tpu.bgzf.block import Block, Metadata, FOOTER_SIZE
-from spark_bam_tpu.bgzf.header import Header, HeaderParseException
+from spark_bam_tpu.bgzf.block import Block, Metadata, FOOTER_SIZE, check_isize
+from spark_bam_tpu.bgzf.header import Header
+from spark_bam_tpu.core import guard
 from spark_bam_tpu.core.channel import ByteChannel
 from spark_bam_tpu.core.faults import (
     BlockCorruptionError,
     BlockGapError,
     ShortReadError,
 )
+from spark_bam_tpu.core.guard import MalformedInputError
 from spark_bam_tpu.core.pos import Pos
 
 
@@ -63,7 +65,9 @@ def read_block(ch: ByteChannel) -> Optional[Block]:
     remaining = header.compressed_size - header.size
     payload = ch.read_fully(remaining)
     data_length = remaining - FOOTER_SIZE
-    uncompressed_size = int.from_bytes(payload[-4:], "little")
+    uncompressed_size = check_isize(
+        int.from_bytes(payload[-4:], "little"), start
+    )
     if data_length == 2:
         # 28-byte empty terminator block (reference Stream.scala:56-58)
         return None
@@ -124,7 +128,9 @@ class BlockStream:
             if not self.tolerant:
                 raise err from e
             self._resync(start, err)
-        except (BlockCorruptionError, HeaderParseException) as e:
+        except (BlockCorruptionError, MalformedInputError) as e:
+            # MalformedInputError covers HeaderParseException plus the
+            # structural guards (bad XLEN/BSIZE/ISIZE, core/guard.py).
             if not self.tolerant:
                 raise
             self._resync(start, e)
@@ -145,6 +151,7 @@ class BlockStream:
         )
         self.quarantined.append(gap)
         obs.count("faults.quarantined_blocks")
+        guard.note_quarantined_block()
         raise gap from err
 
     def head(self) -> Optional[Block]:

@@ -64,10 +64,20 @@ def _add_cache(sub):
     )
 
 
+def _add_limits(sub):
+    sub.add_argument(
+        "--limits", default=None, metavar="SPEC",
+        help="decode resource limits for untrusted input, e.g. "
+             "'record=32MB,refs=1000,cigar=65536,alloc=1GB' "
+             "(SPARK_BAM_LIMITS env var works too; docs/robustness.md)",
+    )
+
+
 def _add_common(sub, split_default=None):
     _add_metrics(sub)
     _add_faults(sub)
     _add_cache(sub)
+    _add_limits(sub)
     sub.add_argument("-m", "--max-split-size", default=split_default,
                      help="split size (byte shorthand like 2MB ok)")
     sub.add_argument("-l", "--print-limit", type=int, default=10)
@@ -209,6 +219,21 @@ def build_parser() -> argparse.ArgumentParser:
     sub.add_argument("in_path")
     sub.add_argument("out_path")
 
+    # Structure-aware mutation fuzzing of the decode boundary
+    # (tools/fuzz_decode.py; docs/robustness.md "Malformed inputs").
+    sub = sp.add_parser("fuzz-decode")
+    _add_limits(sub)
+    sub.add_argument("--seed", type=int, default=0,
+                     help="base seed; the same seed replays the same mutants")
+    sub.add_argument("--mutants", type=int, default=200,
+                     help="mutants per corpus format (default 200)")
+    sub.add_argument(
+        "--formats", default="bam,bgzf,cram,sbi",
+        help="comma-separated corpus formats to fuzz (default all)",
+    )
+    sub.add_argument("-o", "--out", default=None,
+                     help="write the JSON summary here instead of stdout")
+
     # Render a --metrics-out JSONL trace as the reference stats format.
     sub = sp.add_parser("metrics-report")
     sub.add_argument("-o", "--out", default=None, help="write output to file")
@@ -254,6 +279,13 @@ def main(argv=None) -> int:
 
             CacheMode.parse(args.cache)  # fail before any work starts
             config = config.replace(cache=args.cache)
+        if getattr(args, "limits", None) is not None:
+            from spark_bam_tpu.core.guard import DecodeLimits, set_limits
+
+            # Fail before any work starts, then install process-wide so
+            # every parser this invocation touches decodes under them.
+            set_limits(DecodeLimits.parse(args.limits))
+            config = config.replace(limits=args.limits)
         if getattr(args, "chaos", None):
             chaos_state = install_chaos(args.chaos)
     except ValueError as e:
@@ -383,6 +415,21 @@ def main(argv=None) -> int:
                 block_payload=parse_bytes(args.block_payload),
                 reindex=args.index,
             )
+        elif cmd == "fuzz-decode":
+            from spark_bam_tpu.tools.fuzz_decode import run_fuzz
+
+            summary = run_fuzz(
+                seed=args.seed,
+                mutants_per_format=args.mutants,
+                formats=tuple(
+                    f for f in args.formats.split(",") if f.strip()
+                ),
+            )
+            import json
+
+            p.echo(json.dumps(summary, indent=2, sort_keys=True))
+            if summary["violations"]:
+                return 1
         elif cmd == "metrics-report":
             from spark_bam_tpu.cli import metrics_report
 
@@ -391,7 +438,8 @@ def main(argv=None) -> int:
         # retry/hedge/quarantine, say so (the quarantine list is the
         # operator's cue that the output is a degraded-but-complete run).
         rep = last_report()
-        if rep is not None and (rep.retries or rep.hedges or rep.quarantined):
+        if rep is not None and (rep.retries or rep.hedges or rep.quarantined
+                                or rep.lost_records or rep.lost_blocks):
             p.echo(rep.summary())
         if chaos_state is not None:
             injected = ", ".join(

@@ -103,6 +103,10 @@ class Config:
     # parses it. Sidecar location/budget come from SPARK_BAM_CACHE_DIR /
     # SPARK_BAM_CACHE_BUDGET (store-level, not Config knobs).
     cache: str = ""
+    # --- decode limits (core/guard.py; docs/robustness.md) ---
+    # Compact DecodeLimits spec ("record=32MB,refs=1000"; "" = defaults).
+    # Same string-spec pattern; ``decode_limits`` parses it (cached).
+    limits: str = ""
     # --- misc ---
     warn: bool = False                  # root log-level toggle (args/LogArgs.scala:30-33)
     # Accepted for config-surface parity (PostPartitionArgs -p, default
@@ -136,6 +140,13 @@ class Config:
         from spark_bam_tpu.sbi.store import CacheMode
 
         return CacheMode.parse(self.cache)
+
+    @property
+    def decode_limits(self):
+        """The parsed ``DecodeLimits`` for this config's ``limits`` spec."""
+        from spark_bam_tpu.core.guard import DecodeLimits
+
+        return DecodeLimits.parse(self.limits)
 
     def split_size_or(self, default: int) -> int:
         return self.split_size if self.split_size is not None else default

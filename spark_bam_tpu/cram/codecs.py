@@ -23,6 +23,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 
+from spark_bam_tpu.core.guard import StructurallyInvalid, TruncatedInput
 from spark_bam_tpu.cram.nums import Cursor, itf8
 
 EXTERNAL = 1
@@ -31,6 +32,11 @@ BYTE_ARRAY_LEN = 4
 BYTE_ARRAY_STOP = 5
 BETA = 6
 GAMMA = 9
+
+#: Widest sane bit-field: 64 bits covers every CRAM data series. A larger
+#: declared width (BETA length, Huffman code length) is a corrupt header;
+#: uncapped, it sizes a per-value bit loop (a 2³¹-bit read per record).
+MAX_CODE_BITS = 64
 
 
 class BitReader:
@@ -44,7 +50,12 @@ class BitReader:
         self.bit = 7
 
     def read_bit(self) -> int:
-        b = (self.buf[self.pos] >> self.bit) & 1
+        try:
+            b = (self.buf[self.pos] >> self.bit) & 1
+        except IndexError:
+            raise TruncatedInput(
+                f"core bit stream exhausted at byte {self.pos}"
+            ) from None
         if self.bit == 0:
             self.bit = 7
             self.pos += 1
@@ -157,6 +168,10 @@ class Decoders:
             p = Cursor(enc.params)
             offset = p.itf8()
             length = p.itf8()
+            if not 0 <= length <= MAX_CODE_BITS:
+                raise StructurallyInvalid(
+                    f"BETA bit length {length} outside [0, {MAX_CODE_BITS}]"
+                )
             core = self.core
             return lambda: core.read_bits(length) - offset
         if enc.codec == GAMMA:
@@ -185,8 +200,27 @@ class Decoders:
 
     def _huffman_reader(self, enc: Encoding):
         p = Cursor(enc.params)
-        values = [p.itf8() for _ in range(p.itf8())]
-        lens = [p.itf8() for _ in range(p.itf8())]
+        n_values = p.itf8()
+        if not 0 <= n_values <= p.remaining():
+            # Alphabet entries are ≥ 1 byte each: a larger count is corrupt.
+            raise StructurallyInvalid(
+                f"Huffman alphabet count {n_values} exceeds the "
+                f"{p.remaining()} parameter bytes present"
+            )
+        values = [p.itf8() for _ in range(n_values)]
+        n_lens = p.itf8()
+        if n_lens != n_values:
+            raise StructurallyInvalid(
+                f"Huffman table mismatch: {n_values} symbols, {n_lens} code "
+                f"lengths"
+            )
+        lens = [p.itf8() for _ in range(n_lens)]
+        if not values:
+            raise StructurallyInvalid("Huffman table with empty alphabet")
+        if any(not 0 <= l <= MAX_CODE_BITS for l in lens):
+            raise StructurallyInvalid(
+                f"Huffman code length outside [0, {MAX_CODE_BITS}]: {lens}"
+            )
         if len(values) == 1 and lens[0] == 0:
             const = values[0]
             return lambda: const  # zero-bit constant
@@ -206,7 +240,7 @@ class Decoders:
                 tab = by_len.get(length)
                 if tab is not None and code in tab:
                     return tab[code]
-            raise ValueError("bad Huffman code in core block")
+            raise StructurallyInvalid("bad Huffman code in core block")
 
         return read_huffman
 

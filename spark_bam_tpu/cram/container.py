@@ -22,6 +22,14 @@ import struct
 import zlib
 from dataclasses import dataclass, field
 
+from spark_bam_tpu.core.guard import (
+    LimitExceeded,
+    MalformedInputError,
+    StructurallyInvalid,
+    TruncatedInput,
+    check_count,
+    current_limits,
+)
 from spark_bam_tpu.cram import rans
 from spark_bam_tpu.cram.nums import Cursor, i32le, itf8, ltf8, u32le
 
@@ -52,7 +60,11 @@ def file_definition(file_id: bytes = b"") -> bytes:
 
 def parse_file_definition(buf: bytes) -> tuple[int, int]:
     if buf[:4] != MAGIC:
-        raise ValueError(f"Not a CRAM: bad magic {buf[:4]!r}")
+        raise StructurallyInvalid(f"Not a CRAM: bad magic {buf[:4]!r}")
+    if len(buf) < 6:
+        raise TruncatedInput(
+            f"CRAM file definition cut short: {len(buf)} of 6 bytes"
+        )
     return buf[4], buf[5]
 
 
@@ -92,32 +104,58 @@ class Block:
         method = cur.u8()
         content_type = cur.u8()
         content_id = cur.itf8()
-        comp_size = cur.itf8()
-        raw_size = cur.itf8()
+        # Both size fields come from untrusted bytes: validate before they
+        # size a read (comp_size) or a decompression buffer (raw_size).
+        lim = current_limits()
+        comp_size = check_count(
+            cur.itf8(), "CRAM block comp_size", pos=start
+        )
+        raw_size = check_count(
+            cur.itf8(), "CRAM block raw_size", lim.alloc_budget, pos=start
+        )
         comp = cur.read(comp_size)
         crc = cur.u32()
         actual = zlib.crc32(bytes(cur.buf[start: cur.pos - 4]))
         if crc != actual:
-            raise ValueError(
-                f"block crc mismatch: stored {crc:#x}, computed {actual:#x}"
+            raise StructurallyInvalid(
+                f"block crc mismatch: stored {crc:#x}, computed {actual:#x}",
+                pos=start,
             )
-        if method == RAW:
-            data = comp
-        elif method == GZIP:
-            data = zlib.decompress(comp, zlib.MAX_WBITS | 32)
-        elif method == RANS4x8:
-            data = rans.decompress(comp)
-        elif method == BZIP2:
-            data = bz2.decompress(comp)
-        elif method == LZMA:
-            data = lzma.decompress(comp)
-        else:
-            raise ValueError(f"unknown block compression method {method}")
+        data = _decompress(method, comp, raw_size, start)
         if len(data) != raw_size:
-            raise ValueError(
-                f"block inflated to {len(data)} bytes, header said {raw_size}"
+            raise StructurallyInvalid(
+                f"block inflated to {len(data)} bytes, header said {raw_size}",
+                pos=start,
             )
         return Block(content_type, content_id, data, method)
+
+
+def _decompress(method: int, comp: bytes, raw_size: int, start: int) -> bytes:
+    """Inflate one block payload, never producing more than ``raw_size + 1``
+    bytes regardless of what the compressed stream claims (a zip-bomb
+    payload fails the post-inflate size check without the allocation)."""
+    try:
+        if method == RAW:
+            return comp
+        if method == GZIP:
+            return zlib.decompressobj(zlib.MAX_WBITS | 32).decompress(
+                comp, raw_size + 1
+            )
+        if method == RANS4x8:
+            return rans.decompress(comp, max_out=raw_size)
+        if method == BZIP2:
+            return bz2.BZ2Decompressor().decompress(comp, raw_size + 1)
+        if method == LZMA:
+            return lzma.LZMADecompressor().decompress(comp, raw_size + 1)
+    except (zlib.error, OSError, lzma.LZMAError, ValueError, IndexError, EOFError) as e:
+        if isinstance(e, MalformedInputError):
+            raise  # already typed (rans guards, cursor truncation)
+        raise StructurallyInvalid(
+            f"block decompress (method {method}) failed: {e}", pos=start
+        ) from e
+    raise StructurallyInvalid(
+        f"unknown block compression method {method}", pos=start
+    )
 
 
 def gzip_maybe(data: bytes) -> int:
@@ -163,12 +201,18 @@ class ContainerHeader:
         record_counter = cur.ltf8()
         bases = cur.ltf8()
         n_blocks = cur.itf8()
-        landmarks = [cur.itf8() for _ in range(cur.itf8())]
+        # Landmarks are ≥ 1 byte each: a count past the remaining bytes is
+        # provably corrupt before the loop runs (2³¹ itf8 reads otherwise).
+        n_landmarks = check_count(
+            cur.itf8(), "CRAM landmark count", cur.remaining(), pos=start
+        )
+        landmarks = [cur.itf8() for _ in range(n_landmarks)]
         crc = cur.u32()
         actual = zlib.crc32(bytes(cur.buf[start: cur.pos - 4]))
         if crc != actual:
-            raise ValueError(
-                f"container crc mismatch: stored {crc:#x}, computed {actual:#x}"
+            raise StructurallyInvalid(
+                f"container crc mismatch: stored {crc:#x}, computed {actual:#x}",
+                pos=start,
             )
         return ContainerHeader(
             length, ref_seq_id, align_start, span, n_records,

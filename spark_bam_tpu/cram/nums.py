@@ -9,9 +9,16 @@ from __future__ import annotations
 
 import struct
 
+from spark_bam_tpu.core.guard import StructurallyInvalid, TruncatedInput
+
 
 class Cursor:
-    """A positioned view over bytes; every CRAM structure parses off one."""
+    """A positioned view over bytes; every CRAM structure parses off one.
+
+    Truncation raises ``TruncatedInput`` (an ``EOFError`` subclass, so
+    legacy ``except EOFError`` handlers keep working); a negative read
+    size — always a corrupt length field — raises ``StructurallyInvalid``.
+    """
 
     __slots__ = ("buf", "pos")
 
@@ -23,31 +30,43 @@ class Cursor:
         try:
             v = self.buf[self.pos]
         except IndexError:
-            raise EOFError(f"truncated stream at byte {self.pos}") from None
+            raise TruncatedInput(f"truncated stream at byte {self.pos}") from None
         self.pos += 1
         return v
 
     def peek_u8(self) -> int:
-        """Next byte without advancing; clean EOFError when truncated."""
+        """Next byte without advancing; clean TruncatedInput when truncated."""
         try:
             return self.buf[self.pos]
         except IndexError:
-            raise EOFError(f"truncated stream at byte {self.pos}") from None
+            raise TruncatedInput(f"truncated stream at byte {self.pos}") from None
 
     def read(self, n: int) -> bytes:
+        if n < 0:
+            raise StructurallyInvalid(
+                f"negative read of {n} bytes", pos=self.pos
+            )
         v = bytes(self.buf[self.pos: self.pos + n])
         if len(v) != n:
-            raise EOFError(f"wanted {n} bytes, got {len(v)}")
+            raise TruncatedInput(
+                f"wanted {n} bytes at {self.pos}, got {len(v)}"
+            )
         self.pos += n
         return v
 
     def i32(self) -> int:
-        v = struct.unpack_from("<i", self.buf, self.pos)[0]
+        try:
+            v = struct.unpack_from("<i", self.buf, self.pos)[0]
+        except struct.error:
+            raise TruncatedInput(f"truncated stream at byte {self.pos}") from None
         self.pos += 4
         return v
 
     def u32(self) -> int:
-        v = struct.unpack_from("<I", self.buf, self.pos)[0]
+        try:
+            v = struct.unpack_from("<I", self.buf, self.pos)[0]
+        except struct.error:
+            raise TruncatedInput(f"truncated stream at byte {self.pos}") from None
         self.pos += 4
         return v
 

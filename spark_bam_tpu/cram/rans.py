@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import struct
 
+from spark_bam_tpu.core.guard import StructurallyInvalid
 from spark_bam_tpu.cram.nums import Cursor
 
 TOTFREQ = 4096
@@ -309,12 +310,19 @@ def compress(data: bytes, order: int = 0) -> bytes:
     )
 
 
-def decompress(blob: bytes) -> bytes:
+def decompress(blob: bytes, max_out: int | None = None) -> bytes:
     cur = Cursor(blob)
     order = cur.u8()
     comp_sz = cur.u32()
     out_sz = cur.u32()
     del comp_sz
+    if max_out is not None and out_sz > max_out:
+        # The caller (cram/container.py) knows the block's declared raw
+        # size; a larger embedded out_sz is corrupt — refuse before the
+        # decode loop sizes itself on it.
+        raise StructurallyInvalid(
+            f"rANS output size {out_sz} exceeds declared block size {max_out}"
+        )
     if out_sz == 0:
         return b""
     if order in (0, 1):
@@ -324,4 +332,4 @@ def decompress(blob: bytes) -> bytes:
         if native is not None:
             return native
         return _decode_o0(cur, out_sz) if order == 0 else _decode_o1(cur, out_sz)
-    raise ValueError(f"unknown rANS order {order}")
+    raise StructurallyInvalid(f"unknown rANS order {order}")

@@ -15,6 +15,7 @@ the split planner for ``load_cram`` — the CRAM analog of the BGZF
 from __future__ import annotations
 
 import mmap
+import struct
 from dataclasses import dataclass
 
 from spark_bam_tpu.bam.header import BamHeader, ContigLengths
@@ -34,6 +35,12 @@ from spark_bam_tpu.cram.container import (
 from spark_bam_tpu.cram.nums import Cursor
 from spark_bam_tpu.cram.structure import CompressionHeader, SliceHeader
 from spark_bam_tpu.cram.writer import CF_DETACHED, CF_NO_SEQ, CF_QS_PRESERVED
+from spark_bam_tpu.core.guard import (
+    MalformedInputError,
+    StructurallyInvalid,
+    check_count,
+    current_limits,
+)
 from spark_bam_tpu.core.pos import Pos
 
 CF_MATE_DOWNSTREAM = 4
@@ -83,7 +90,10 @@ class CramReader:
         blocks_start = cur.pos
         block = Block.parse(cur)
         if block.content_type != FILE_HEADER:
-            raise ValueError("first CRAM container does not hold the SAM header")
+            raise StructurallyInvalid(
+                "first CRAM container does not hold the SAM header",
+                path=path,
+            )
         text_cur = Cursor(block.data)
         text_len = text_cur.i32()
         self.sam_text = text_cur.read(text_len).decode("latin-1")
@@ -122,11 +132,34 @@ class CramReader:
         [offset, end) — defaults to the whole file."""
         cur = Cursor(self.buf, self.first_data_offset if offset is None else offset)
         while cur.remaining() > 0 and (end is None or cur.pos < end):
+            container_start = cur.pos
             header = ContainerHeader.parse(cur)
             if header.is_eof:
                 break
             region_end = cur.pos + header.length
-            yield from self._decode_container(cur, header, region_end)
+            # Decode boundary: whatever a corrupt container throws deep in
+            # the codec machinery (bad tag keys, inconsistent series
+            # lengths, malformed UTF/latin frames) surfaces as one typed
+            # error carrying the container offset.
+            try:
+                out = list(self._decode_container(cur, header, region_end))
+            except MalformedInputError:
+                raise
+            except (
+                ValueError,
+                KeyError,
+                IndexError,
+                NotImplementedError,
+                OverflowError,
+                UnicodeDecodeError,
+                struct.error,
+            ) as e:
+                raise StructurallyInvalid(
+                    f"CRAM container decode failed: {e!r}",
+                    path=self.path,
+                    pos=container_start,
+                ) from e
+            yield from out
             cur.pos = region_end
 
     def __iter__(self):
@@ -135,16 +168,21 @@ class CramReader:
     def _decode_container(self, cur: Cursor, header: ContainerHeader, region_end: int):
         first = Block.parse(cur)
         if first.content_type != COMPRESSION_HEADER:
-            raise ValueError("container does not start with a compression header")
+            raise StructurallyInvalid(
+                "container does not start with a compression header"
+            )
         ch = CompressionHeader.parse(first.data)
         counter = header.record_counter
         while cur.pos < region_end:
             sh_block = Block.parse(cur)
             if sh_block.content_type != MAPPED_SLICE:
-                raise ValueError(
+                raise StructurallyInvalid(
                     f"expected slice header block, got type {sh_block.content_type}"
                 )
             sh = SliceHeader.parse(sh_block.data)
+            # A slice cannot hold more records than its container declares;
+            # the slice count sizes per-record work below, so fence it here.
+            check_count(sh.n_records, "CRAM slice records", header.n_records)
             blocks = [Block.parse(cur) for _ in range(sh.n_blocks)]
             yield from self._decode_slice(ch, sh, blocks, counter)
             counter += sh.n_records
@@ -221,11 +259,13 @@ class CramReader:
         out: list[BamRecord] = []
         links: list[int | None] = []
         last_ap = sh.start
+        max_seq = current_limits().max_seq_len
         for i in range(sh.n_records):
             bf = r_bf()
             cf = r_cf()
             ri = r_ri() if sh.ref_seq_id == -2 else sh.ref_seq_id
-            rl = r_rl()
+            # RL sizes the seq/qual buffers and bulk reads below.
+            rl = check_count(r_rl(), "CRAM read length", max_seq)
             if ch.ap_delta:
                 last_ap += r_ap()
                 ap = last_ap

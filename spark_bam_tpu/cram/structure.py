@@ -9,6 +9,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
+from spark_bam_tpu.core.guard import StructurallyInvalid, check_count
 from spark_bam_tpu.cram.codecs import Encoding
 from spark_bam_tpu.cram.nums import Cursor, itf8, ltf8
 
@@ -77,7 +78,13 @@ class CompressionHeader:
         cur = Cursor(data)
         h = CompressionHeader()
         cur.itf8()  # preservation map byte size
-        for _ in range(cur.itf8()):
+        # Every count below fences a loop over parsed entries; each entry
+        # is ≥ 3 bytes (2-byte key + ≥ 1 value byte), so a count beyond the
+        # remaining bytes is provably corrupt before the loop runs.
+        n_pres = check_count(
+            cur.itf8(), "CRAM preservation-map entries", cur.remaining()
+        )
+        for _ in range(n_pres):
             key = cur.read(2)
             if key == b"RN":
                 h.read_names_included = bool(cur.u8())
@@ -98,18 +105,31 @@ class CompressionHeader:
                         line = []
                         i += 1
                     else:
+                        if i + 3 > len(blob):
+                            raise StructurallyInvalid(
+                                f"CRAM TD dictionary cut mid-entry at "
+                                f"byte {i} of {len(blob)}"
+                            )
                         line.append((bytes(blob[i: i + 2]), blob[i + 2]))
                         i += 3
                 if not h.tag_dict:
                     h.tag_dict = [[]]
             else:
-                raise ValueError(f"unknown preservation key {key!r}")
+                raise StructurallyInvalid(
+                    f"unknown preservation key {key!r}", pos=cur.pos
+                )
         cur.itf8()  # data-series map byte size
-        for _ in range(cur.itf8()):
+        n_series = check_count(
+            cur.itf8(), "CRAM data-series entries", cur.remaining()
+        )
+        for _ in range(n_series):
             key = cur.read(2).decode("latin-1")
             h.data_series[key] = Encoding.parse(cur)
         cur.itf8()  # tag map byte size
-        for _ in range(cur.itf8()):
+        n_tags = check_count(
+            cur.itf8(), "CRAM tag-map entries", cur.remaining()
+        )
+        for _ in range(n_tags):
             key = cur.itf8()
             h.tags[key] = Encoding.parse(cur)
         return h
@@ -152,7 +172,10 @@ class SliceHeader:
         n_records = cur.itf8()
         record_counter = cur.ltf8()
         n_blocks = cur.itf8()
-        content_ids = [cur.itf8() for _ in range(cur.itf8())]
+        n_ids = check_count(
+            cur.itf8(), "CRAM slice content ids", cur.remaining()
+        )
+        content_ids = [cur.itf8() for _ in range(n_ids)]
         embedded_ref_id = cur.itf8()
         ref_md5 = cur.read(16)
         tags = bytes(cur.buf[cur.pos:])

@@ -33,6 +33,7 @@ from spark_bam_tpu.core.faults import (
     BlockGapError,
     with_retries,
 )
+from spark_bam_tpu.core.guard import MalformedInputError, RecordGapError
 from spark_bam_tpu.core.pos import Pos
 from spark_bam_tpu.load.dataset import Dataset
 from spark_bam_tpu.load.intervals import LociSet
@@ -53,6 +54,14 @@ def _resolve_split_start(path, split: FileSplit, header: BamHeader, config: Conf
     # The warm-cache acceptance gate: a cache-served load must never get
     # here (tests assert this counter stays 0 on warm loads).
     obs.count("load.split_resolutions")
+    # The split owning the header needs no inference: the first record
+    # begins exactly at header.end_pos (read_header already validated the
+    # bytes up to there). Running the checker here instead would *search*
+    # for a provable chain — and on a file whose early records are damaged,
+    # silently resolve past them, losing records even in strict mode.
+    first = header.end_pos
+    if split.start <= first.block_pos < split.end:
+        return first
     with obs.span("bgzf.read", kind="find_block_start", split=split.start):
         with open_channel(path) as ch:
             block_start = find_block_start(
@@ -211,18 +220,12 @@ def _native_next_read_start(path, block_start: int, header: BamHeader, config: C
             confirm.close()
 
 
-def _tolerant_record_resync(path, gap: BlockGapError, header: BamHeader,
-                            config: Config):
-    """After a quarantined block gap: the first provable record boundary at
-    or past the resynced block, or None when the damage runs to EOF or no
-    boundary can be proven (the rest of the partition is lost with it).
-    Mirrors split resolution — find-block-start already happened in the
-    stream's resync; this is the find-record-start half."""
+def _tolerant_next_start(path, start: Pos, header: BamHeader, config: Config):
+    """First provable record boundary at or past ``start`` on a tolerant
+    stream, or None when the damage runs to EOF or no boundary can be
+    proven (the rest of the partition is lost with it)."""
     from spark_bam_tpu.check.checker import NoReadFoundException
-    from spark_bam_tpu.bgzf.header import HeaderParseException
 
-    if gap.resync is None:
-        return None
     checker = EagerChecker(
         SeekableUncompressedBytes(
             SeekableBlockStream(open_channel(path), tolerant=True)
@@ -231,18 +234,31 @@ def _tolerant_record_resync(path, gap: BlockGapError, header: BamHeader,
         config.reads_to_check,
     )
     try:
-        return checker.next_read_start(Pos(gap.resync, 0), config.max_read_size)
+        return checker.next_read_start(start, config.max_read_size)
     except BlockGapError as nxt:
-        # The resync region is damaged too; chase the next gap (resync
+        # The scan region is damaged too; chase the next gap (resync
         # offsets strictly increase, so this terminates).
-        if nxt.resync is None or nxt.resync <= gap.resync:
+        if nxt.resync is None or nxt.resync <= start.block_pos:
             return None
-        return _tolerant_record_resync(path, nxt, header, config)
-    except (NoReadFoundException, BlockCorruptionError, HeaderParseException,
+        return _tolerant_next_start(path, Pos(nxt.resync, 0), header, config)
+    except (NoReadFoundException, BlockCorruptionError, MalformedInputError,
             EOFError):
+        # MalformedInputError covers HeaderParseException and the structural
+        # decode guards (core/guard.py).
         return None
     finally:
         checker.close()
+
+
+def _tolerant_record_resync(path, gap: BlockGapError, header: BamHeader,
+                            config: Config):
+    """After a quarantined block gap: the first provable record boundary at
+    or past the resynced block. Mirrors split resolution — find-block-start
+    already happened in the stream's resync; this is the find-record-start
+    half."""
+    if gap.resync is None:
+        return None
+    return _tolerant_next_start(path, Pos(gap.resync, 0), header, config)
 
 
 #: "no cached verdict for this boundary" — distinct from None, which is a
@@ -281,6 +297,20 @@ def _iter_split_records(
                 # record boundary past the gap. Records overlapping the
                 # damage are dropped with it.
                 resume = _tolerant_record_resync(path, gap, header, config)
+                if resume is None or resume.block_pos >= split.end:
+                    break
+                stream.seek(resume)
+                it = iter(stream)
+                continue
+            except RecordGapError as gap:
+                # Tolerant mode only: a record's length prefix is garbage,
+                # so the local skip-one-record recovery can't size the skip;
+                # re-prove a boundary with the checker just past the
+                # damaged prefix (the BlockGapError analog one layer up).
+                resume = _tolerant_next_start(
+                    path, Pos(gap.pos.block_pos, gap.pos.offset + 1),
+                    header, config,
+                )
                 if resume is None or resume.block_pos >= split.end:
                     break
                 stream.seek(resume)

@@ -41,6 +41,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Iterable, Sequence, TypeVar
 
 from spark_bam_tpu import obs
+from spark_bam_tpu.core import guard
 from spark_bam_tpu.core.faults import FaultPolicy, retryable
 
 T = TypeVar("T")
@@ -114,6 +115,11 @@ class JobReport:
     — the observable replacement for Spark's task-level UI."""
 
     partitions: list[PartitionReport]
+    #: Decode-level losses (tolerant mode): records/blocks quarantined by
+    #: the guard layer (core/guard.py) while this job ran — finer-grained
+    #: than partition quarantine, which loses a whole partition at once.
+    lost_records: int = 0
+    lost_blocks: int = 0
 
     @property
     def quarantined(self) -> list[int]:
@@ -144,6 +150,11 @@ class JobReport:
             f"{self.retries} retries, {self.hedges} hedges, "
             f"{len(self.quarantined)} quarantined"
         ]
+        if self.lost_records or self.lost_blocks:
+            lines.append(
+                f"\tmalformed input: {self.lost_records} records and "
+                f"{self.lost_blocks} blocks quarantined by decode guards"
+            )
         for p in self.partitions:
             if p.status == "quarantined":
                 lines.append(f"\tquarantined partition {p.index}: {p.error}")
@@ -210,10 +221,17 @@ def run_partitions(
     reports = [PartitionReport(i) for i in range(len(items))]
     report = JobReport(reports)
     _last_report = report
+    # Snapshot the process-wide decode-loss tally around the run: the delta
+    # is what this job's partitions quarantined (the tally is global, so
+    # thread-pool workers land in it too; process pools under-report).
+    rec0, blk0 = guard.loss_totals()
     if config.mode == "sequential" or len(items) <= 1:
         results = _run_sequential(fn, items, policy, reports)
     else:
         results = _run_pooled(fn, items, config, policy, reports)
+    rec1, blk1 = guard.loss_totals()
+    report.lost_records = rec1 - rec0
+    report.lost_blocks = blk1 - blk0
     return results, report
 
 

@@ -45,6 +45,7 @@ import numpy as np
 
 from spark_bam_tpu.bgzf.block import Metadata
 from spark_bam_tpu.core.channel import is_url, open_channel, path_size
+from spark_bam_tpu.core.guard import StructurallyInvalid
 from spark_bam_tpu.core.pos import Pos
 
 MAGIC = b"SBTI"
@@ -66,8 +67,12 @@ PLAN_POS = 1         # resolved virtual position
 PLAN_UNRESOLVED = 2  # scan budget exhausted at build time; re-resolve live
 
 
-class SbiFormatError(ValueError):
-    """The sidecar's bytes are not a well-formed ``.sbi`` index."""
+class SbiFormatError(StructurallyInvalid):
+    """The sidecar's bytes are not a well-formed ``.sbi`` index.
+
+    A ``StructurallyInvalid`` (still a ValueError): the store treats it as
+    cache corruption, and the fuzz harness classifies it with the rest of
+    the malformed-input taxonomy (core/guard.py)."""
 
 
 def config_digest(config) -> int:
@@ -221,17 +226,31 @@ class _Reader:
     def unpack(self, fmt: str):
         return struct.unpack(fmt, self.take(struct.calcsize(fmt)))
 
+    def count(self, n: int, what: str, item_size: int) -> int:
+        """Validate an element count against the bytes actually present
+        before it sizes a loop (a corrupt count must fail in O(1), not
+        after ``n`` iterations)."""
+        if n * item_size > len(self.data) - self.off:
+            raise SbiFormatError(
+                f"corrupt .sbi: {what} count {n} needs {n * item_size} "
+                f"bytes at {self.off}, have {len(self.data) - self.off}"
+            )
+        return n
+
 
 def _decode_blocks(r: _Reader) -> list[Metadata]:
     (n,) = r.unpack("<Q")
+    r.count(n, "blocks", 16)
     return [Metadata(*r.unpack("<QII")) for _ in range(n)]
 
 
 def _decode_split_plans(r: _Reader) -> dict[int, list[PlanEntry]]:
     (n_plans,) = r.unpack("<I")
+    r.count(n_plans, "split plans", 16)
     plans: dict[int, list[PlanEntry]] = {}
     for _ in range(n_plans):
         split_size, n_entries = r.unpack("<QQ")
+        r.count(n_entries, "plan entries", 17)
         entries = []
         for _ in range(n_entries):
             file_start, kind, vpos = r.unpack("<QBQ")
@@ -249,7 +268,7 @@ def _decode_split_plans(r: _Reader) -> dict[int, list[PlanEntry]]:
 
 def _decode_record_starts(r: _Reader) -> np.ndarray:
     (n,) = r.unpack("<Q")
-    raw = r.take(8 * n)
+    raw = r.take(8 * r.count(n, "record starts", 8))
     return np.frombuffer(raw, dtype=np.uint64).copy()
 
 

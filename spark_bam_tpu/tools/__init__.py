@@ -1,0 +1,1 @@
+"""Operational tooling shipped with the package (fuzzing, diagnostics)."""

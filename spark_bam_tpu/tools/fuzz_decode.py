@@ -1,0 +1,474 @@
+"""Structure-aware mutation fuzzing for the decode boundary.
+
+Generates small pristine corpora with the package's own writers (BAM,
+BGZF, CRAM, .sbi), mutates them at *structural field boundaries* (length
+prefixes, counts, magics, sizes — the fields the guards in
+``core/guard.py`` fence), and asserts the decode contract on every
+mutant:
+
+1. **no hang** — the parse finishes within a wall-clock bound;
+2. **no allocation blow-up** — peak traced allocation stays under the
+   active ``DecodeLimits.alloc_budget``;
+3. **typed failure** — strict mode either parses cleanly or raises a
+   typed error (``MalformedInputError`` and the fault-layer types);
+   tolerant mode additionally may quarantine the damaged record/block
+   and resume.
+
+Anything else — an untyped ``Exception`` escaping a parser, a parse that
+overruns the time or allocation budget — is recorded as a *violation*.
+``run_fuzz`` is deterministic for a given seed (splitmix64, the same mix
+as ``core/faults.py``), so every violation comes with a one-line repro.
+
+Entry points: ``spark-bam-tpu fuzz-decode`` (CLI), ``tools/fuzz_decode.py``
+(repo script), and the ``fuzz``-marked pytest smoke in
+``tests/test_malformed.py``.
+"""
+
+from __future__ import annotations
+
+import struct
+import tempfile
+import time
+import tracemalloc
+import zlib
+from pathlib import Path
+
+import numpy as np
+
+from spark_bam_tpu.bam.header import BamHeader, ContigLengths, parse_header
+from spark_bam_tpu.bam.iterators import RecordStream
+from spark_bam_tpu.bam.record import BamRecord
+from spark_bam_tpu.bam.writer import BGZF_EOF, compress_block, encode_bam_header
+from spark_bam_tpu.bgzf.header import HeaderSearchFailedException
+from spark_bam_tpu.bgzf.stream import BlockStream, MetadataStream, UncompressedBytes
+from spark_bam_tpu.check.checker import NoReadFoundException
+from spark_bam_tpu.core import guard
+from spark_bam_tpu.core.channel import open_channel
+from spark_bam_tpu.core.config import Config
+from spark_bam_tpu.core.faults import (
+    BlockCorruptionError,
+    BlockGapError,
+    ShortReadError,
+)
+from spark_bam_tpu.core.guard import (
+    DecodeLimits,
+    MalformedInputError,
+    scoped_limits,
+)
+from spark_bam_tpu.core.pos import Pos
+from spark_bam_tpu.cram.reader import CramReader
+from spark_bam_tpu.cram.writer import CramWriter
+from spark_bam_tpu.load.api import load_reads_and_positions
+from spark_bam_tpu.sbi.format import (
+    PLAN_POS,
+    PlanEntry,
+    SbiIndex,
+    decode_sbi,
+    encode_sbi,
+    fingerprint_of,
+)
+
+FORMATS = ("bam", "bgzf", "cram", "sbi")
+
+#: Typed outcomes the contract accepts from a strict parse of hostile
+#: bytes. ``EOFError`` is the pinned clean-truncation signal (PR 2);
+#: ``NoReadFoundException`` / ``HeaderSearchFailedException`` are the
+#: checker's explicit "no sound structure here" diagnoses.
+TYPED_ERRORS = (
+    MalformedInputError,
+    BlockCorruptionError,
+    ShortReadError,
+    BlockGapError,
+    EOFError,
+    NoReadFoundException,
+    HeaderSearchFailedException,
+)
+
+#: Per-mutant budgets. The corpora are a few KiB, so a healthy parse takes
+#: milliseconds and allocates a few hundred KiB — these bounds only trip
+#: on quadratic blow-ups a mutation managed to smuggle past the guards.
+TIME_LIMIT_S = 5.0
+FUZZ_LIMITS = DecodeLimits(alloc_budget=64 << 20)
+
+_M64 = (1 << 64) - 1
+
+
+class _Rng:
+    """splitmix64 — the same mixer as ``core/faults.py``, so fuzz runs are
+    reproducible from the seed alone across platforms and sessions."""
+
+    def __init__(self, seed: int):
+        self.s = seed & _M64
+
+    def next(self) -> int:
+        self.s = (self.s + 0x9E3779B97F4A7C15) & _M64
+        z = self.s
+        z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & _M64
+        z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & _M64
+        return (z ^ (z >> 31)) & _M64
+
+    def below(self, n: int) -> int:
+        return self.next() % n
+
+    def choice(self, seq):
+        return seq[self.below(len(seq))]
+
+
+# ------------------------------------------------------------- mutations
+
+#: Adversarial i32 values: sign flips, off-by-one around the minimum
+#: record body (33), and allocation-sized extremes.
+_I32_POISON = (-1, -2, 0, 1, 32, 33, -(1 << 31), (1 << 31) - 1, 1 << 30)
+
+
+def _mutate(data: bytes, off: int, rng: _Rng) -> bytes:
+    """One structural mutation at ``off``; returns the mutated bytes."""
+    buf = bytearray(data)
+    op = rng.below(5)
+    if op == 0 and off + 4 <= len(buf):
+        struct.pack_into("<i", buf, off, rng.choice(_I32_POISON))
+    elif op == 1:
+        buf[off] ^= 1 << rng.below(8)
+    elif op == 2:
+        buf[off] = rng.choice((0, 0x80, 0xFF))
+    elif op == 3:
+        return bytes(buf[: max(off, 1)])  # truncate mid-structure
+    elif off + 2 <= len(buf):
+        struct.pack_into("<H", buf, off, 0xFFFF)
+    else:
+        buf[off] ^= 0xFF
+    return bytes(buf)
+
+
+# --------------------------------------------------------------- corpora
+
+def _base_contigs() -> ContigLengths:
+    return ContigLengths({0: ("chr1", 100_000), 1: ("chr2", 50_000)})
+
+
+def _base_records(n: int = 24) -> list[BamRecord]:
+    recs = []
+    for i in range(n):
+        recs.append(
+            BamRecord(
+                i % 2, 100 + 50 * i, 30, 0, 16 if i % 3 == 0 else 0,
+                -1, -1, 0, f"read{i:03d}", [(32, 0)],
+                "ACGT" * 8, b"I" * 32, b"",
+            )
+        )
+    return recs
+
+
+def _bam_uncompressed() -> tuple[bytes, list[int]]:
+    """Uncompressed BAM stream + every structural field offset in it."""
+    header = BamHeader(
+        _base_contigs(), Pos(0, 0), 0,
+        "@HD\tVN:1.6\n@SQ\tSN:chr1\tLN:100000\n@SQ\tSN:chr2\tLN:50000\n",
+    )
+    blob = bytearray(encode_bam_header(header))
+    offsets = [0, 4]  # magic, l_text
+    (text_len,) = struct.unpack_from("<i", blob, 4)
+    o = 8 + text_len
+    offsets.append(o)  # n_ref
+    (n_ref,) = struct.unpack_from("<i", blob, o)
+    o += 4
+    for _ in range(n_ref):
+        offsets.append(o)  # l_name
+        (l_name,) = struct.unpack_from("<i", blob, o)
+        o += 4 + l_name
+        offsets.append(o)  # l_ref
+        o += 4
+    # Fixed-field offsets inside each record (block_size .. tlen).
+    fixed = (0, 4, 8, 12, 13, 14, 16, 18, 20, 24, 28, 32)
+    for rec in _base_records():
+        start = len(blob)
+        offsets.extend(start + d for d in fixed)
+        blob += rec.encode()
+    return bytes(blob), offsets
+
+
+def _bgzf_compress(payload: bytes, chunk: int = 4096) -> tuple[bytes, list[int]]:
+    """BGZF-compress ``payload`` into multiple blocks; returns the
+    compressed bytes and each block's start offset (EOF block included)."""
+    out = bytearray()
+    starts = []
+    for i in range(0, len(payload), chunk):
+        starts.append(len(out))
+        out += compress_block(payload[i : i + chunk])
+    starts.append(len(out))
+    out += BGZF_EOF
+    return bytes(out), starts
+
+
+def _cram_corpus(tmp: Path) -> tuple[bytes, list[int]]:
+    path = tmp / "base.cram"
+    contigs = ContigLengths({0: ("chr1", 100_000)})
+    with CramWriter(
+        path, contigs, sam_text="@SQ\tSN:chr1\tLN:100000\n",
+        records_per_container=8, index=False,
+    ) as w:
+        for i in range(16):
+            w.write(
+                BamRecord(
+                    0, 100 + 10 * i, 30, 0, 0, -1, -1, 0, f"q{i}",
+                    [(20, 0)], "ACGTACGTACGTACGTACGT", b"I" * 20, b"",
+                )
+            )
+    data = path.read_bytes()
+    # Structural hot spots: file definition, SAM-header container, and
+    # the first ~32 bytes of every data container (header itf8 fields,
+    # first block headers).
+    offsets = list(range(0, min(64, len(data))))
+    with CramReader(path) as r:
+        for info in r.container_infos():
+            offsets.extend(
+                off for off in range(info.offset, min(info.offset + 32, len(data)))
+            )
+    return data, sorted(set(offsets))
+
+
+def _sbi_corpus(bam_path: Path) -> tuple[bytes, list[int]]:
+    cfg = Config()
+    fp = fingerprint_of(bam_path, cfg)
+    ms = MetadataStream(open_channel(bam_path))
+    blocks = list(ms)
+    u = UncompressedBytes(BlockStream(open_channel(bam_path)))
+    hdr = parse_header(u)
+    starts = np.array(
+        [pos.to_htsjdk() for pos, _ in RecordStream(u, hdr)], dtype=np.uint64
+    )
+    index = SbiIndex(
+        fp,
+        blocks=blocks,
+        split_plans={65536: [PlanEntry(0, PLAN_POS, Pos(0, 0))]},
+        record_starts=starts,
+    )
+    data = encode_sbi(index)
+    # Fixed header fields, then the section table (tag, payload length,
+    # and each payload's leading count — the fields _Reader.count fences).
+    hdr_end = 4 + 2 + 2 + 24
+    offsets = [0, 4, 6, 8, 16, 24, 28, hdr_end]
+    (n_sections,) = struct.unpack_from("<I", data, hdr_end)
+    o = hdr_end + 4
+    for _ in range(n_sections):
+        offsets.extend((o, o + 4, o + 12))
+        (payload_len,) = struct.unpack_from("<Q", data, o + 4)
+        o += 12 + payload_len
+    return data, offsets
+
+
+# -------------------------------------------------------------- consumers
+
+def _consume_bam(path, tolerant: bool) -> int:
+    spec = "retries=0" + (",mode=tolerant" if tolerant else "")
+    ds = load_reads_and_positions(str(path), config=Config(faults=spec))
+    n = 0
+    for split in ds.partitions:
+        for _ in ds.compute(split):
+            n += 1
+    return n
+
+
+def _consume_bgzf(path, tolerant: bool) -> int:
+    stream = BlockStream(open_channel(str(path)), tolerant=tolerant)
+    n = 0
+    try:
+        it = iter(stream)
+        while True:
+            try:
+                next(it)
+                n += 1
+            except StopIteration:
+                return n
+            except BlockGapError as gap:
+                if not tolerant:
+                    raise
+                if gap.resync is None:
+                    return n
+                # Channel is already positioned at the resync point.
+    finally:
+        stream.close()
+
+
+def _consume_cram(path, tolerant: bool) -> int:
+    with CramReader(str(path)) as r:
+        return sum(1 for _ in r.records())
+
+
+def _consume_sbi(path, tolerant: bool) -> int:
+    index = decode_sbi(Path(path).read_bytes())
+    n = len(index.blocks or [])
+    if index.record_starts is not None:
+        n += int(index.record_starts.size)
+    return n
+
+
+# ----------------------------------------------------------------- engine
+
+def _repro(seed: int, fmt: str, mutants: int) -> str:
+    return (
+        f"python tools/fuzz_decode.py --seed {seed} "
+        f"--mutants {mutants} --formats {fmt}"
+    )
+
+
+def _run_case(consume, path, tolerant: bool) -> dict:
+    """Execute one consumer under the fuzz budgets; classify the outcome."""
+    rec0, blk0 = guard.loss_totals()
+    tracemalloc.start()
+    t0 = time.monotonic()
+    outcome, detail = "clean", ""
+    try:
+        with scoped_limits(FUZZ_LIMITS):
+            consume(path, tolerant)
+    except TYPED_ERRORS as e:
+        outcome, detail = f"malformed:{type(e).__name__}", str(e)[:200]
+    except Exception as e:  # the contract breach we are hunting
+        outcome, detail = "untyped", f"{type(e).__name__}: {e}"[:300]
+    elapsed = time.monotonic() - t0
+    _, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    rec1, blk1 = guard.loss_totals()
+    if outcome == "clean" and (rec1 > rec0 or blk1 > blk0):
+        outcome = "quarantined"
+        detail = f"lost {rec1 - rec0} records, {blk1 - blk0} blocks"
+    return {
+        "outcome": outcome,
+        "detail": detail,
+        "elapsed_s": round(elapsed, 3),
+        "peak_bytes": peak,
+    }
+
+
+def _mutants_for(fmt: str, tmp: Path, rng: _Rng, count: int):
+    """Yield ``count`` mutated byte strings for one format."""
+    if fmt == "bam":
+        payload, offsets = _bam_uncompressed()
+        for _ in range(count):
+            off = rng.choice(offsets) if rng.below(4) else rng.below(len(payload))
+            mutated = _mutate(payload, off, rng)
+            yield _bgzf_compress(mutated)[0]
+    elif fmt == "bgzf":
+        payload, _ = _bam_uncompressed()
+        comp, starts = _bgzf_compress(payload)
+        offsets = []
+        for i, s in enumerate(starts):
+            # Block header fields (magic, FLG, XLEN, BC subfield, BSIZE)
+            # and the previous block's CRC32/ISIZE trailer.
+            offsets.extend(s + d for d in (0, 1, 3, 10, 12, 16, 17) if s + d < len(comp))
+            if i > 0:
+                offsets.extend((s - 8, s - 4))
+        for _ in range(count):
+            off = rng.choice(offsets) if rng.below(4) else rng.below(len(comp))
+            yield _mutate(comp, off, rng)
+    elif fmt == "cram":
+        data, offsets = _cram_corpus(tmp)
+        for _ in range(count):
+            off = rng.choice(offsets) if rng.below(4) else rng.below(len(data))
+            yield _mutate(data, off, rng)
+    elif fmt == "sbi":
+        data, offsets = _sbi_corpus(tmp / "base.bam")
+        body = data[:-4]
+        for _ in range(count):
+            off = rng.choice(offsets) if rng.below(4) else rng.below(len(body))
+            mutated = _mutate(body, off, rng)
+            if rng.below(4) == 0:
+                # Leave the trailer stale: exercises the CRC gate itself.
+                yield mutated + data[-4:]
+            else:
+                # Re-fix the trailer so the mutation reaches the inner
+                # count guards instead of being masked by the CRC check.
+                yield mutated + struct.pack("<I", zlib.crc32(mutated) & 0xFFFFFFFF)
+    else:
+        raise ValueError(f"unknown fuzz format {fmt!r}")
+
+
+_CONSUMERS = {
+    "bam": (_consume_bam, True),   # (consumer, has tolerant mode)
+    "bgzf": (_consume_bgzf, True),
+    "cram": (_consume_cram, False),
+    "sbi": (_consume_sbi, False),
+}
+
+
+def run_fuzz(
+    seed: int = 0,
+    mutants_per_format: int = 200,
+    formats: tuple[str, ...] = FORMATS,
+) -> dict:
+    """Run the mutation fuzz campaign; returns a JSON-able summary whose
+    ``"violations"`` list is empty iff every mutant honored the contract."""
+    summary: dict = {
+        "seed": seed,
+        "mutants_per_format": mutants_per_format,
+        "formats": list(formats),
+        "counts": {},
+        "violations": [],
+    }
+    with tempfile.TemporaryDirectory(prefix="sbt-fuzz-") as d:
+        tmp = Path(d)
+        # The sbi corpus fingerprints a real BAM; give every format one.
+        base_bam, _ = _bam_uncompressed()
+        (tmp / "base.bam").write_bytes(_bgzf_compress(base_bam)[0])
+        for fmt in formats:
+            consume, has_tolerant = _CONSUMERS[fmt]
+            counts: dict[str, int] = {}
+            rng = _Rng((seed << 16) ^ zlib.crc32(fmt.encode()))
+            for idx, mutant in enumerate(_mutants_for(fmt, tmp, rng, mutants_per_format)):
+                path = tmp / f"mutant.{fmt}"
+                path.write_bytes(mutant)
+                modes = (False, True) if has_tolerant else (False,)
+                for tolerant in modes:
+                    res = _run_case(consume, path, tolerant)
+                    if not tolerant:
+                        key = res["outcome"]
+                        counts[key] = counts.get(key, 0) + 1
+                    problems = []
+                    if res["outcome"] == "untyped":
+                        problems.append(f"untyped error: {res['detail']}")
+                    if res["elapsed_s"] > TIME_LIMIT_S:
+                        problems.append(f"wall clock {res['elapsed_s']}s > {TIME_LIMIT_S}s")
+                    if res["peak_bytes"] > FUZZ_LIMITS.alloc_budget:
+                        problems.append(
+                            f"peak alloc {res['peak_bytes']} > {FUZZ_LIMITS.alloc_budget}"
+                        )
+                    for problem in problems:
+                        summary["violations"].append(
+                            {
+                                "format": fmt,
+                                "mutant": idx,
+                                "mode": "tolerant" if tolerant else "strict",
+                                "problem": problem,
+                                "repro": _repro(seed, fmt, mutants_per_format),
+                            }
+                        )
+            summary["counts"][fmt] = counts
+    return summary
+
+
+def main(argv=None) -> int:
+    import argparse
+    import json
+
+    ap = argparse.ArgumentParser(
+        description="Structure-aware mutation fuzzing of the decode boundary"
+    )
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--mutants", type=int, default=200)
+    ap.add_argument("--formats", default=",".join(FORMATS))
+    ap.add_argument("-o", "--out", default=None)
+    args = ap.parse_args(argv)
+    summary = run_fuzz(
+        seed=args.seed,
+        mutants_per_format=args.mutants,
+        formats=tuple(f.strip() for f in args.formats.split(",") if f.strip()),
+    )
+    text = json.dumps(summary, indent=2, sort_keys=True)
+    if args.out:
+        Path(args.out).write_text(text + "\n")
+    print(text)
+    return 1 if summary["violations"] else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

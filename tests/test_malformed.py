@@ -1,0 +1,322 @@
+"""Malformed-input guards (core/guard.py): the error taxonomy, decode
+limits, each parser's typed failures, and the seeded mutation-fuzz smoke.
+
+Complements tests/test_robustness.py (fault injection on sound bytes):
+here the *bytes themselves* are hostile.
+"""
+
+import struct
+import zlib
+
+import pytest
+
+from spark_bam_tpu.bam.bai import BaiIndex
+from spark_bam_tpu.bam.header import BamHeader, ContigLengths, parse_header
+from spark_bam_tpu.bam.record import BamRecord
+from spark_bam_tpu.bam.writer import BGZF_EOF, compress_block, encode_bam_header
+from spark_bam_tpu.bgzf.block import Metadata
+from spark_bam_tpu.bgzf.header import HeaderParseException
+from spark_bam_tpu.bgzf.stream import BlockStream, UncompressedBytes
+from spark_bam_tpu.core.channel import open_channel
+from spark_bam_tpu.core.config import Config
+from spark_bam_tpu.core.faults import Unrecoverable
+from spark_bam_tpu.core.guard import (
+    DecodeLimits,
+    LimitExceeded,
+    MalformedInputError,
+    StructurallyInvalid,
+    TruncatedInput,
+    check_available,
+    check_count,
+    current_limits,
+    scoped_limits,
+)
+from spark_bam_tpu.core.pos import Pos
+from spark_bam_tpu.cram import rans
+from spark_bam_tpu.cram.container import parse_file_definition
+from spark_bam_tpu.cram.nums import Cursor
+from spark_bam_tpu.load.api import load_reads_and_positions
+from spark_bam_tpu.sbi.format import Fingerprint, SbiFormatError, SbiIndex, decode_sbi, encode_sbi
+
+
+# ---------------------------------------------------------------- taxonomy
+
+def test_error_taxonomy():
+    # Typed errors slot into both the historical except-clauses and the
+    # fault layer's retry classification.
+    assert issubclass(MalformedInputError, ValueError)
+    assert issubclass(MalformedInputError, Unrecoverable)
+    assert issubclass(TruncatedInput, EOFError)          # pinned PR 2 contract
+    assert issubclass(TruncatedInput, MalformedInputError)
+    assert issubclass(StructurallyInvalid, MalformedInputError)
+    assert issubclass(LimitExceeded, MalformedInputError)
+    assert issubclass(HeaderParseException, StructurallyInvalid)
+    assert issubclass(SbiFormatError, StructurallyInvalid)
+
+
+def test_error_context_rendering():
+    e = StructurallyInvalid("boom", path="/x.bam", pos=Pos(7, 3))
+    assert "/x.bam" in str(e) and "boom" in str(e)
+
+
+# ------------------------------------------------------------------ limits
+
+def test_limits_parse_spec():
+    lim = DecodeLimits.parse("record=32MB,refs=1000,name=128")
+    assert lim.max_record_bytes == 32 << 20
+    assert lim.max_refs == 1000
+    assert lim.max_name_len == 128
+    # Unspecified keys keep their defaults.
+    assert lim.max_seq_len == DecodeLimits().max_seq_len
+
+
+def test_limits_parse_rejects_unknown_key():
+    with pytest.raises(ValueError):
+        DecodeLimits.parse("bogus=1")
+
+
+def test_limits_from_env():
+    lim = DecodeLimits.from_env({"SPARK_BAM_LIMITS": "refs=7"})
+    assert lim.max_refs == 7
+
+
+def test_scoped_limits_restores():
+    before = current_limits()
+    with scoped_limits("refs=3"):
+        assert current_limits().max_refs == 3
+    assert current_limits() == before
+
+
+def test_config_limits_knob():
+    assert Config(limits="record=1MB").decode_limits.max_record_bytes == 1 << 20
+
+
+def test_check_count_and_available():
+    assert check_count(5, "things", 10) == 5
+    with pytest.raises(StructurallyInvalid):
+        check_count(-1, "things")
+    with pytest.raises(LimitExceeded):
+        check_count(11, "things", 10)
+    with pytest.raises(TruncatedInput):
+        check_available(4, 8, "bytes")
+
+
+# ------------------------------------------------------------- BAM records
+
+def _record_bytes() -> bytearray:
+    rec = BamRecord(
+        0, 100, 30, 0, 0, -1, -1, 0, "read0", [(8, 0)],
+        "ACGTACGT", b"I" * 8, b"",
+    )
+    return bytearray(rec.encode())
+
+
+def test_record_truncated_buffer():
+    with pytest.raises(TruncatedInput):
+        BamRecord.decode(bytes(_record_bytes()[:20]))
+
+
+def test_record_block_size_too_small():
+    buf = _record_bytes()
+    struct.pack_into("<i", buf, 0, 10)  # < 33-byte minimum body
+    with pytest.raises(StructurallyInvalid):
+        BamRecord.decode(bytes(buf))
+
+
+def test_record_block_size_over_limit():
+    buf = _record_bytes()
+    with scoped_limits("record=64"):
+        struct.pack_into("<i", buf, 0, 65)
+        with pytest.raises(LimitExceeded):
+            BamRecord.decode(bytes(buf))
+
+
+def test_record_zero_read_name_length():
+    buf = _record_bytes()
+    buf[12] = 0  # l_read_name: must include the NUL
+    with pytest.raises(StructurallyInvalid):
+        BamRecord.decode(bytes(buf))
+
+
+def test_record_subfields_overrun_block():
+    buf = _record_bytes()
+    struct.pack_into("<i", buf, 20, 10_000)  # l_seq far beyond block_size
+    with pytest.raises(StructurallyInvalid):
+        BamRecord.decode(bytes(buf))
+
+
+# -------------------------------------------------------------- BAM header
+
+def _header_payload(text="@HD\tVN:1.6\n") -> bytearray:
+    contigs = ContigLengths({0: ("chr1", 1000)})
+    return bytearray(encode_bam_header(BamHeader(contigs, Pos(0, 0), 0, text)))
+
+
+def _parse_payload(tmp_path, payload):
+    p = tmp_path / "h.bam"
+    p.write_bytes(compress_block(bytes(payload)) + BGZF_EOF)
+    return parse_header(UncompressedBytes(BlockStream(open_channel(str(p)))))
+
+
+def test_bam_header_roundtrip(tmp_path):
+    h = _parse_payload(tmp_path, _header_payload())
+    assert h.contig_lengths[0] == ("chr1", 1000)
+
+
+def test_bam_header_bad_magic(tmp_path):
+    payload = _header_payload()
+    payload[:4] = b"XAM\x01"
+    with pytest.raises(StructurallyInvalid):
+        _parse_payload(tmp_path, payload)
+
+
+def test_bam_header_negative_ref_count(tmp_path):
+    payload = _header_payload()
+    (text_len,) = struct.unpack_from("<i", payload, 4)
+    struct.pack_into("<i", payload, 8 + text_len, -5)
+    with pytest.raises(StructurallyInvalid):
+        _parse_payload(tmp_path, payload)
+
+
+def test_bam_header_text_over_limit(tmp_path):
+    payload = _header_payload(text="@CO\t" + "x" * 100 + "\n")
+    with scoped_limits("text=16"):
+        with pytest.raises(LimitExceeded):
+            _parse_payload(tmp_path, payload)
+
+
+def test_bam_header_truncated(tmp_path):
+    with pytest.raises(TruncatedInput):
+        _parse_payload(tmp_path, _header_payload()[:10])
+
+
+# --------------------------------------------------------------------- BAI
+
+def test_bai_bad_magic(tmp_path):
+    p = tmp_path / "x.bai"
+    p.write_bytes(b"XAI\x01" + struct.pack("<i", 0))
+    with pytest.raises(StructurallyInvalid):
+        BaiIndex.read(str(p))
+
+
+def test_bai_negative_count(tmp_path):
+    p = tmp_path / "x.bai"
+    p.write_bytes(b"BAI\x01" + struct.pack("<i", -1))
+    with pytest.raises(StructurallyInvalid):
+        BaiIndex.read(str(p))
+
+
+def test_bai_count_overruns_file(tmp_path):
+    p = tmp_path / "x.bai"
+    p.write_bytes(b"BAI\x01" + struct.pack("<i", 1_000_000))
+    with pytest.raises(TruncatedInput):
+        BaiIndex.read(str(p))
+
+
+# --------------------------------------------------------------------- SBI
+
+def _sbi_blob() -> bytes:
+    index = SbiIndex(
+        Fingerprint(123, 456, 789, 1),
+        blocks=[Metadata(0, 10, 20), Metadata(10, 10, 20)],
+    )
+    return encode_sbi(index)
+
+
+def test_sbi_trailer_crc_gate():
+    blob = bytearray(_sbi_blob())
+    blob[10] ^= 0xFF  # damage the body, leave the trailer stale
+    with pytest.raises(SbiFormatError):
+        decode_sbi(bytes(blob))
+
+
+def test_sbi_inner_count_guard():
+    blob = bytearray(_sbi_blob())
+    # Section table starts after the 32-byte fixed header + u32 count;
+    # the blocks payload leads with its u64 element count.
+    hdr_end = 4 + 2 + 2 + 24
+    payload_off = hdr_end + 4 + 4 + 8
+    struct.pack_into("<Q", blob, payload_off, 1 << 40)
+    body = bytes(blob[:-4])
+    fixed = body + struct.pack("<I", zlib.crc32(body) & 0xFFFFFFFF)
+    with pytest.raises(SbiFormatError):
+        decode_sbi(fixed)
+
+
+# -------------------------------------------------------------------- CRAM
+
+def test_cram_file_definition_guards():
+    with pytest.raises(StructurallyInvalid):
+        parse_file_definition(b"XRAM\x03\x00")
+    with pytest.raises(TruncatedInput):
+        parse_file_definition(b"CRAM\x03")
+
+
+def test_cram_cursor_truncation():
+    with pytest.raises(TruncatedInput):
+        Cursor(b"").u8()
+    with pytest.raises(TruncatedInput):
+        Cursor(b"\x01").read(5)
+    with pytest.raises(StructurallyInvalid):
+        Cursor(b"\x01\x02").read(-3)
+
+
+def test_rans_output_size_guard():
+    blob = rans.compress(b"hello world, hello fuzz")
+    assert rans.decompress(blob) == b"hello world, hello fuzz"
+    with pytest.raises(StructurallyInvalid):
+        rans.decompress(blob, max_out=2)
+
+
+# ---------------------------------------------------------------- BGZF
+
+def test_bgzf_bad_xlen_is_typed(tmp_path):
+    block = bytearray(compress_block(b"payload"))
+    struct.pack_into("<H", block, 10, 2)  # XLEN < 6: no room for BC subfield
+    p = tmp_path / "bad.bgzf"
+    p.write_bytes(bytes(block) + BGZF_EOF)
+    with pytest.raises(MalformedInputError):
+        for _ in BlockStream(open_channel(str(p))):
+            pass
+
+
+# ----------------------------------------------- strict end-to-end decode
+
+def test_strict_load_raises_on_damaged_record(tmp_path):
+    contigs = ContigLengths({0: ("chr1", 100_000)})
+    header = BamHeader(contigs, Pos(0, 0), 0, "@SQ\tSN:chr1\tLN:100000\n")
+    payload = bytearray(encode_bam_header(header))
+    rec_offsets = []
+    for i in range(8):
+        rec_offsets.append(len(payload))
+        payload += BamRecord(
+            0, 100 + 10 * i, 30, 0, 0, -1, -1, 0, f"r{i}", [(8, 0)],
+            "ACGTACGT", b"I" * 8, b"",
+        ).encode()
+    payload[rec_offsets[4] + 12] = 0  # l_read_name = 0 keeps the framing
+    p = tmp_path / "damaged.bam"
+    p.write_bytes(compress_block(bytes(payload)) + BGZF_EOF)
+    ds = load_reads_and_positions(str(p), config=Config(faults="retries=0"))
+    with pytest.raises(MalformedInputError):
+        for split in ds.partitions:
+            for _ in ds.compute(split):
+                pass
+
+
+# -------------------------------------------------------------- fuzz smoke
+
+@pytest.mark.fuzz
+def test_fuzz_smoke_all_formats():
+    """Bounded seeded campaign: 50 mutants x 4 formats = 200 mutants."""
+    from spark_bam_tpu.tools.fuzz_decode import run_fuzz
+
+    seed = 0
+    summary = run_fuzz(seed=seed, mutants_per_format=50)
+    assert not summary["violations"], (
+        f"{len(summary['violations'])} decode-contract violations; "
+        f"first: {summary['violations'][0]}; reproduce with: "
+        f"python tools/fuzz_decode.py --seed {seed} --mutants 50"
+    )
+    # The campaign must actually classify every mutant, not skip them.
+    for fmt in ("bam", "bgzf", "cram", "sbi"):
+        assert sum(summary["counts"][fmt].values()) == 50

@@ -238,3 +238,55 @@ def test_corrupted_block_tolerant_mode_resyncs(damaged_bam):
         )
         assert names == sorted(names, key=lambda n: int(n[1:]))
         assert not ds.last_report.quarantined
+
+
+def test_tolerant_mode_counts_damaged_records(tmp_path):
+    """K records damaged in place (framing intact) → a tolerant load drops
+    exactly those K records, and every ledger agrees: the surviving names,
+    ``JobReport.lost_records``, and the ``guard`` loss tally."""
+    from spark_bam_tpu.bam.header import BamHeader, ContigLengths
+    from spark_bam_tpu.bam.record import BamRecord
+    from spark_bam_tpu.bam.writer import BGZF_EOF, compress_block, encode_bam_header
+    from spark_bam_tpu.core import guard
+    from spark_bam_tpu.core.config import Config
+    from spark_bam_tpu.core.pos import Pos
+    from spark_bam_tpu.parallel.executor import ParallelConfig
+
+    total, damaged = 60, (10, 25, 40)
+    header = BamHeader(
+        ContigLengths({0: ("chr1", 1_000_000)}), Pos(0, 0), 0,
+        "@SQ\tSN:chr1\tLN:1000000\n",
+    )
+    payload = bytearray(encode_bam_header(header))
+    offsets = []
+    for i in range(total):
+        offsets.append(len(payload))
+        payload += BamRecord(
+            0, 100 + 50 * i, 60, 0, 0, -1, -1, 0, f"r{i}", [(40, 0)],
+            "ACGT" * 10, b"I" * 40, b"",
+        ).encode()
+    for i in damaged:
+        # l_read_name = 0 breaks the record but not the framing, so the
+        # tolerant stream can skip exactly one record per damage site.
+        payload[offsets[i] + 12] = 0
+    blob = bytearray()
+    for o in range(0, len(payload), 1024):
+        blob += compress_block(bytes(payload[o:o + 1024]))
+    blob += BGZF_EOF
+    path = tmp_path / "damaged_records.bam"
+    path.write_bytes(bytes(blob))
+
+    expected = [f"r{i}" for i in range(total) if i not in damaged]
+    for mode in ("sequential", "threads"):
+        rec0, blk0 = guard.loss_totals()
+        ds = load_bam(
+            str(path), config=Config(faults="mode=tolerant"),
+            parallel=ParallelConfig(mode, 4),
+        )
+        names = [r.read_name for r in ds.collect()]
+        assert names == expected
+        assert ds.last_report.lost_records == len(damaged)
+        assert ds.last_report.lost_blocks == 0
+        rec1, blk1 = guard.loss_totals()
+        assert (rec1 - rec0, blk1 - blk0) == (len(damaged), 0)
+        assert "quarantined by decode guards" in ds.last_report.summary()

@@ -1,0 +1,21 @@
+#!/usr/bin/env python
+"""Repo-level runner for the decode-boundary mutation fuzzer.
+
+Thin wrapper over ``spark_bam_tpu.tools.fuzz_decode`` so the harness can
+be launched without installing the package::
+
+    python tools/fuzz_decode.py --seed 42 --mutants 500 --formats bam,cram
+
+Exits nonzero iff any mutant violated the decode contract (hang,
+allocation blow-up, or untyped error). See docs/robustness.md.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from spark_bam_tpu.tools.fuzz_decode import main  # noqa: E402
+
+if __name__ == "__main__":
+    raise SystemExit(main())
