@@ -1145,6 +1145,16 @@ def _e2e_forensics(stages: list[str], completed: set | None = None) -> str:
         tail = stages[-1] if stages else "none"
         return prefix + f"no e2e window completed (last stage: {tail})"
     _, leg, k, done, total, wall = last.split(":")
+    if done == total:
+        # The final window marker reports positions_done == total: the leg
+        # finished its scan and died later (teardown / RESULT flush), it
+        # did not stall — blaming "stalled after window N" here is the
+        # false-positive this forensics line exists to avoid.
+        return (
+            prefix
+            + f"{leg} completed all {total} positions in {wall} "
+            + "but died before emitting a RESULT"
+        )
     return (
         prefix
         + f"{leg} stalled after window {k}, {done}/{total} positions in {wall}"
@@ -1370,6 +1380,85 @@ def cache_leg(path: str, split_size: int = 2 << 20):
         else:
             os.environ["SPARK_BAM_CACHE_DIR"] = old
         shutil.rmtree(tmp, ignore_errors=True)
+
+
+def funnel_leg(path: str, window: int = 8 << 20, reads_to_check: int = 10):
+    """Two-stage candidate funnel A/B (host backend): the same
+    ``count_window`` kernel with the funnel on vs off over one identical
+    device-resident window cut from the quick file. Equal-count gated;
+    also reports the stage-0 prefilter's standalone throughput and the
+    measured survivor reduction (docs/design.md, "Candidate funnel")."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_bam_tpu.bam.header import contig_lengths
+    from spark_bam_tpu.bgzf.flat import flatten_file
+    from spark_bam_tpu.tpu.checker import (
+        PAD, _prefilter_flags, make_count_window,
+    )
+
+    flat = flatten_file(path)
+    lens_arr = np.array(contig_lengths(path).lengths_list(), dtype=np.int32)
+    lens = np.zeros(1024, dtype=np.int32)
+    lens[: len(lens_arr)] = lens_arr
+    reps = max(1, window // flat.size + 1)
+    buf = np.concatenate([np.asarray(flat.data)] * reps)[:window]
+    padded = np.zeros(window + PAD, dtype=np.uint8)
+    padded[:window] = buf
+    pd = jnp.asarray(padded)
+    ld = jnp.asarray(lens)
+    nc = jnp.int32(len(lens_arr))
+    nn = jnp.int32(window)
+    ae = jnp.bool_(False)
+    lo, hi = jnp.int32(0), jnp.int32(window)
+
+    on = make_count_window(window, reads_to_check, funnel=True)
+    off = make_count_window(window, reads_to_check, funnel=False)
+    pre = jax.jit(
+        lambda p, l, c, n: jnp.sum(
+            (_prefilter_flags(p, l, c, n) == 0).astype(jnp.int32)
+        )
+    )
+
+    def best_of(fn, *args, iters=5):
+        out = fn(*args)  # warm-up / compile
+        jax.tree_util.tree_map(
+            lambda x: x.block_until_ready() if hasattr(
+                x, "block_until_ready") else x, out)
+        best = float("inf")
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            out = fn(*args)
+            jax.tree_util.tree_map(
+                lambda x: x.block_until_ready() if hasattr(
+                    x, "block_until_ready") else x, out)
+            best = min(best, time.perf_counter() - t0)
+        return best, out
+
+    t_on, out_on = best_of(on, pd, ld, nc, nn, ae, lo, hi)
+    t_off, out_off = best_of(off, pd, ld, nc, nn, ae, lo, hi)
+    t_pre, _ = best_of(pre, pd, ld, nc, nn)
+    if int(out_on["count"]) != int(out_off["count"]):
+        raise AssertionError(
+            "funnel changed the verdict count: "
+            f"{int(out_on['count'])} vs {int(out_off['count'])}"
+        )
+    survivors = int(out_on["survivors"])
+    return {
+        "funnel_on_pps": round(window / t_on),
+        "funnel_off_pps": round(window / t_off),
+        "funnel_speedup": round(t_off / max(t_on, 1e-9), 2),
+        "funnel_reduction": round(window / max(survivors, 1), 1),
+        "prefilter_pps": round(window / t_pre),
+        "funnel_stages": {
+            "on_ms": round(t_on * 1e3, 1),
+            "off_ms": round(t_off * 1e3, 1),
+            "prefilter_ms": round(t_pre * 1e3, 1),
+            "survivors": survivors,
+            "window_mb": window >> 20,
+            "reads": int(out_on["count"]),
+        },
+    }
 
 
 def cpu_e2e_rate(path: Path, cap_bytes: int = CPU_E2E_CAP_BYTES):
@@ -1777,6 +1866,12 @@ def _main_measure(record, warnings, errors):
             record.update(cache_leg(quick_path))
         except Exception as e:
             warnings.append(f"cache leg: {type(e).__name__}: {e}")
+    # Candidate-funnel on-vs-off kernel A/B (host-side; equal-count gated).
+    if quick_path:
+        try:
+            record.update(funnel_leg(quick_path))
+        except Exception as e:
+            warnings.append(f"funnel leg: {type(e).__name__}: {e}")
 
     pallas = results.get("pallas")
     if pallas is not None:

@@ -71,6 +71,36 @@ def print_report_header(p, total: int, compressed: int, num_reads: int):
     )
 
 
+def funnel_status_line(
+    config: Config,
+    stats: dict | None = None,
+    device: bool = True,
+    full_masks: bool = False,
+) -> str:
+    """One ``funnel: …`` line for the check commands (sibling of
+    ``sbi.store.cache_status_line``): the configured mode, whether the
+    two-stage prefilter actually ran on this path, and — when the engine
+    recorded ``funnel_stats`` — the measured reduction."""
+    mode = config.funnel
+    if not device or not config.funnel_enabled(full_masks):
+        if mode == "off":
+            why = "disabled"
+        elif not device:
+            why = "host engine, no device hot path"
+        else:
+            why = "full per-position flag masks requested"
+        return f"funnel: off ({mode}: {why})"
+    if stats and stats.get("screened"):
+        screened = int(stats["screened"])
+        survivors = int(stats["survivors"])
+        reduction = screened / max(survivors, 1)
+        return (
+            f"funnel: on ({mode}): {screened} positions -> "
+            f"{survivors} survivors, {reduction:.1f}x reduction"
+        )
+    return f"funnel: on ({mode})"
+
+
 class CheckerContext:
     def __init__(
         self,

@@ -45,6 +45,7 @@ def run(
         expected, actual = ctx.eager_verdict, ctx.seqdoop_verdict
     ctx.print_header_and_confusion(expected, actual)
     _print_cache_status(ctx)
+    _print_funnel_status(ctx, device=False)
 
 
 def _print_cache_status(ctx: CheckerContext) -> None:
@@ -54,6 +55,14 @@ def _print_cache_status(ctx: CheckerContext) -> None:
     from spark_bam_tpu.sbi.store import cache_status_line
 
     ctx.printer.echo(cache_status_line(ctx.path, ctx.config))
+
+
+def _print_funnel_status(
+    ctx: CheckerContext, device: bool = True, stats: dict | None = None
+) -> None:
+    from spark_bam_tpu.cli.app import funnel_status_line
+
+    ctx.printer.echo(funnel_status_line(ctx.config, stats=stats, device=device))
 
 
 def _run_sharded(ctx: CheckerContext) -> None:
@@ -75,6 +84,9 @@ def _run_sharded(ctx: CheckerContext) -> None:
     print_report_header(p, stats["positions"], compressed, num_reads)
     p.echo(f"checked across {stats['devices']} device(s)")
     _print_cache_status(ctx)
+    # Mesh steps psum record-scale counters only, so no survivor totals
+    # here — the line reports the mode the device step actually ran with.
+    _print_funnel_status(ctx)
     if not stats["false_positives"] and not stats["false_negatives"]:
         p.echo("All calls matched!")
         return

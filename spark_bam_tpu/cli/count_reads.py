@@ -45,11 +45,12 @@ def run(
         # packed into HBM chunks, one dispatch per chunk — the remote-
         # device configuration. A config-level opt-in (env/dict) applies
         # only where the mode exists, so CRAM counting is unaffected.
+        from spark_bam_tpu.cli.app import funnel_status_line
         from spark_bam_tpu.tpu.stream_check import StreamChecker
 
-        timed_loop(
-            lambda: StreamChecker(path, config).count_reads_resident()
-        )
+        checker = StreamChecker(path, config)
+        timed_loop(checker.count_reads_resident)
+        p.echo(funnel_status_line(config, stats=checker.funnel_stats), "")
         return
     if sharded:
         # Mesh-scale streaming count across every device (no hadoop-bam

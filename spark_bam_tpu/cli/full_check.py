@@ -11,7 +11,7 @@ from spark_bam_tpu.check.flags import (
     considered_mask,
     num_failing_fields,
 )
-from spark_bam_tpu.cli.app import CheckerContext
+from spark_bam_tpu.cli.app import CheckerContext, funnel_status_line
 
 
 
@@ -164,6 +164,9 @@ def run_streaming(ctx: CheckerContext, sharded: bool = False) -> None:
         s["two_check_positions"], s["two_check_masks"],
         s["per_flag"], pos_str,
     )
+    # full-check needs every per-position flag mask, so the funnel's
+    # verdict-only projection never applies; say so rather than go silent.
+    p.echo(funnel_status_line(ctx.config, full_masks=True))
 
 
 def run(ctx: CheckerContext) -> None:
@@ -202,3 +205,4 @@ def run(ctx: CheckerContext) -> None:
         _mask_counts(masks[all_considered]),
         lambda i: str(ctx.annotate(i)),
     )
+    p.echo(funnel_status_line(ctx.config, device=False))

@@ -73,11 +73,23 @@ def _add_limits(sub):
     )
 
 
+def _add_funnel(sub):
+    sub.add_argument(
+        "--funnel", default=None, choices=("on", "off", "auto"),
+        help="two-stage checker candidate funnel: cheap prefilter over "
+             "every position, deep checks on survivors only. auto "
+             "(default) funnels verdict paths and keeps the exact "
+             "single-pass kernel for full flag-mask output "
+             "(SPARK_BAM_FUNNEL env var works too; docs/design.md)",
+    )
+
+
 def _add_common(sub, split_default=None):
     _add_metrics(sub)
     _add_faults(sub)
     _add_cache(sub)
     _add_limits(sub)
+    _add_funnel(sub)
     sub.add_argument("-m", "--max-split-size", default=split_default,
                      help="split size (byte shorthand like 2MB ok)")
     sub.add_argument("-l", "--print-limit", type=int, default=10)
@@ -286,6 +298,9 @@ def main(argv=None) -> int:
             # every parser this invocation touches decodes under them.
             set_limits(DecodeLimits.parse(args.limits))
             config = config.replace(limits=args.limits)
+        if getattr(args, "funnel", None) is not None:
+            config = config.replace(funnel=args.funnel)
+        config.funnel_enabled()  # fail early on a bad SPARK_BAM_FUNNEL
         if getattr(args, "chaos", None):
             chaos_state = install_chaos(args.chaos)
     except ValueError as e:

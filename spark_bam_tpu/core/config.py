@@ -107,6 +107,24 @@ class Config:
     # Compact DecodeLimits spec ("record=32MB,refs=1000"; "" = defaults).
     # Same string-spec pattern; ``decode_limits`` parses it (cached).
     limits: str = ""
+    # --- candidate funnel (tpu/checker.py; docs/design.md) ---
+    # Two-stage checker hot path: cheap fixed-block prefilter over every
+    # position, full 19-flag pass only on survivors. "auto" (default)
+    # funnels verdict projections (spans/count/check-bam) and keeps the
+    # single-pass kernel wherever full per-position flag masks are the
+    # product (full-check forensics) — the funnel's masks are only
+    # verdict-faithful. "on" behaves like auto (mask projections always
+    # take the exact path); "off" disables it everywhere.
+    funnel: str = "auto"                # on | off | auto
+    # --- device pacing (tpu/stream_check.py) ---
+    # Device→host flush interval for the fused count path, in windows.
+    # None → auto: ≤ 2^30 positions between flushes so the on-device
+    # int32 accumulators cannot overflow (the auto cap still bounds
+    # explicit values).
+    flush_every: int | None = None
+    # Windows whose device scalars may remain un-synced in the fused
+    # count ring (the two-in-flight pipeline's pacing depth).
+    ring_depth: int = 2
     # --- misc ---
     warn: bool = False                  # root log-level toggle (args/LogArgs.scala:30-33)
     # Accepted for config-surface parity (PostPartitionArgs -p, default
@@ -148,6 +166,31 @@ class Config:
 
         return DecodeLimits.parse(self.limits)
 
+    def funnel_enabled(self, full_masks: bool = False) -> bool:
+        """Whether a projection should run the two-stage candidate funnel.
+
+        ``full_masks=True`` marks projections whose *product* is the
+        per-position flag mask (full-check forensics): those always take
+        the exact single-pass kernel — the funnel's masks carry only
+        prefilter bits at rejected positions, so they are verdict-faithful
+        but not mask-faithful.
+        """
+        mode = self.funnel
+        if mode not in ("on", "off", "auto"):
+            raise ValueError(
+                f"Bad funnel mode: {mode!r} (expected on | off | auto)"
+            )
+        return mode != "off" and not full_masks
+
+    def flush_every_for(self, kernel_window: int) -> int:
+        """Count-path flush interval for this kernel window: the explicit
+        knob when set, else the int32-overflow-safe auto value; either way
+        capped so ≤ 2^30 positions accumulate between flushes."""
+        auto = max(1, (1 << 30) // max(kernel_window, 1))
+        if self.flush_every is None:
+            return auto
+        return max(1, min(self.flush_every, auto))
+
     def split_size_or(self, default: int) -> int:
         return self.split_size if self.split_size is not None else default
 
@@ -172,6 +215,11 @@ class Config:
             f = fields[name]
             if f.type in ("int", int):
                 value = parse_bytes(value) if isinstance(value, str) else int(value)
+            elif f.type == "int | None":
+                if value is None or str(value).lower() in ("auto", "none", ""):
+                    value = None
+                else:
+                    value = parse_bytes(value)
             elif f.type in ("float", float):
                 value = float(value)
             elif f.type in ("bool", bool, "bool | None"):

@@ -185,12 +185,15 @@ def make_shard_map_check_step(mesh: Mesh, reads_to_check: int = 10, axis: str = 
 
 def _make_sharded_stats_step(
     mesh: Mesh, reads_to_check: int, axis: str, row_stats, with_truth: bool,
-    flags_impl: str = "xla",
+    flags_impl: str = "xla", funnel: bool = False,
 ):
     """Shared scaffolding for the streaming-step makers below: per-row
     ``check_window`` + owned-span mask [lo, own), per-device ``vmap``, and
     the stat vector all-reduced with ``lax.psum`` over the mesh axis.
     ``row_stats(res, m, tr)`` stacks the workload's counters.
+    ``funnel=True`` runs the two-stage candidate funnel per row — verdict
+    projections only (the full-check step stays single-pass: its product
+    is the per-position flag mask, which the funnel does not preserve).
 
     Every counter psum'd here must be record-scale (≤ positions/40 per
     step), never position-scale: the reduction is int32 and a
@@ -210,7 +213,7 @@ def _make_sharded_stats_step(
         res = check_window(
             window, lengths, num_contigs, n, at_eof,
             reads_to_check=reads_to_check, flags_impl=flags_impl,
-            pallas_interpret=pallas_interpret,
+            pallas_interpret=pallas_interpret, funnel=funnel,
         )
         w = window.shape[0] - PAD
         i = jnp.arange(w, dtype=jnp.int32)
@@ -248,7 +251,7 @@ def _make_sharded_stats_step(
 
 def make_shard_map_count_step(
     mesh: Mesh, reads_to_check: int = 10, axis: str = "data",
-    flags_impl: str = "xla",
+    flags_impl: str = "xla", funnel: bool = False,
 ):
     """Sharded count-reads step: each device checks its window rows and the
     (boundary count, owned escapes) pair all-reduces with ``lax.psum`` —
@@ -266,13 +269,13 @@ def make_shard_map_count_step(
 
     return _make_sharded_stats_step(
         mesh, reads_to_check, axis, row_stats, with_truth=False,
-        flags_impl=flags_impl,
+        flags_impl=flags_impl, funnel=funnel,
     )
 
 
 def make_shard_map_confusion_step(
     mesh: Mesh, reads_to_check: int = 10, axis: str = "data",
-    flags_impl: str = "xla",
+    flags_impl: str = "xla", funnel: bool = False,
 ):
     """Sharded check-bam step: verdicts vs indexed truth at every owned
     position, the (tp, fp, fn, escapes) counters ``psum``'d over the mesh
@@ -295,7 +298,7 @@ def make_shard_map_confusion_step(
 
     return _make_sharded_stats_step(
         mesh, reads_to_check, axis, row_stats, with_truth=True,
-        flags_impl=flags_impl,
+        flags_impl=flags_impl, funnel=funnel,
     )
 
 

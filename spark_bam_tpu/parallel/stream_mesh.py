@@ -452,7 +452,7 @@ def count_reads_sharded(
     )
     step = make_shard_map_count_step(
         st.mesh, reads_to_check=config.reads_to_check, axis=st.axis,
-        flags_impl=config.flags_impl,
+        flags_impl=config.flags_impl, funnel=config.funnel_enabled(),
     )
     count = escapes = steps = 0
     dirty: list[int] = []  # local row offsets (c0) of escaped steps
@@ -828,7 +828,7 @@ def check_bam_sharded(
     truth_flats = _truth_flats(path, records_path, st.metas)
     step = make_shard_map_confusion_step(
         st.mesh, reads_to_check=config.reads_to_check, axis=st.axis,
-        flags_impl=config.flags_impl,
+        flags_impl=config.flags_impl, funnel=config.funnel_enabled(),
     )
 
     def fill_row(row, buf, base, n):

@@ -181,38 +181,285 @@ def _compute_misc(p, n):
     return remaining, body_end
 
 
+def _misc_at(p, n, pos):
+    """``_compute_misc`` evaluated at arbitrary positions (K,) int32.
+
+    The funnel walk needs remaining/body_end only at lane positions, so it
+    gathers the seven fixed-block bytes there instead of materializing two
+    full-width arrays; value-identical to indexing ``_compute_misc``'s
+    outputs at ``pos`` (``pos`` pre-clipped to [0, w), PAD covers the +17)."""
+    def byte(off):
+        return jnp.take(p, pos + off, mode="clip").astype(jnp.uint32)
+
+    u = byte(0) | (byte(1) << 8) | (byte(2) << 16) | (byte(3) << 24)
+    remaining = lax.bitcast_convert_type(u, jnp.int32)
+    name_len = byte(12).astype(_I32)
+    n_cigar = (byte(16) | (byte(17) << 8)).astype(_I32)
+    has_name = name_len >= 2
+    name_eof = has_name & (pos + 36 + name_len > n)
+    name_in = has_name & (~name_eof)
+    cig_start = pos + 36 + jnp.where(name_in, name_len, _I32(0))
+    few_fixed = pos > n - 36
+    body_end = jnp.where(
+        few_fixed,
+        pos + 36,
+        cig_start + jnp.where(~name_eof, _I32(4) * n_cigar, _I32(0)),
+    )
+    return remaining, body_end
+
+
+# ---------------------------------------------------------------------------
+# Candidate funnel: stage 0 = cheap prefilter over every position, stage 1 =
+# compact survivors and deep-check only those. The prefilter evaluates ONLY
+# fixed-block-derivable bits (remaining bounds, refID/pos ranges, name_len
+# sanity, implied-size consistency) — no name-byte scans, no cigar scans — so
+# it is provably a superset filter: every bit it can set is also set by the
+# full pass at the same position, hence full-pass survivors (F == 0) always
+# pass the prefilter. Deep-only bits (name charset/termination, cigar ops,
+# empty-mapped) are evaluated once at candidate positions via K-sized gathers
+# against word-level hierarchical tables (full-width cumsums cost ~60 ms per
+# 8 MB window on CPU XLA; packed-u32 popcount prefixes cost ~3 ms).
+
+_U32 = jnp.uint32
+
+
+def _prefilter_flags(p, lengths, num_contigs, n):
+    """Stage-0 funnel pass: the fixed-block-derivable subset of the 19 bits.
+
+    Mirrors the corresponding prefix of ``_compute_flags`` exactly,
+    including the ``tooFewFixedBlockBytes`` *overwrite* (not OR) — so at
+    few-fixed positions the prefilter mask equals the full mask."""
+    w = p.shape[0] - PAD
+    u = _i32_at(p, w)
+    i32 = lax.bitcast_convert_type(u, jnp.int32)
+    remaining = i32[0:w]
+    ref_idx = i32[4: w + 4]
+    ref_pos = i32[8: w + 8]
+    name_len = p[12: w + 12].astype(_I32)
+    n_cigar = (u[16: w + 16] & 0xFFFF).astype(_I32)
+    seq_len = i32[20: w + 20]
+    next_ref_idx = i32[24: w + 24]
+    next_ref_pos = i32[28: w + 28]
+
+    c = num_contigs
+    cmax = lengths.shape[0]
+    len_r = jnp.take(lengths, jnp.clip(ref_idx, 0, cmax - 1), mode="clip")
+    len_n = jnp.take(lengths, jnp.clip(next_ref_idx, 0, cmax - 1), mode="clip")
+    F = _ref_pos_bits(
+        ref_idx, ref_pos, c, len_r,
+        BIT["negativeReadIdx"], BIT["tooLargeReadIdx"],
+        BIT["negativeReadPos"], BIT["tooLargeReadPos"],
+    )
+    F = F | _ref_pos_bits(
+        next_ref_idx, next_ref_pos, c, len_n,
+        BIT["negativeNextReadIdx"], BIT["tooLargeNextReadIdx"],
+        BIT["negativeNextReadPos"], BIT["tooLargeNextReadPos"],
+    )
+    t = seq_len + _I32(1)
+    half = lax.div(t, _I32(2))
+    rhs = _I32(32) + name_len + _I32(4) * n_cigar + half + seq_len
+    F = F | jnp.where(remaining < rhs, _I32(BIT["tooFewRemainingBytesImplied"]), _I32(0))
+    F = F | jnp.where(name_len == 0, _I32(BIT["noReadName"]), _I32(0))
+    F = F | jnp.where(name_len == 1, _I32(BIT["emptyReadName"]), _I32(0))
+    idx = jnp.arange(w, dtype=_I32)
+    few_fixed = idx > n - 36
+    F = jnp.where(few_fixed, _I32(BIT["tooFewFixedBlockBytes"]), F)
+    return F
+
+
+def _pack_bits(bits):
+    """Pack a bool vector into uint32 words (lane = bit index), zero-padding
+    the tail to a word boundary."""
+    length = bits.shape[0]
+    full = -(-length // 32) * 32
+    if full != length:
+        bits = jnp.concatenate([bits, jnp.zeros(full - length, dtype=bits.dtype)])
+    lanes = jnp.arange(32, dtype=_U32)
+    return jnp.sum(bits.reshape(-1, 32).astype(_U32) << lanes[None, :], axis=1)
+
+
+def _funnel_tables(p, n):
+    """Word-level hierarchical prefix tables for the deep checks: packed
+    indicator bitmasks + exclusive per-word popcount prefixes. Exact
+    per-position prefix counts are recovered at query time with one masked
+    popcount, so no full-width cumsum is ever materialized."""
+    allowed = (p >= 0x21) & (p <= 0x7E) & (p != 0x40)
+    nwords = _pack_bits(allowed)
+    nwpc = lax.population_count(nwords).astype(_I32)
+    nwpre = jnp.cumsum(nwpc) - nwpc
+
+    j = jnp.arange(p.shape[0], dtype=_I32)
+    bad_op = ((p & 0xF) > 8) & (j + 4 <= n)
+    cwords = _pack_bits(bad_op)
+    cm = _U32(0x11111111)
+    wpc4 = jnp.stack(
+        [lax.population_count(cwords & (cm << c)).astype(_I32) for c in range(4)],
+        axis=1,
+    )
+    cwpre4 = (jnp.cumsum(wpc4, axis=0) - wpc4).reshape(-1)  # flat: wi*4 + class
+    return nwords, nwpre, cwords, cwpre4
+
+
+def _allowed_before(nwords, nwpre, q):
+    """# allowed read-name chars at byte positions < q."""
+    wi = q >> 5
+    r = (q & 31).astype(_U32)
+    word = jnp.take(nwords, wi, mode="clip")
+    part = lax.population_count(word & ((_U32(1) << r) - _U32(1)))
+    return jnp.take(nwpre, wi, mode="clip") + part.astype(_I32)
+
+
+def _badops_before(cwords, cwpre4, q, c):
+    """# bad cigar-op bytes j < q with j ≡ c (mod 4)."""
+    wi = q >> 5
+    r = (q & 31).astype(_U32)
+    word = jnp.take(cwords, wi, mode="clip")
+    cmask = _U32(0x11111111) << c.astype(_U32)
+    part = lax.population_count(word & cmask & ((_U32(1) << r) - _U32(1)))
+    return jnp.take(cwpre4, wi * 4 + c, mode="clip") + part.astype(_I32)
+
+
+def _deep_flags_at(p, lengths, num_contigs, n, tables, pos):
+    """The full 19-bit mask of ``_compute_flags`` at arbitrary positions
+    (K,), via K-sized slab gathers + the hierarchical tables. Field-for-field
+    identical to the full pass (same overwrite, same reference quirks)."""
+    nwords, nwpre, cwords, cwpre4 = tables
+    total = p.shape[0]
+    pc = jnp.clip(pos, 0, total - 36)
+    slab = jnp.take(p, pc[:, None] + jnp.arange(36, dtype=_I32)[None, :], mode="clip")
+
+    def i32at(off):
+        u = (
+            slab[:, off].astype(_U32)
+            | (slab[:, off + 1].astype(_U32) << 8)
+            | (slab[:, off + 2].astype(_U32) << 16)
+            | (slab[:, off + 3].astype(_U32) << 24)
+        )
+        return lax.bitcast_convert_type(u, jnp.int32)
+
+    remaining = i32at(0)
+    ref_idx = i32at(4)
+    ref_pos = i32at(8)
+    name_len = slab[:, 12].astype(_I32)
+    fnc = lax.bitcast_convert_type(i32at(16), _U32)
+    n_cigar = (fnc & 0xFFFF).astype(_I32)
+    mapped = ((fnc >> 18) & 1) == 0
+    seq_len = i32at(20)
+    next_ref_idx = i32at(24)
+    next_ref_pos = i32at(28)
+
+    c = num_contigs
+    cmax = lengths.shape[0]
+    len_r = jnp.take(lengths, jnp.clip(ref_idx, 0, cmax - 1), mode="clip")
+    len_n = jnp.take(lengths, jnp.clip(next_ref_idx, 0, cmax - 1), mode="clip")
+    F = _ref_pos_bits(
+        ref_idx, ref_pos, c, len_r,
+        BIT["negativeReadIdx"], BIT["tooLargeReadIdx"],
+        BIT["negativeReadPos"], BIT["tooLargeReadPos"],
+    )
+    F = F | _ref_pos_bits(
+        next_ref_idx, next_ref_pos, c, len_n,
+        BIT["negativeNextReadIdx"], BIT["tooLargeNextReadIdx"],
+        BIT["negativeNextReadPos"], BIT["tooLargeNextReadPos"],
+    )
+    t = seq_len + _I32(1)
+    half = lax.div(t, _I32(2))
+    rhs = _I32(32) + name_len + _I32(4) * n_cigar + half + seq_len
+    F = F | jnp.where(remaining < rhs, _I32(BIT["tooFewRemainingBytesImplied"]), _I32(0))
+    F = F | jnp.where(name_len == 0, _I32(BIT["noReadName"]), _I32(0))
+    F = F | jnp.where(name_len == 1, _I32(BIT["emptyReadName"]), _I32(0))
+
+    name_start = pos + 36
+    name_end = name_start + name_len
+    has_name = name_len >= 2
+    name_eof = has_name & (name_end > n)
+    F = F | jnp.where(name_eof, _I32(BIT["tooFewBytesForReadName"]), _I32(0))
+    name_in = has_name & (~name_eof)
+    last_idx = name_end - 1
+    last_byte = jnp.take(p, jnp.clip(last_idx, 0, total - 1), mode="clip")
+    non_null = name_in & (last_byte != 0)
+    F = F | jnp.where(non_null, _I32(BIT["nonNullTerminatedReadName"]), _I32(0))
+    good = (
+        _allowed_before(nwords, nwpre, jnp.clip(last_idx, 0, total - 1))
+        - _allowed_before(nwords, nwpre, jnp.clip(name_start, 0, total - 1))
+    )
+    bad_chars = name_in & (~non_null) & (good != name_len - 1)
+    F = F | jnp.where(bad_chars, _I32(BIT["nonASCIIReadName"]), _I32(0))
+
+    cig_start = name_start + jnp.where(name_in, name_len, _I32(0))
+    cig_end = cig_start + _I32(4) * n_cigar
+    cig_considered = ~name_eof
+    ccls = cig_start & 3
+    bad_count = (
+        _badops_before(cwords, cwpre4, jnp.clip(cig_end, 0, total - 1), ccls)
+        - _badops_before(cwords, cwpre4, jnp.clip(cig_start, 0, total - 1), ccls)
+    )
+    has_bad = cig_considered & (bad_count != 0)
+    F = F | jnp.where(has_bad, _I32(BIT["invalidCigarOp"]), _I32(0))
+    cig_eof = cig_considered & (~has_bad) & (cig_end > n)
+    F = F | jnp.where(cig_eof, _I32(BIT["tooFewBytesForCigarOps"]), _I32(0))
+    empty_ok = cig_considered & (~has_bad) & (~cig_eof) & mapped
+    empty_seq = empty_ok & (seq_len == 0)
+    empty_cig = empty_ok & (n_cigar == 0)
+    some_empty = empty_seq | empty_cig
+    # Swapped on purpose: reference quirk (see check/vectorized.py).
+    F = F | jnp.where(some_empty & empty_seq, _I32(BIT["emptyMappedCigar"]), _I32(0))
+    F = F | jnp.where(some_empty & empty_cig, _I32(BIT["emptyMappedSeq"]), _I32(0))
+
+    few_fixed = pos > n - 36
+    F = jnp.where(few_fixed, _I32(BIT["tooFewFixedBlockBytes"]), F)
+    return F
+
+
+def _compact_mask(mask, capacity: int):
+    """Compact set positions of ``mask`` into a (capacity,) index buffer
+    (-1 beyond the population) without any full-width cumsum/sort/scatter:
+    pack to u32 words, build a word-level popcount prefix (tiny cumsum),
+    binary-search the word holding the k-th survivor, then locate the
+    in-word bit with masked popcounts. Returns (cand, n_set)."""
+    words = _pack_bits(mask)
+    wpc = lax.population_count(words).astype(_I32)
+    wcnt = jnp.cumsum(wpc)
+    n_set = wcnt[-1]
+    k = jnp.arange(capacity, dtype=_I32)
+    wi = jnp.searchsorted(wcnt, k + 1, side="left").astype(_I32)
+    excl = jnp.take(wcnt - wpc, jnp.clip(wi, 0, wcnt.shape[0] - 1), mode="clip")
+    r = k + 1 - excl                              # target rank within word: 1..32
+    word = jnp.take(words, wi, mode="clip")
+    lanes = jnp.arange(32, dtype=_U32)
+    incl = (_U32(2) << lanes) - _U32(1)           # inclusive masks (lane 31 wraps to ~0)
+    pcnt = lax.population_count(word[:, None] & incl[None, :])
+    hit = (pcnt == r[:, None]) & (((word[:, None] >> lanes[None, :]) & 1) == 1)
+    lane = jnp.argmax(hit, axis=1).astype(_I32)
+    cand = jnp.where(k < n_set, wi * 32 + lane, _I32(-1))
+    return cand, n_set
+
+
 # Sentinel bounds for the logical cursor: anything outside [0, n] behaves
 # identically (it can never equal the physical cursor at EOF), so clamping is
 # exact unless the cursor needs to *re-enter* range — tracked per lane.
-@functools.partial(
-    jax.jit,
-    static_argnames=("reads_to_check", "window", "flags_impl", "pallas_interpret"),
-)
-def check_window(
-    padded: jnp.ndarray,       # (W+PAD,) uint8; zeros beyond n
-    lengths: jnp.ndarray,      # (Cmax,) int32 contig lengths, padded
-    num_contigs: jnp.ndarray,  # () int32
-    n: jnp.ndarray,            # () int32: valid byte count
-    at_eof: jnp.ndarray,       # () bool: buffer end == file end
-    reads_to_check: int = 10,
-    window: int | None = None,
-    flags_impl: str = "xla",   # "xla" | "pallas" (spark.bam.backend=pallas)
-    pallas_interpret: bool = False,
+def _check_lanes(
+    padded, lengths, num_contigs, n, at_eof,
+    reads_to_check: int = 10, flags_impl: str = "xla",
+    pallas_interpret: bool = False, funnel: bool = False,
 ):
-    """Flag pass + chain walk over one window; verdicts for every offset.
-
-    The walk runs only over *survivor* lanes (positions whose own record
-    passes every check, F==0 — ~0.2% of positions on real data): candidates
-    compact into a fixed-capacity lane buffer, walk ``reads_to_check`` gather
-    rounds, and scatter back. Non-survivors resolve directly from F. If an
-    adversarial input overflows the lane capacity, the whole window escapes
-    to the host engine — exactness over speed, never a guess.
-
-    Returns dict of (W,) arrays: verdict, fail_mask, reads_parsed,
-    reads_before, exact, escaped.
-    """
+    """Flag pass + survivor compaction + lane walk, WITHOUT the full-width
+    scatters: the shared core of ``check_window`` (which scatters the lanes
+    back to (W,) arrays) and the funnel count path (which reduces the lanes
+    directly — for two scalars the scatters are pure overhead that XLA
+    cannot eliminate through the sums)."""
     w = padded.shape[0] - PAD
-    if flags_impl == "pallas":
+    if funnel:
+        if flags_impl == "pallas":
+            from spark_bam_tpu.tpu.pallas_kernels import prefilter_check_flags
+
+            F = prefilter_check_flags(
+                padded, lengths, num_contigs.reshape(1), n.reshape(1),
+                interpret=pallas_interpret,
+            )
+        else:
+            F = _prefilter_flags(padded, lengths, num_contigs, n)
+    elif flags_impl == "pallas":
         from spark_bam_tpu.tpu.pallas_kernels import full_check_flags
 
         F = full_check_flags(
@@ -221,7 +468,19 @@ def check_window(
         )
     else:
         F = _compute_flags(padded, lengths, num_contigs, n)
-    remaining, body_end = _compute_misc(padded, n)
+    if funnel:
+        # Lane-width misc: the walk only ever reads remaining/body_end at
+        # (capacity,) positions — full-width materialization is the single
+        # biggest non-prefilter cost on the funnel path.
+        misc_at = functools.partial(_misc_at, padded, n)
+    else:
+        remaining, body_end = _compute_misc(padded, n)
+
+        def misc_at(pi):
+            return (
+                jnp.take(remaining, pi, mode="clip"),
+                jnp.take(body_end, pi, mode="clip"),
+            )
 
     in_range = jnp.arange(w, dtype=_I32) < n
     definitive0 = F & DEFINITIVE_MASK
@@ -229,6 +488,9 @@ def check_window(
     survivor = (F == 0) & in_range
 
     # --- non-survivor resolution straight from F -------------------------
+    # (Under the funnel, F here is the prefilter mask: positions it rejects
+    # resolve identically — every prefilter bit is definitive except the
+    # tooFewFixedBlockBytes overwrite, where prefilter == full mask.)
     fail0 = (F != 0) & ((definitive0 != 0) | (at_eof & (boundary0 != 0)))
     esc0 = (F != 0) & (~at_eof) & (definitive0 == 0) & (boundary0 != 0)
     inexact0 = (F != 0) & (~at_eof) & (definitive0 != 0) & (boundary0 != 0)
@@ -239,11 +501,39 @@ def check_window(
 
     # --- survivor compaction ---------------------------------------------
     capacity = max(w // 32, 4096)
-    n_survivors = jnp.sum(survivor.astype(_I32))
-    overflow = n_survivors > capacity
-    (cand,) = jnp.nonzero(survivor, size=capacity, fill_value=-1)
-    cand = cand.astype(_I32)
-    live = cand >= 0
+    if funnel:
+        cand, n_survivors = _compact_mask(survivor, capacity)
+        overflow = n_survivors > capacity
+        live = cand >= 0
+        # Stage 1: full 19-bit flags once at candidate positions, scattered
+        # to a full-width array so the chain walk can look them up by
+        # position. A walked position either passes the prefilter (then its
+        # deep mask is here — deep-failing candidates resolve inside the
+        # walk's step logic exactly like fail0/esc0/inexact0 above) or
+        # fails it (then the prefilter bits alone are verdict-equivalent).
+        tables = _funnel_tables(padded, n)
+        F_cand = _deep_flags_at(
+            padded, lengths, num_contigs, n, tables,
+            jnp.where(live, cand, _I32(0)),
+        )
+        F_cand = jnp.where(live, F_cand, _I32(0))
+        tgt0 = jnp.where(live, cand, _I32(w))
+        F_deep = jnp.zeros(w + 1, dtype=_I32).at[tgt0].set(
+            F_cand, mode="drop"
+        )[:w]
+
+        def flags_lookup(pi):
+            pre = jnp.take(F, pi, mode="clip")
+            return jnp.where(pre == 0, jnp.take(F_deep, pi, mode="clip"), pre)
+    else:
+        n_survivors = jnp.sum(survivor.astype(_I32))
+        overflow = n_survivors > capacity
+        (cand,) = jnp.nonzero(survivor, size=capacity, fill_value=-1)
+        cand = cand.astype(_I32)
+        live = cand >= 0
+
+        def flags_lookup(pi):
+            return jnp.take(F, pi, mode="clip")
 
     logical = jnp.where(live, cand, _I32(0))
     physical = logical
@@ -273,7 +563,7 @@ def check_window(
         res = jnp.where(eof_esc, jnp.int8(2), res)
         run = res == 0
 
-        f = jnp.take(F, jnp.clip(physical, 0, w - 1), mode="clip")
+        f = flags_lookup(jnp.clip(physical, 0, w - 1))
         f = jnp.where(run, f, _I32(0))
         definitive = f & DEFINITIVE_MASK
         boundary = f & ESCAPE_MASK
@@ -290,7 +580,7 @@ def check_window(
 
         ok = run & (f == 0)
         pi = jnp.clip(physical, 0, w - 1)
-        rem = jnp.take(remaining, pi, mode="clip")
+        rem, b_end = misc_at(pi)
         # int32-safe logical advance: out-of-range values collapse to
         # sentinels (n+64 / -64) that preserve all future comparisons unless
         # the cursor would legitimately re-enter [0, n] — flagged for host
@@ -301,7 +591,7 @@ def check_window(
         next_logical = logical + 4 + rem_c
         next_logical = jnp.clip(next_logical, -(n + 64), n + 64)
         overflow_now = big | small | (logical + 4 + rem_c != next_logical)
-        next_physical = jnp.maximum(jnp.take(body_end, pi, mode="clip"), next_logical)
+        next_physical = jnp.maximum(b_end, next_logical)
         next_physical = jnp.minimum(next_physical, n)
         # (A chain stepping to/past the buffer end resolves at the next
         #  iteration's EOF check: success/fail when at_eof, escape otherwise.)
@@ -314,12 +604,85 @@ def check_window(
         ), None
 
     state = (logical, physical, l_overflowed, res, fail_mask, reads_before, reads_parsed, exact)
-    state, _ = lax.scan(step, state, jnp.arange(reads_to_check, dtype=_I32))
+    if funnel:
+        # Unrolled walk: the loop-carried scan blocks XLA from fusing the
+        # lane gathers with their producers (~25% of the funnel path); ten
+        # lane-width steps unroll cheaply. The funnel=False scan is kept
+        # verbatim so the funnel A/B baseline measures the original kernel.
+        state, _ = lax.scan(
+            step, state, jnp.arange(reads_to_check, dtype=_I32), unroll=True
+        )
+    else:
+        state, _ = lax.scan(
+            step, state, jnp.arange(reads_to_check, dtype=_I32)
+        )
     logical, physical, l_overflowed, res, fail_mask, reads_before, reads_parsed, exact = state
 
     full_chain = live & (res == 0)
     res = jnp.where(full_chain, jnp.int8(1), res)
     reads_parsed = jnp.where(full_chain, _I32(reads_to_check), reads_parsed)
+    return {
+        "survivor": survivor, "res0": res0, "fail_mask0": fail_mask0,
+        "inexact0": inexact0, "cand": cand, "live": live, "res": res,
+        "fail_mask": fail_mask, "reads_before": reads_before,
+        "reads_parsed": reads_parsed, "exact": exact,
+        "overflow": overflow, "n_survivors": n_survivors,
+    }
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "reads_to_check", "window", "flags_impl", "pallas_interpret", "funnel"
+    ),
+)
+def check_window(
+    padded: jnp.ndarray,       # (W+PAD,) uint8; zeros beyond n
+    lengths: jnp.ndarray,      # (Cmax,) int32 contig lengths, padded
+    num_contigs: jnp.ndarray,  # () int32
+    n: jnp.ndarray,            # () int32: valid byte count
+    at_eof: jnp.ndarray,       # () bool: buffer end == file end
+    reads_to_check: int = 10,
+    window: int | None = None,
+    flags_impl: str = "xla",   # "xla" | "pallas" (spark.bam.backend=pallas)
+    pallas_interpret: bool = False,
+    funnel: bool = False,      # two-stage candidate funnel (Config.funnel)
+):
+    """Flag pass + chain walk over one window; verdicts for every offset.
+
+    The walk runs only over *survivor* lanes (positions whose own record
+    passes every check, F==0 — ~0.2% of positions on real data): candidates
+    compact into a fixed-capacity lane buffer, walk ``reads_to_check`` gather
+    rounds, and scatter back. Non-survivors resolve directly from F. If an
+    adversarial input overflows the lane capacity, the whole window escapes
+    to the host engine — exactness over speed, never a guess.
+
+    ``funnel=True`` swaps the full-width 19-bit pass for the two-stage
+    candidate funnel: the cheap prefilter screens every position, survivors
+    compact, and the deep bits are evaluated once at candidate positions
+    only. Verdicts (and hence record-start positions) are identical to
+    ``funnel=False``; the documented differences are that ``fail_mask`` at
+    prefilter-rejected positions carries only the prefilter bits, and
+    ``exact`` may be True where the full pass reports a (definitively
+    failing) lane as inexact — both only affect forensic projections, which
+    run with the funnel off (Config.funnel="auto").
+
+    Returns dict of (W,) arrays: verdict, fail_mask, reads_parsed,
+    reads_before, exact, escaped — plus the () int32 ``survivors`` count
+    (stage-0 survivors under the funnel; full-pass survivors otherwise).
+    """
+    w = padded.shape[0] - PAD
+    L = _check_lanes(
+        padded, lengths, num_contigs, n, at_eof,
+        reads_to_check=reads_to_check, flags_impl=flags_impl,
+        pallas_interpret=pallas_interpret, funnel=funnel,
+    )
+    survivor, res0 = L["survivor"], L["res0"]
+    fail_mask0, inexact0 = L["fail_mask0"], L["inexact0"]
+    cand, live, res = L["cand"], L["live"], L["res"]
+    fail_mask, reads_before = L["fail_mask"], L["reads_before"]
+    reads_parsed, exact = L["reads_parsed"], L["exact"]
+    overflow, n_survivors = L["overflow"], L["n_survivors"]
 
     # --- scatter survivors back over the F-derived base -------------------
     tgt = jnp.where(live, cand, _I32(w))  # dead lanes scatter into the pad row
@@ -347,17 +710,21 @@ def check_window(
         "reads_before": rb_full,
         "exact": exact_out,
         "escaped": escaped,
+        "survivors": n_survivors,
     }
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("reads_to_check", "window", "flags_impl", "pallas_interpret"),
+    static_argnames=(
+        "reads_to_check", "window", "flags_impl", "pallas_interpret", "funnel"
+    ),
 )
 def count_window(
     padded, lengths, num_contigs, n, at_eof, lo, own,
     reads_to_check: int = 10, window: int | None = None,
     flags_impl: str = "xla", pallas_interpret: bool = False,
+    funnel: bool = False,
 ):
     """check_window fused with its owned-span count reduction.
 
@@ -368,24 +735,47 @@ def count_window(
     (Escapes are rare; the caller falls back to the exact spans path when
     ``esc_count`` is ever nonzero.)
     """
+    w = padded.shape[0] - PAD
+    i = jnp.arange(w, dtype=_I32)
+    m = (i >= lo) & (i < own)
+    if funnel:
+        # Scatter-free reduction: verdicts live only on survivor lanes
+        # (non-survivors never reach res==1) and escapes split cleanly into
+        # prefilter-rejected positions (res0==2) plus lane escapes, so both
+        # scalars reduce over lanes without materializing the (W,) arrays.
+        L = _check_lanes(
+            padded, lengths, num_contigs, n, at_eof,
+            reads_to_check=reads_to_check, flags_impl=flags_impl,
+            pallas_interpret=pallas_interpret, funnel=True,
+        )
+        own_lane = L["live"] & (L["cand"] >= lo) & (L["cand"] < own)
+        count = jnp.sum(own_lane & (L["res"] == 1))
+        esc = jnp.sum(m & (L["res0"] == 2)) + jnp.sum(
+            own_lane & (L["res"] == 2)
+        )
+        count = jnp.where(L["overflow"], 0, count)
+        esc = jnp.where(L["overflow"], jnp.sum(m), esc)
+        return {
+            "count": count, "esc_count": esc, "survivors": L["n_survivors"],
+        }
     res = check_window(
         padded, lengths, num_contigs, n, at_eof,
         reads_to_check=reads_to_check, window=window,
         flags_impl=flags_impl, pallas_interpret=pallas_interpret,
+        funnel=funnel,
     )
-    w = padded.shape[0] - PAD
-    i = jnp.arange(w, dtype=_I32)
-    m = (i >= lo) & (i < own)
     return {
         "count": jnp.sum(m & res["verdict"]),
         "esc_count": jnp.sum(m & res["escaped"]),
+        "survivors": res["survivors"],
     }
 
 
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "window", "reads_to_check", "iters", "flags_impl", "pallas_interpret"
+        "window", "reads_to_check", "iters", "flags_impl", "pallas_interpret",
+        "funnel",
     ),
 )
 def count_repeat(
@@ -396,6 +786,7 @@ def count_repeat(
     reads_to_check: int = 10,
     flags_impl: str = "xla",
     pallas_interpret: bool = False,
+    funnel: bool = False,
 ):
     """The fused count kernel repeated ``iters`` times in ONE dispatch.
 
@@ -418,6 +809,7 @@ def count_repeat(
             _I32(0), n_eff,
             reads_to_check=reads_to_check, window=window,
             flags_impl=flags_impl, pallas_interpret=pallas_interpret,
+            funnel=funnel,
         )
         return carry + r["count"], None
 
@@ -426,7 +818,8 @@ def count_repeat(
 
 
 def make_count_repeat(
-    window: int, reads_to_check: int = 10, flags_impl: str = "xla"
+    window: int, reads_to_check: int = 10, flags_impl: str = "xla",
+    funnel: bool = False,
 ):
     """A jit-compiled ``count_repeat`` for fixed window/iteration count."""
     pallas_interpret = _pallas_interpret_for(flags_impl)
@@ -436,6 +829,7 @@ def make_count_repeat(
             padded, lengths, num_contigs, n, at_eof,
             window=window, iters=iters, reads_to_check=reads_to_check,
             flags_impl=flags_impl, pallas_interpret=pallas_interpret,
+            funnel=funnel,
         )
 
     return run
@@ -448,7 +842,8 @@ def _pallas_interpret_for(flags_impl: str) -> bool:
 
 
 def make_count_window(
-    window: int, reads_to_check: int = 10, flags_impl: str = "xla"
+    window: int, reads_to_check: int = 10, flags_impl: str = "xla",
+    funnel: bool = False,
 ):
     """A jit-compiled fused count kernel for fixed ``window`` size."""
     pallas_interpret = _pallas_interpret_for(flags_impl)
@@ -458,6 +853,7 @@ def make_count_window(
             padded, lengths, num_contigs, n, at_eof, lo, own,
             reads_to_check=reads_to_check, window=window,
             flags_impl=flags_impl, pallas_interpret=pallas_interpret,
+            funnel=funnel,
         )
 
     return run
@@ -465,7 +861,9 @@ def make_count_window(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("window", "reads_to_check", "flags_impl", "pallas_interpret"),
+    static_argnames=(
+        "window", "reads_to_check", "flags_impl", "pallas_interpret", "funnel"
+    ),
 )
 def count_scan(
     chunk,      # (L,) uint8 resident chunk; L ≥ max(starts) + window + PAD
@@ -481,6 +879,7 @@ def count_scan(
     reads_to_check: int = 10,
     flags_impl: str = "xla",
     pallas_interpret: bool = False,
+    funnel: bool = False,
 ):
     """The fused count kernel scanned over K windows in ONE dispatch.
 
@@ -502,30 +901,33 @@ def count_scan(
     load/.../CanLoadBam.scala:173-243 at whole-chunk granularity.
     """
     def body(carry, xs):
-        cnt, esc = carry
+        cnt, esc, surv = carry
         s, n, ae, lo, own = xs
         win = lax.dynamic_slice(chunk, (s,), (window + PAD,))
         r = check_window(
             win, lengths, num_contigs, n, ae,
             reads_to_check=reads_to_check, window=window,
             flags_impl=flags_impl, pallas_interpret=pallas_interpret,
+            funnel=funnel,
         )
         i = jnp.arange(window, dtype=_I32)
         m = (i >= lo) & (i < own)
         return (
             cnt + jnp.sum(m & r["verdict"]),
             esc + jnp.sum(m & r["escaped"]),
+            surv + r["survivors"],
         ), None
 
-    (cnt, esc), _ = lax.scan(
-        body, (_I32(0), _I32(0)),
+    (cnt, esc, surv), _ = lax.scan(
+        body, (_I32(0), _I32(0), _I32(0)),
         (starts, ns, at_eofs, los, owns),
     )
-    return {"count": cnt, "esc_count": esc}
+    return {"count": cnt, "esc_count": esc, "survivors": surv}
 
 
 def make_count_scan(
-    window: int, reads_to_check: int = 10, flags_impl: str = "xla"
+    window: int, reads_to_check: int = 10, flags_impl: str = "xla",
+    funnel: bool = False,
 ):
     """A jit-compiled resident-chunk count kernel for fixed ``window``."""
     pallas_interpret = _pallas_interpret_for(flags_impl)
@@ -535,18 +937,22 @@ def make_count_scan(
             chunk, lengths, num_contigs, starts, ns, at_eofs, los, owns,
             window=window, reads_to_check=reads_to_check,
             flags_impl=flags_impl, pallas_interpret=pallas_interpret,
+            funnel=funnel,
         )
 
     return run
 
 
 def make_check_window(
-    window: int, reads_to_check: int = 10, flags_impl: str = "xla"
+    window: int, reads_to_check: int = 10, flags_impl: str = "xla",
+    funnel: bool = False,
 ):
     """A jit-compiled window kernel for fixed ``window`` size.
 
     ``flags_impl="pallas"`` swaps the flag pass for the Pallas full kernel
     (tpu/pallas_kernels.py); on non-TPU backends it runs in interpret mode.
+    ``funnel=True`` swaps in the two-stage candidate funnel (same verdicts,
+    see ``check_window``).
     """
     pallas_interpret = _pallas_interpret_for(flags_impl)
 
@@ -555,6 +961,7 @@ def make_check_window(
             padded, lengths, num_contigs, n, at_eof,
             reads_to_check=reads_to_check, window=window,
             flags_impl=flags_impl, pallas_interpret=pallas_interpret,
+            funnel=funnel,
         )
 
     return run

@@ -1,6 +1,13 @@
 """Pallas TPU kernels for the checker hot path.
 
-One kernel, verified bit-exact against the engines it mirrors:
+Two kernels, verified bit-exact against the engines they mirror:
+
+``prefilter_flags_kernel`` — stage 0 of the candidate funnel: only the
+flag bits derivable from the fixed 36-byte block (``remaining`` bounds,
+refID/pos range, name-length sanity), no name-byte scans and no cigar
+scans, so the slab halo shrinks from ``PAD`` to one DMA tile and the
+254-way unroll disappears entirely.  Positions it cannot reject go on to
+the deep pass in tpu/checker.py.
 
 ``full_flags_kernel`` — ALL 19 flag bits of the checker error model
 (check/flags.py; reference full/Checker.scala:17-198) computed in-kernel,
@@ -194,6 +201,127 @@ def _full_flags_kernel(p_hbm, lengths_ref, nc_ref, n_ref, out_ref, slab, sem):
     F = jnp.where(few_fixed, _I32(BIT["tooFewFixedBlockBytes"]), F)
 
     out_ref[...] = F
+
+
+# --------------------------------------------------- funnel stage-0 kernel
+
+# The prefilter only reads the fixed block (bytes [l, l+36)); one 1 KiB
+# halo tile keeps the DMA length a multiple of Mosaic's tiling like PAD.
+PRE_HALO = 1024
+
+
+def _prefilter_flags_kernel(p_hbm, lengths_ref, nc_ref, n_ref, out_ref, slab, sem):
+    i = pl.program_id(0)
+    copy = pltpu.make_async_copy(
+        p_hbm.at[pl.ds(i * TILE, TILE + PRE_HALO)], slab, sem
+    )
+    copy.start()
+    copy.wait()
+    tile = slab[...]
+    t = TILE
+    base = i * TILE
+    nval = n_ref[0]
+    c = nc_ref[0]
+
+    # --- fixed-field extraction (lane l ↔ candidate offset base+l) -------
+    remaining = _i32_at(tile, 0, t)
+    ref_idx = _i32_at(tile, 4, t)
+    ref_pos = _i32_at(tile, 8, t)
+    name_len = tile[12: t + 12].astype(_I32)
+    fnc = _i32_at(tile, 16, t)
+    n_cigar = fnc & 0xFFFF
+    seq_len = _i32_at(tile, 20, t)
+    next_ref_idx = _i32_at(tile, 24, t)
+    next_ref_pos = _i32_at(tile, 28, t)
+
+    abs_i = base + _iota(t)
+
+    # --- contig-length lookup without gather: scalar loop over SMEM ------
+    def contig_body(j, carry):
+        len_r, len_n = carry
+        lj = lengths_ref[j]
+        len_r = jnp.where(ref_idx == j, lj, len_r)
+        len_n = jnp.where(next_ref_idx == j, lj, len_n)
+        return len_r, len_n
+
+    len_r, len_n = lax.fori_loop(
+        0, c, contig_body,
+        (jnp.zeros(t, dtype=_I32), jnp.zeros(t, dtype=_I32)),
+    )
+
+    def ref_bits(idx, pos, len_at, b_neg_idx, b_large_idx, b_neg_pos, b_large_pos):
+        neg_idx = idx < -1
+        large_idx = (~neg_idx) & (idx >= c)
+        neg_pos = pos < -1
+        idx_ok = (~neg_idx) & (~large_idx)
+        large_pos = idx_ok & (~neg_pos) & (idx >= 0) & (pos > len_at)
+        return (
+            jnp.where(neg_idx, _I32(b_neg_idx), _I32(0))
+            | jnp.where(large_idx, _I32(b_large_idx), _I32(0))
+            | jnp.where(neg_pos, _I32(b_neg_pos), _I32(0))
+            | jnp.where(large_pos, _I32(b_large_pos), _I32(0))
+        )
+
+    F = ref_bits(
+        ref_idx, ref_pos, len_r,
+        BIT["negativeReadIdx"], BIT["tooLargeReadIdx"],
+        BIT["negativeReadPos"], BIT["tooLargeReadPos"],
+    )
+    F = F | ref_bits(
+        next_ref_idx, next_ref_pos, len_n,
+        BIT["negativeNextReadIdx"], BIT["tooLargeNextReadIdx"],
+        BIT["negativeNextReadPos"], BIT["tooLargeNextReadPos"],
+    )
+
+    # --- implied size (JVM int32 wrap + truncating division) -------------
+    tt = seq_len + _I32(1)
+    half = lax.div(tt, _I32(2))
+    rhs = _I32(32) + name_len + _I32(4) * n_cigar + half + seq_len
+    F = F | jnp.where(
+        remaining < rhs, _I32(BIT["tooFewRemainingBytesImplied"]), _I32(0)
+    )
+    F = F | jnp.where(name_len == 0, _I32(BIT["noReadName"]), _I32(0))
+    F = F | jnp.where(name_len == 1, _I32(BIT["emptyReadName"]), _I32(0))
+
+    # --- the only flag when the fixed 36-byte read itself fails ----------
+    few_fixed = abs_i > nval - 36
+    F = jnp.where(few_fixed, _I32(BIT["tooFewFixedBlockBytes"]), F)
+
+    out_ref[...] = F
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def prefilter_check_flags(
+    padded: jnp.ndarray,       # (W + FULL_HALO,) uint8, W a multiple of TILE
+    lengths: jnp.ndarray,      # (Cmax,) int32
+    num_contigs: jnp.ndarray,  # (1,) int32
+    n: jnp.ndarray,            # (1,) int32: valid byte count
+    interpret: bool = False,
+):
+    """Stage-0 funnel bits at every offset of the window: the fixed-block
+    subset of the 19-flag model, a guaranteed superset of full-pass
+    rejections among those bits (positions it clears still face the deep
+    pass)."""
+    w = padded.shape[0] - FULL_HALO
+    assert w % TILE == 0, "window must be a multiple of the tile size"
+    grid = (w // TILE,)
+    return pl.pallas_call(
+        _prefilter_flags_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),     # bytes stay in HBM; DMA'd
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec((TILE,), lambda i: (i,)),
+        out_shape=jax.ShapeDtypeStruct((w,), jnp.int32),
+        scratch_shapes=[
+            pltpu.VMEM((TILE + PRE_HALO,), jnp.uint8),
+            pltpu.SemaphoreType.DMA,
+        ],
+        interpret=interpret,
+    )(padded, lengths, num_contigs, n)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))

@@ -165,8 +165,15 @@ class StreamChecker:
         # position after the contig dictionary).
         self.header_end_abs = self.header.uncompressed_size
         # Flush the device count accumulators to host ints often enough
-        # that the int32 sums cannot overflow: ≤ 2^30 positions per chunk.
-        self.flush_every = max(1, (1 << 30) // self.kernel_window)
+        # that the int32 sums cannot overflow: ≤ 2^30 positions per chunk
+        # (Config.flush_every overrides within that cap).
+        self.flush_every = config.flush_every_for(self.kernel_window)
+        # Pacing depth of the fused count ring (Config.ring_depth).
+        self.ring_depth = max(1, config.ring_depth)
+        # Funnel totals across the consuming projection (positions
+        # screened by stage 0 / stage-0 survivors); None until a funnelled
+        # window lands — the CLI's ``funnel:`` summary line reads this.
+        self.funnel_stats: dict | None = None
 
     # ------------------------------------------------------------ the loop
     def _windows(self, launch):
@@ -193,7 +200,20 @@ class StreamChecker:
     def _flags_impl(self) -> str:
         return self.config.flags_impl
 
-    def _launcher(self):
+    def _funnel_add(self, screened: int, survivors: int):
+        """Fold one window's (or chunk's) funnel totals into the stats
+        surface and the ``funnel.*`` observability counters."""
+        if self.funnel_stats is None:
+            self.funnel_stats = {"screened": 0, "survivors": 0}
+        self.funnel_stats["screened"] += screened
+        self.funnel_stats["survivors"] += survivors
+        if obs.enabled():
+            obs.count("funnel.positions", screened)
+            obs.count("funnel.survivors", survivors)
+            obs.observe("funnel.window_survivors", survivors)
+            obs.observe("funnel.reduction", screened / max(survivors, 1))
+
+    def _launcher(self, full_masks: bool = False):
         """Full-output launch (the spans path)."""
         if not self.use_device:
             return lambda buf, n, at_eof, lo, own_end: None  # host-lazy
@@ -202,6 +222,7 @@ class StreamChecker:
         kernel = make_check_window(
             self.kernel_window, self.config.reads_to_check,
             flags_impl=self._flags_impl(),
+            funnel=self.config.funnel_enabled(full_masks),
         )
         lens_dev, nc = self._device_inputs()
         w = self.kernel_window
@@ -226,6 +247,7 @@ class StreamChecker:
         kernel = make_count_window(
             self.kernel_window, self.config.reads_to_check,
             flags_impl=self._flags_impl(),
+            funnel=self.config.funnel_enabled(),
         )
         lens_dev, nc = self._device_inputs()
         w = self.kernel_window
@@ -395,9 +417,14 @@ class StreamChecker:
         each window tuple (``None`` on deferred re-emissions)."""
         deferred = self._Deferred(self.lengths, self.config.reads_to_check)
         windows = 0
-        for buf, base, own_end, at_eof, out in self._windows(self._launcher()):
+        funnel = self.use_device and self.config.funnel_enabled(defer_inexact)
+        for buf, base, own_end, at_eof, out in self._windows(
+            self._launcher(full_masks=defer_inexact)
+        ):
             with obs.span("check.window", base=base, own=own_end):
                 res = self._materialize(buf, at_eof, out)
+                if funnel:
+                    self._funnel_add(len(buf), int(res["survivors"]))
                 spans = [res[f][:own_end].copy() for f in fields]
                 bad = res["escaped"][:own_end]
                 if defer_inexact:
@@ -447,11 +474,15 @@ class StreamChecker:
         total = 0
         dev_total = None
         dev_esc = None
+        dev_surv = None
         windows = 0
         chunk = 0
+        screened = 0
         flush_every = self.flush_every
+        funnel = self.config.funnel_enabled()
         escaped = False
-        ring: list = []  # pacing: keep ≤2 windows' scalars un-synced
+        # pacing: keep ≤ ring_depth windows' scalars un-synced
+        ring: list = []
         for buf, base, own_end, at_eof, out in self._windows(
             self._count_launcher()
         ):
@@ -462,8 +493,13 @@ class StreamChecker:
                 out["esc_count"] if dev_esc is None
                 else dev_esc + out["esc_count"]
             )
+            dev_surv = (
+                out["survivors"] if dev_surv is None
+                else dev_surv + out["survivors"]
+            )
+            screened += len(buf)
             ring.append(out["count"])
-            if len(ring) > 2:
+            if len(ring) > self.ring_depth:
                 ring.pop(0).block_until_ready()
             windows += 1
             chunk += 1
@@ -487,13 +523,18 @@ class StreamChecker:
                     escaped = True
                     break
                 total += int(dev_total)
-                dev_total = dev_esc = None
+                if funnel:
+                    self._funnel_add(screened, int(dev_surv))
+                dev_total = dev_esc = dev_surv = None
                 chunk = 0
+                screened = 0
         if not escaped and dev_total is not None:
             if int(dev_esc):
                 escaped = True
             else:
                 total += int(dev_total)
+                if funnel:
+                    self._funnel_add(screened, int(dev_surv))
         if escaped:
             # Rare exact path (chains outran the halo — ultra-long reads):
             # the spans path resolves every deferral bit-exactly. Suppress
@@ -548,8 +589,10 @@ class StreamChecker:
         else:
             chunk_windows = min(chunk_windows, max_windows)
         kernel = make_count_scan(
-            w, self.config.reads_to_check, flags_impl=self._flags_impl()
+            w, self.config.reads_to_check, flags_impl=self._flags_impl(),
+            funnel=self.config.funnel_enabled(),
         )
+        funnel = self.config.funnel_enabled()
         lens_dev, nc = self._device_inputs()
 
         total = 0
@@ -605,18 +648,23 @@ class StreamChecker:
                 obs.count("check.positions", own_end)
                 if len(rows) >= cap:
                     out = flush(rows)
+                    scr = sum(len(r[0]) for r in rows)
                     rows = []
                     chunks += 1
                     cap = chunk_windows
-                    pend.append((out["count"], out["esc_count"]))
+                    pend.append(
+                        (out["count"], out["esc_count"], out["survivors"], scr)
+                    )
                     # Sync the first (small) chunk's scalars immediately;
                     # after that, one chunk behind.
                     if chunks == 1 or len(pend) > 1:
-                        cnt, esc = pend.pop(0)
+                        cnt, esc, surv, scr = pend.pop(0)
                         if int(esc):
                             escaped = True
                             break
                         total += int(cnt)
+                        if funnel:
+                            self._funnel_add(scr, int(surv))
                     # Progress at dispatch points only: buffered-but-unsent
                     # windows must not inflate the forensics position.
                     if self.progress is not None:
@@ -626,12 +674,17 @@ class StreamChecker:
         if not escaped:
             if rows:
                 out = flush(rows)
-                pend.append((out["count"], out["esc_count"]))
-            for cnt, esc in pend:
+                scr = sum(len(r[0]) for r in rows)
+                pend.append(
+                    (out["count"], out["esc_count"], out["survivors"], scr)
+                )
+            for cnt, esc, surv, scr in pend:
                 if int(esc):
                     escaped = True
                     break
                 total += int(cnt)
+                if funnel:
+                    self._funnel_add(scr, int(surv))
             if not escaped and self.progress is not None and windows_done:
                 self.progress(windows_done, pos_flushed, self.total)
         if escaped:

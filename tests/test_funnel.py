@@ -1,0 +1,239 @@
+"""Candidate-funnel tier-1 suite.
+
+Two claims keep the funnel honest (docs/design.md, "Candidate funnel"):
+the stage-0 prefilter is a provable superset filter (every full-pass
+survivor passes it), and every funnel projection is verdict-identical to
+the full pass — on factory corpora, on seeded decode-fuzz mutants, and on
+adversarial byte soup. Everything here runs on the virtual CPU mesh;
+Pallas coverage uses interpret mode.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from spark_bam_tpu.bam.header import contig_lengths
+from spark_bam_tpu.bgzf.flat import flatten_file
+from spark_bam_tpu.core.config import Config
+from spark_bam_tpu.tpu import checker as ck
+from tests.bam_factories import random_bam
+
+W = 256 << 10  # multiple of the Pallas TILE (32 KiB)
+
+PARITY_KEYS = ("verdict", "escaped", "reads_before", "reads_parsed")
+
+
+def _window_of(data, w=W):
+    padded = np.zeros(w + ck.PAD, dtype=np.uint8)
+    n = min(len(data), w)
+    padded[:n] = np.asarray(data)[:n]
+    return jnp.asarray(padded), jnp.int32(n)
+
+
+def _lens_of(path):
+    arr = np.array(contig_lengths(path).lengths_list(), dtype=np.int32)
+    lens = np.zeros(1024, dtype=np.int32)
+    lens[: len(arr)] = arr
+    return jnp.asarray(lens), jnp.int32(len(arr))
+
+
+@pytest.fixture(scope="module")
+def corpus(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("funnel")
+    paths = []
+    for i, kw in enumerate((
+        dict(n_records=(150, 400)),
+        dict(n_records=(80, 200), mapped_rate=0.3, dup_rate=0.2),
+    )):
+        p = tmp / f"c{i}.bam"
+        random_bam(p, seed=100 + i, **kw)
+        paths.append(p)
+    return paths
+
+
+@pytest.fixture(scope="module")
+def kernels():
+    return (
+        ck.make_check_window(W, 10, funnel=True),
+        ck.make_check_window(W, 10, funnel=False),
+    )
+
+
+def test_check_window_parity_corpora(corpus, kernels):
+    """Funnel on/off: identical verdicts (hence identical record starts),
+    escapes, and read counts at every position, both at_eof values."""
+    on, off = kernels
+    for p in corpus:
+        pd, n = _window_of(flatten_file(p).data)
+        ld, nc = _lens_of(p)
+        for at_eof in (True, False):
+            a = on(pd, ld, nc, n, jnp.bool_(at_eof))
+            b = off(pd, ld, nc, n, jnp.bool_(at_eof))
+            for k in PARITY_KEYS:
+                np.testing.assert_array_equal(
+                    np.asarray(a[k]), np.asarray(b[k]),
+                    err_msg=f"{p.name} at_eof={at_eof} key={k}",
+                )
+            np.testing.assert_array_equal(
+                np.flatnonzero(np.asarray(a["verdict"])),
+                np.flatnonzero(np.asarray(b["verdict"])),
+            )
+
+
+def test_count_window_parity(corpus):
+    on = ck.make_count_window(W, 10, funnel=True)
+    off = ck.make_count_window(W, 10, funnel=False)
+    p = corpus[0]
+    pd, n = _window_of(flatten_file(p).data)
+    ld, nc = _lens_of(p)
+    spans = ((0, int(n)), (1000, int(n) // 2))
+    for at_eof in (True, False):
+        for lo, own in spans:
+            a = on(pd, ld, nc, n, jnp.bool_(at_eof), jnp.int32(lo), jnp.int32(own))
+            b = off(pd, ld, nc, n, jnp.bool_(at_eof), jnp.int32(lo), jnp.int32(own))
+            assert int(a["count"]) == int(b["count"]), (at_eof, lo, own)
+            assert int(a["esc_count"]) == int(b["esc_count"]), (at_eof, lo, own)
+
+
+def test_fuzz_mutant_parity(kernels, tmp_path):
+    """Seeded decode-fuzz BAM mutants: the funnel must never flip a verdict
+    on corrupted input (where the prefilter's screening earns its keep)."""
+    from spark_bam_tpu.tools.fuzz_decode import _mutants_for, _Rng
+
+    on, off = kernels
+    rng = _Rng(5)
+    checked = 0
+    for i, blob in enumerate(_mutants_for("bam", tmp_path, rng, 12)):
+        p = tmp_path / f"m{i}.bam"
+        p.write_bytes(blob)
+        try:
+            data = flatten_file(p).data
+            ld, nc = _lens_of(p)
+        except Exception:
+            continue  # mutant broke the header/BGZF layer: nothing to scan
+        pd, n = _window_of(data)
+        for at_eof in (True, False):
+            a = on(pd, ld, nc, n, jnp.bool_(at_eof))
+            b = off(pd, ld, nc, n, jnp.bool_(at_eof))
+            for k in PARITY_KEYS:
+                np.testing.assert_array_equal(
+                    np.asarray(a[k]), np.asarray(b[k]),
+                    err_msg=f"mutant {i} at_eof={at_eof} key={k}",
+                )
+        checked += 1
+    assert checked >= 5, f"only {checked} mutants survived decode"
+
+
+def _assert_superset(pd, ld, nc, n):
+    """Every prefilter bit must also be set by the full pass — hence
+    full-pass survivors (F == 0) are a subset of prefilter survivors."""
+    pre = np.asarray(ck._prefilter_flags(pd, ld, nc, n))
+    full = np.asarray(ck._compute_flags(pd, ld, nc, n))
+    stray = pre & ~full
+    assert not stray.any(), (
+        f"prefilter set bits the full pass did not at "
+        f"{np.flatnonzero(stray)[:5]}"
+    )
+    assert not ((full == 0) & (pre != 0)).any()
+
+
+def test_superset_on_corpus(corpus):
+    for p in corpus:
+        pd, n = _window_of(flatten_file(p).data)
+        ld, nc = _lens_of(p)
+        _assert_superset(pd, ld, nc, n)
+
+
+def test_superset_on_adversarial_windows(corpus):
+    """Byte soup and bit-flipped corpus windows: the superset property is
+    structural (prefilter bits are a subset of full-pass bits at every
+    position), so it must hold on arbitrary garbage, not just valid BAM."""
+    rng = np.random.default_rng(11)
+    ld, nc = _lens_of(corpus[0])
+    soup = rng.integers(0, 256, size=W, dtype=np.uint8)
+    pd, n = _window_of(soup)
+    _assert_superset(pd, ld, nc, n)
+
+    data = np.array(flatten_file(corpus[0]).data[:W], dtype=np.uint8, copy=True)
+    flips = rng.integers(0, len(data), size=max(1, len(data) // 100))
+    data[flips] ^= rng.integers(1, 256, size=len(flips)).astype(np.uint8)
+    pd, n = _window_of(data)
+    _assert_superset(pd, ld, nc, n)
+
+
+def test_pallas_prefilter_matches_xla(corpus):
+    """The fused Pallas prefilter tile kernel (interpret mode off-TPU) is
+    bit-identical to the XLA prefilter."""
+    from spark_bam_tpu.tpu.pallas_kernels import prefilter_check_flags
+
+    p = corpus[0]
+    pd, n = _window_of(flatten_file(p).data)
+    ld, nc = _lens_of(p)
+    got = np.asarray(
+        prefilter_check_flags(
+            pd, ld, nc.reshape(1), n.reshape(1), interpret=True
+        )
+    )
+    want = np.asarray(ck._prefilter_flags(pd, ld, nc, n))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_stream_record_starts_parity(corpus):
+    """Whole-stream projection: funnel on vs off yield byte-identical
+    record-start positions, and only the funnelled run reports stats."""
+    from spark_bam_tpu.tpu.stream_check import StreamChecker
+
+    p = corpus[0]
+
+    def starts(mode):
+        checker = StreamChecker(
+            p, Config(funnel=mode), window_uncompressed=128 << 10,
+            halo=32 << 10,
+        )
+        got = np.sort(np.concatenate(
+            list(checker.record_starts()) or [np.array([], dtype=np.int64)]
+        ))
+        return got, checker.funnel_stats
+
+    s_on, stats_on = starts("on")
+    s_off, stats_off = starts("off")
+    np.testing.assert_array_equal(s_on, s_off)
+    assert len(s_on) > 0
+    assert stats_off is None
+    assert stats_on is not None and stats_on["screened"] > 0
+    assert stats_on["survivors"] <= stats_on["screened"]
+
+
+def test_config_funnel_knobs():
+    assert Config().funnel == "auto"
+    assert Config().funnel_enabled() is True
+    assert Config().funnel_enabled(full_masks=True) is False
+    assert Config(funnel="off").funnel_enabled() is False
+    assert Config(funnel="on").funnel_enabled() is True
+    # Explicit "on" still cannot apply where full flag masks are required.
+    assert Config(funnel="on").funnel_enabled(full_masks=True) is False
+    with pytest.raises(ValueError, match="funnel"):
+        Config(funnel="bogus").funnel_enabled()
+
+
+def test_config_funnel_env_and_dict():
+    cfg = Config.from_env({"SPARK_BAM_FUNNEL": "off"})
+    assert cfg.funnel == "off"
+    cfg = Config.from_dict({"spark.bam.funnel": "on"})
+    assert cfg.funnel == "on"
+
+
+def test_config_flush_every_and_ring_depth():
+    kw = 1 << 20
+    auto = (1 << 30) // kw
+    assert Config().flush_every_for(kw) == auto
+    assert Config.from_dict({"spark.bam.flush_every": "auto"}).flush_every is None
+    assert Config.from_dict({"spark.bam.flush_every": "8"}).flush_every == 8
+    assert Config(flush_every=8).flush_every_for(kw) == 8
+    # The int32-overflow cap always wins over a larger operator setting.
+    assert Config(flush_every=10 * auto).flush_every_for(kw) == auto
+    assert Config(flush_every=0).flush_every_for(kw) == 1
+    assert Config(ring_depth=4).ring_depth == 4
+    cfg = Config.from_env({"SPARK_BAM_RING_DEPTH": "3"})
+    assert cfg.ring_depth == 3
