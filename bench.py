@@ -740,6 +740,17 @@ def _obs_stages(reg) -> dict:
             c["name"]: c["value"] for c in snap["counters"]
             if not c["labels"]
         },
+        # Non-timing histograms (e.g. inflate.rounds — LZ77 rounds to
+        # convergence per device batch): count + mean, enough to read
+        # "how deep do real chains go" from a capture.
+        "hists": {
+            h["name"]: {
+                "count": h["count"],
+                "mean": round(h["sum"] / max(h["count"], 1), 2),
+            }
+            for h in snap.get("hists", [])
+            if h.get("labels", {}).get("unit") != "ms"
+        },
     }
     trace_out = os.environ.get("SPARK_BAM_METRICS_OUT")
     if trace_out:
@@ -969,21 +980,31 @@ def _run_e2e_resident(
 
 
 def _child_resident(
-    window_mb: int, big_path: str, reads: int, chunk_windows: int = 0
+    window_mb: int, big_path: str, reads: int, chunk_windows: int = 0,
+    platform: str = "default",
 ):
     """The resident-scan e2e leg, isolated in its own process: count_scan
     is a brand-new XLA program no other leg compiles, and _run_e2e_resident
     has no projection abort (its device work is per-chunk, not per-window)
     — a wedged compile over the tunnel must cost only this child's
     timeout, never the proven legs (the r05 burn-the-window lesson,
-    applied to new programs generally)."""
+    applied to new programs generally).
+
+    ``platform="cpu"`` pins the CPU backend and runs the leg anyway — the
+    tier-1 resident-crash regression test drives exactly this child (the
+    r05 crash must be reproducible in-harness, not only on a live TPU);
+    an *unrequested* CPU backend still skips, as a device leg should."""
     _emit_stage("start")
+    if platform == "cpu":
+        from spark_bam_tpu.core.platform import force_cpu_devices
+
+        force_cpu_devices(1)
     enable_compile_cache()
     import jax
 
     backend = jax.devices()[0].platform
     _emit_stage("backend_ok:" + backend)
-    if backend == "cpu":
+    if backend == "cpu" and platform != "cpu":
         _emit_result("resident_child", {"skipped": True, "backend": backend})
         return
     from spark_bam_tpu.bgzf.index_blocks import blocks_metadata
@@ -1042,6 +1063,24 @@ def _child_inflate(window_mb: int, big_path: str, reads: int):
         _emit_stage(
             "inflate_error:" + f"{type(e).__name__}: {e}"[:300].replace("\n", " ")
         )
+
+
+def _child_probe():
+    """Backend-init probe: jax init + device enumeration, NOTHING else.
+
+    The r05 window=32MB/16MB "stalls" were never about window size — both
+    legs died between ``start`` and ``backend_ok``, i.e. inside jax TPU
+    backend init against a dark tunnel, and the ladder burned two full
+    5-minute init timeouts discovering the same dead backend twice. This
+    probe answers "is the backend even there?" in one cheap child; the
+    ladder skips itself (with a clear warning) when the answer is no."""
+    _emit_stage("start")
+    enable_compile_cache()
+    import jax
+
+    backend = jax.devices()[0].platform
+    _emit_stage("backend_ok:" + backend)
+    _emit_result("probe", {"backend": backend})
 
 
 def _run_cli_smoke(backend: str):
@@ -1165,14 +1204,33 @@ def _device_ladder(big_path: str, reads: int, quick_path: str,
                    quick_reads: int):
     """TPU attempts through the window ladder, then CPU-backend fallback.
 
-    Returns (results_by_leg, stages, errors). Backend-init failures (no
-    backend_ok stage) retry once, then short-circuit the ladder — smaller
-    windows can't fix a dead tunnel. A child that landed ANY primary leg
-    (an e2e or the steady kernel) counts as a success — a partial child
-    (e.g. killed after its e2e legs) must not discard the artifact by
-    retrying the whole window.
+    Returns (results_by_leg, stages, errors). A cheap ``--child-probe``
+    (jax init + device enumeration only) gates the whole ladder: backend
+    init is window-size-independent, so when the probe can't reach
+    ``backend_ok`` the ladder is skipped with ONE clear warning instead of
+    burning an init timeout per rung (the r05 window=32MB/16MB
+    ``stages=['start']`` double-burn). Past the probe, backend-init
+    failures (a tunnel that died mid-run) still retry once, then
+    short-circuit. A child that landed ANY primary leg (an e2e or the
+    steady kernel) counts as a success — a partial child (e.g. killed
+    after its e2e legs) must not discard the artifact by retrying the
+    whole window.
     """
     errors = []
+    probe_timeout = int(
+        os.environ.get("SB_BENCH_PROBE_S", str(min(INIT_TIMEOUT_S, 240)))
+    )
+    if probe_timeout > 0:
+        probe_res, probe_stages, probe_err = _run_child(
+            ["--child-probe"], probe_timeout
+        )
+        if probe_res.get("probe", {}).get("backend") is None:
+            errors.append(
+                "backend probe failed "
+                f"({probe_err or 'no backend_ok'}); skipping device window "
+                "ladder — backend init is window-size-independent"
+            )
+            return {}, probe_stages, errors
     deadline = time.time() + DEVICE_BUDGET_S
     backend_failures = 0
     for window_mb in WINDOW_LADDER_MB:
@@ -1461,6 +1519,67 @@ def funnel_leg(path: str, window: int = 8 << 20, reads_to_check: int = 10):
     }
 
 
+def inflate_ab_leg(path: str, window: int = 4 << 20, max_windows: int = 4):
+    """Host zlib vs two-phase device inflate over the SAME window groups
+    (in-process backend — CPU wherever the parent runs host-side legs, the
+    real chip when a TPU is attached). ``device_inflate_vs_host`` becomes a
+    first-class record field tracked per round in BENCH_HISTORY.jsonl
+    instead of a field buried inside the isolated inflate child; the
+    child's TPU-measured probe still takes precedence when it landed.
+    Equality is part of the result, not an assumption: ``equal`` gates the
+    ratio's meaning. Returns {} when the native tokenizer is missing."""
+    if not _device_inflate_available():
+        return {}
+    import jax
+
+    from spark_bam_tpu import obs
+    from spark_bam_tpu.bgzf.flat import inflate_blocks
+    from spark_bam_tpu.bgzf.index_blocks import blocks_metadata
+    from spark_bam_tpu.core.channel import open_channel
+    from spark_bam_tpu.tpu.inflate import inflate_group_device, window_plan
+
+    metas = list(blocks_metadata(path))
+    groups = window_plan(metas, window)[:max_windows]
+    if not groups:
+        return {}
+    reg = obs.configure()
+    host_s = dev_s = 0.0
+    nbytes = 0
+    equal = True
+    with open_channel(path) as ch:
+        for g in groups:  # compile each pow2 batch bucket before timing
+            inflate_group_device(ch, g)
+        for g in groups:
+            t0 = time.perf_counter()
+            hv = inflate_blocks(ch, g)
+            host_s += time.perf_counter() - t0
+            t0 = time.perf_counter()
+            dv = inflate_group_device(ch, g)
+            dev_s += time.perf_counter() - t0
+            nbytes += hv.size
+            equal = equal and dv is not None and np.array_equal(
+                np.asarray(hv.data), np.asarray(dv.data)
+            )
+    stages = _obs_stages(reg)
+    host_Bps = nbytes / max(host_s, 1e-9)
+    dev_Bps = nbytes / max(dev_s, 1e-9)
+    ratio = round(dev_Bps / max(host_Bps, 1e-9), 4)
+    return {
+        "inflate_ab": {
+            "host_Bps": round(host_Bps),
+            "device_Bps": round(dev_Bps),
+            "device_vs_host": ratio,
+            "equal": equal,
+            "windows": len(groups),
+            "bytes": nbytes,
+            "backend": jax.default_backend(),
+            "stages": stages,
+        },
+        "device_inflate_vs_host": ratio,
+        "device_inflate_equal": equal,
+    }
+
+
 def cpu_e2e_rate(path: Path, cap_bytes: int = CPU_E2E_CAP_BYTES):
     """The same count-reads workload on the native CPU checker: pipelined
     host inflate + sequential native eager check of every position.
@@ -1502,7 +1621,11 @@ def main():
         _child_resident(
             int(sys.argv[2]), sys.argv[3], int(sys.argv[4]),
             int(sys.argv[5]) if len(sys.argv) > 5 else 0,
+            sys.argv[6] if len(sys.argv) > 6 else "default",
         )
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == "--child-probe":
+        _child_probe()
         return
 
     record = {
@@ -1872,6 +1995,20 @@ def _main_measure(record, warnings, errors):
             record.update(funnel_leg(quick_path))
         except Exception as e:
             warnings.append(f"funnel leg: {type(e).__name__}: {e}")
+    # Host-zlib vs two-phase device inflate on identical windows
+    # (in-process backend). setdefault: the inflate child's TPU-measured
+    # first-class fields win when they landed; this leg guarantees the
+    # metric exists in EVERY round's history entry.
+    if quick_path:
+        try:
+            ab = inflate_ab_leg(quick_path)
+            for k, v in ab.items():
+                if k in ("device_inflate_vs_host", "device_inflate_equal"):
+                    record.setdefault(k, v)
+                else:
+                    record[k] = v
+        except Exception as e:
+            warnings.append(f"inflate A/B leg: {type(e).__name__}: {e}")
 
     pallas = results.get("pallas")
     if pallas is not None:

@@ -92,6 +92,20 @@ class Config:
     # because resident chunks hold ~1 GiB of HBM and the count is the only
     # projection the scan kernel serves.
     resident_scan: bool = False
+    # HBM budget for one resident-scan chunk, bytes (clamped to ≤ 1 GiB —
+    # the int32-offset ceiling — and to ≥ one window row). BENCH_r05's
+    # resident leg crashed the TPU worker at the old hardwired 1 GiB: two
+    # chunks in flight plus the scan body's window intermediates exceed a
+    # 16 GiB part at 32 MB windows. 256 MiB keeps the dispatch
+    # amortization (hundreds of windows per round-trip) with headroom.
+    resident_chunk_bytes: int = 256 << 20
+    # Fully device-resident count path (stream_check._count_reads_fused):
+    # ship packed LZ77 tokens, resolve + assemble + funnel + walk in one
+    # XLA program per window, carry chained in HBM. ``None`` = auto:
+    # follows the resolved ``device_inflate`` state (the two share the
+    # tokenizer prerequisite); demotes to the classic streaming loop
+    # whenever the tokenizer or kernel geometry can't serve a file.
+    fused_count: bool | None = None
     # --- fault tolerance (core/faults.py; docs/robustness.md) ---
     # Compact FaultPolicy spec ("retries=3,deadline=60,mode=tolerant"; "" =
     # defaults). Kept as the string form so the frozen dataclass stays

@@ -943,6 +943,104 @@ def make_count_scan(
     return run
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "window", "halo", "reads_to_check", "flags_impl", "pallas_interpret",
+        "funnel",
+    ),
+)
+def count_window_tokens(
+    packed,       # (3*B*STRIDE,) uint8 packed lit/dist token planes
+    out_lens,     # (B,) int32 inflated size per block row (0 ⇒ pad row)
+    carry,        # (halo,) uint8 previous window's tail (valid ≤ carry_len)
+    lengths,      # (Cmax,) int32
+    num_contigs,  # () int32
+    carry_len,    # () int32 valid carry bytes (≤ halo)
+    n,            # () int32 = carry_len + Σ out_lens (total window bytes)
+    at_eof,       # () bool
+    lo,           # () int32 owned-span start
+    own,          # () int32 owned-span end
+    *,
+    window: int,
+    halo: int,
+    reads_to_check: int = 10,
+    flags_impl: str = "xla",
+    pallas_interpret: bool = False,
+    funnel: bool = False,
+):
+    """The fully device-resident hot path: LZ77 resolve + window assembly
+    + funnel/deep check + chain walk in ONE XLA program.
+
+    The only H2D operands are the packed token planes from the host
+    entropy phase plus a handful of scalars; the only D2H results are the
+    two count scalars (+ survivors/rounds) and the (halo,) carry — which
+    itself stays on device between windows, so in steady state nothing but
+    scalars crosses the PCIe/tunnel boundary. Compare
+    ``inflate_blocks_device`` → host concatenate → ``count_window``, which
+    bounces every inflated byte through host twice.
+
+    Window assembly is gather-based: byte ``i`` of the logical window is
+    either ``carry[i]`` (the previous window's halo tail) or byte
+    ``j = i - carry_len`` of the concatenated block outputs, located by a
+    ``searchsorted`` over the cumulative ``out_lens`` — zero-length rows
+    (batch padding, empty final BGZF blocks) occupy no output range and
+    are skipped naturally. The new carry is the owned-end tail
+    ``val[own : own+halo]`` (zeros beyond ``n``), exactly the
+    ``halo_windows`` carry discipline.
+    """
+    from spark_bam_tpu.tpu.inflate import STRIDE, _resolve_body, _unpack_tokens
+
+    lit, dist = _unpack_tokens(packed)
+    resolved, rounds = _resolve_body(lit, dist)
+    b = lit.shape[0]
+    cum = jnp.concatenate(
+        [jnp.zeros(1, _I32), jnp.cumsum(out_lens.astype(_I32))]
+    )
+    i = jnp.arange(window, dtype=_I32)
+    j = i - carry_len
+    blk = jnp.clip(jnp.searchsorted(cum, j, side="right") - 1, 0, b - 1)
+    off = jnp.clip(j - cum[blk], 0, STRIDE - 1)
+    from_blocks = resolved.reshape(-1)[blk * STRIDE + off]
+    carry_v = carry[jnp.clip(i, 0, halo - 1)]
+    val = jnp.where(
+        i < carry_len, carry_v,
+        jnp.where(i < n, from_blocks, jnp.uint8(0)),
+    )
+    padded = jnp.concatenate([val, jnp.zeros(PAD, jnp.uint8)])
+    r = count_window(
+        padded, lengths, num_contigs, n, at_eof, lo, own,
+        reads_to_check=reads_to_check, window=window,
+        flags_impl=flags_impl, pallas_interpret=pallas_interpret,
+        funnel=funnel,
+    )
+    ext = jnp.concatenate([val, jnp.zeros(halo, jnp.uint8)])
+    new_carry = lax.dynamic_slice(ext, (own,), (halo,))
+    return {**r, "carry": new_carry, "rounds": rounds}
+
+
+def make_count_window_tokens(
+    window: int, halo: int, reads_to_check: int = 10,
+    flags_impl: str = "xla", funnel: bool = False,
+):
+    """A jit-compiled fused inflate→assemble→count kernel for fixed
+    window/halo geometry (the device-resident count path of
+    stream_check.StreamChecker.count_reads)."""
+    pallas_interpret = _pallas_interpret_for(flags_impl)
+
+    def run(packed, out_lens, carry, lengths, num_contigs, carry_len, n,
+            at_eof, lo, own):
+        return count_window_tokens(
+            packed, out_lens, carry, lengths, num_contigs, carry_len, n,
+            at_eof, lo, own,
+            window=window, halo=halo, reads_to_check=reads_to_check,
+            flags_impl=flags_impl, pallas_interpret=pallas_interpret,
+            funnel=funnel,
+        )
+
+    return run
+
+
 def make_check_window(
     window: int, reads_to_check: int = 10, flags_impl: str = "xla",
     funnel: bool = False,

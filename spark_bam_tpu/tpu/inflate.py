@@ -5,28 +5,44 @@ saturates cores — bgzf/flat.py) and ships flat windows to HBM. That is
 already off the critical path for the checker speedup: SURVEY.md §7 "the
 checker/parser speedup does not depend on it [device DEFLATE]".
 
-Path B is the **two-phase device inflate** (SURVEY §7 hard-part #1).
-Bit-serial Huffman decoding resists lane-parallelism, so the split is:
+Path B is the **batched two-phase device inflate** (SURVEY §7 hard-part
+#1). Bit-serial Huffman decoding resists lane-parallelism, so the split is:
 
 1. *Host entropy phase* (`sbt_tokenize_deflate`, native/): decode the
    DEFLATE bitstream into per-output-byte tokens — ``lit[i]`` (the byte, if
    position ``i`` was emitted by a literal) and ``dist[i]`` (0 for
-   literals; the back-reference distance otherwise, which fits u16 —
-   DEFLATE's max is 32768). Tokens cost 3 wire bytes per output byte on
-   the H2D hop; the implied parent pointer ``i - dist[i]`` is
-   reconstructed on device from an iota. No byte copying happens on host:
-   the LZ77 "copy" half of inflate — the memory-bandwidth half — is
-   deferred entirely.
+   literals; the back-reference distance otherwise, u16 — DEFLATE's max is
+   32768). The LZ77 "copy" half of inflate — the memory-bandwidth half —
+   is deferred entirely. Token rows for a whole window's worth of blocks
+   are **packed into one contiguous u8 buffer** (lit plane then dist
+   plane) so the H2D hop is a single 3-bytes-per-output-byte transfer,
+   unpacked on device by a bitcast inside the same XLA program as the
+   resolve kernel.
 2. *Device copy phase* (`resolve_lz77`): every output byte's value is the
-   byte at its pointer chain's root literal. Chains collapse in
-   ``log2(64 KiB) = 16`` lock-step pointer-doubling rounds — pure gathers
-   over a (blocks, 64 Ki) batch, fully lane-parallel, the same shape the
-   checker's chain walk uses. Overlapping copies (RLE runs) are just deep
-   chains; correctness is depth-independent.
+   byte at its pointer chain's root literal; parents materialize as
+   ``i - dist`` from an iota. Chains collapse with lock-step
+   pointer-doubling — ``parent = parent[parent]`` per round — which
+   **early-exits as soon as every chain has reached its root**
+   (``lax.while_loop`` convergence test; the same loop shape as the fused
+   Pallas kernel in tpu/pallas_kernels.py, ``lz77_resolve_pallas``).
+   ``log2(64 KiB) = 16`` rounds bound the worst case (a block-spanning
+   distance-1 RLE run); typical BAM blocks converge in a handful, and the
+   per-call round count feeds the ``inflate.rounds`` histogram.
 
-``InflatePipeline`` overlaps the stages per window — read+tokenize/inflate
-(host threads) → H2D transfer → device kernel — double-buffered so the
-device never waits on the host for steady-state streams.
+Batching: ALL blocks of a window group go through one tokenize call, one
+packed H2D transfer, and one resolve dispatch — (blocks, 64 Ki) lanes per
+launch, batch dim padded to a power of two so jit shape churn is bounded.
+
+``InflatePipeline`` overlaps the stages: worker threads run read +
+tokenize + pack + **async device dispatch** for up to ``depth`` window
+groups while the consumer materializes the previous window's resolved
+bytes — real double-buffering, so the device never idles on the host
+entropy phase and the host never idles on the device copy phase.
+
+The fully device-resident consumer (``checker.count_window_tokens``) goes
+one step further: it takes the packed tokens directly, resolves + windows
++ counts inside ONE program, and only scalars (and the halo carry) ever
+leave HBM — see stream_check.StreamChecker.count_reads.
 
 Keeping host zlib as the correctness fallback is permanent policy: the
 checker consumes identical flat windows from either producer.
@@ -35,6 +51,7 @@ checker consumes identical flat windows from either producer.
 from __future__ import annotations
 
 import logging
+import os
 import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Iterator
@@ -59,38 +76,129 @@ STRIDE = MAX_BLOCK_SIZE
 _DOUBLING_ROUNDS = (STRIDE - 1).bit_length()  # collapses any chain in-range
 
 
-@jax.jit
-def resolve_lz77(lit: jnp.ndarray, dist: jnp.ndarray) -> jnp.ndarray:
-    """Device phase 2: resolve all LZ77 back-references in parallel.
+def pack_tokens(lit: np.ndarray, dist: np.ndarray) -> np.ndarray:
+    """Pack (B, STRIDE) u8/u16 token rows into ONE contiguous u8 buffer
+    (lit plane, then the dist plane's little-endian bytes) — a single H2D
+    transfer instead of two, and the layout `_unpack_tokens` bitcasts back
+    for free on device."""
+    return np.concatenate([
+        np.ascontiguousarray(lit, dtype=np.uint8).reshape(-1),
+        np.ascontiguousarray(dist, dtype="<u2").view(np.uint8).reshape(-1),
+    ])
 
-    ``lit``/``dist`` are (B, STRIDE) u8/u16 token rows from the host
-    entropy phase (dist=0 ⇒ literal). Parents materialize on device as
-    ``i - dist`` (an iota minus the shipped distances — u16 on the wire,
-    i32 only in HBM), then pointer chains (copy → … → root literal)
-    collapse with log-step doubling — ``parent = parent[parent]`` per
-    round — and one final gather reads each root's literal byte. 16
-    rounds cover any chain that fits a 64 KiB block; padded tails are
-    dist=0 identities, so they resolve to themselves harmlessly.
-    """
+
+def _unpack_tokens(packed: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Device-side inverse of ``pack_tokens`` (shape-derived batch dim)."""
+    plane = packed.shape[0] // 3
+    b = plane // STRIDE
+    lit = packed[:plane].reshape(b, STRIDE)
+    dist = lax.bitcast_convert_type(
+        packed[plane:].reshape(b, STRIDE, 2), jnp.uint16
+    )
+    return lit, dist
+
+
+def _resolve_body(lit: jnp.ndarray, dist: jnp.ndarray):
+    """The traced LZ77 resolve: early-exit pointer doubling.
+
+    Returns ``(resolved (B, STRIDE) u8, rounds () i32)``. Convergence test:
+    ``parent[parent] == parent`` everywhere ⇔ every pointer reached a root
+    (roots are the only fixed points — dist=0 ⇒ parent=i), after which
+    further doubling is the identity. Worst case ``_DOUBLING_ROUNDS``; a
+    literal-only batch costs exactly one gather (the test itself)."""
     iota = jnp.arange(lit.shape[1], dtype=jnp.int32)[None, :]
     parent = iota - dist.astype(jnp.int32)
 
-    def round_(p, _):
-        return jnp.take_along_axis(p, p, axis=1), None
+    def cond(state):
+        _, r, done = state
+        return jnp.logical_and(~done, r < _DOUBLING_ROUNDS)
 
-    roots, _ = lax.scan(round_, parent, None, length=_DOUBLING_ROUNDS)
-    return jnp.take_along_axis(lit, roots, axis=1)
+    def body(state):
+        p, r, _ = state
+        nxt = jnp.take_along_axis(p, p, axis=1)
+        return nxt, r + jnp.int32(1), jnp.all(nxt == p)
+
+    roots, rounds, _ = lax.while_loop(
+        cond, body, (parent, jnp.int32(0), jnp.bool_(False))
+    )
+    return jnp.take_along_axis(lit, roots, axis=1), rounds
 
 
-def inflate_blocks_device(
+@jax.jit
+def resolve_lz77(lit: jnp.ndarray, dist: jnp.ndarray):
+    """Device phase 2: resolve all LZ77 back-references in parallel.
+
+    ``lit``/``dist`` are (B, STRIDE) u8/u16 token rows from the host
+    entropy phase (dist=0 ⇒ literal). Returns ``(resolved, rounds)`` —
+    the output bytes plus the number of pointer-doubling rounds the batch
+    actually needed (early exit on convergence; see ``_resolve_body``).
+    Padded tails are dist=0 identities, so they resolve to themselves
+    harmlessly.
+    """
+    return _resolve_body(lit, dist)
+
+
+@jax.jit
+def _resolve_packed(packed: jnp.ndarray):
+    """Unpack + resolve in ONE XLA program: the packed token buffer is the
+    only H2D operand, the bitcast unpack fuses with the first gather."""
+    lit, dist = _unpack_tokens(packed)
+    return _resolve_body(lit, dist)
+
+
+# Fused-Pallas LZ77 engine selection. "auto" uses the Pallas kernel on the
+# TPU backend (per-block VMEM rows, in-kernel early exit) and the XLA
+# while_loop elsewhere; a Mosaic lowering/compile failure demotes to XLA
+# permanently for the process (logged once). SPARK_BAM_LZ77=xla|pallas pins.
+_lz77_engine: str | None = None
+
+
+def _lz77_impl() -> str:
+    global _lz77_engine
+    if _lz77_engine is None:
+        env = os.environ.get("SPARK_BAM_LZ77", "").lower()
+        if env in ("xla", "pallas"):
+            _lz77_engine = env
+        else:
+            _lz77_engine = (
+                "pallas" if jax.default_backend() == "tpu" else "xla"
+            )
+    return _lz77_engine
+
+
+def _dispatch_resolve(packed: np.ndarray):
+    """H2D + resolve dispatch (async; nothing is synced here). Returns
+    ``(resolved_dev (B, STRIDE) u8, rounds_dev () i32)``."""
+    global _lz77_engine
+    if _lz77_impl() == "pallas":
+        try:
+            from spark_bam_tpu.tpu.pallas_kernels import lz77_resolve_pallas
+
+            dev = jnp.asarray(packed)
+            lit, dist = _unpack_tokens(dev)
+            return lz77_resolve_pallas(lit, dist)
+        except Exception:
+            _lz77_engine = "xla"
+            log.warning(
+                "Pallas LZ77 kernel unavailable; using the XLA resolve "
+                "(reported once per process)", exc_info=True,
+            )
+    return _resolve_packed(jnp.asarray(packed))
+
+
+def tokenize_pack(
     comp: np.ndarray,
     offsets: np.ndarray,
     lengths: np.ndarray,
     out_lengths: np.ndarray,
-) -> np.ndarray | None:
-    """Two-phase inflate of raw-DEFLATE payloads: host tokenize + device
-    LZ77 resolution. Returns the concatenated output bytes, or None when
-    the native tokenizer is unavailable (callers fall back to zlib)."""
+):
+    """Host entropy phase for a batch of raw-DEFLATE payloads: tokenize,
+    verify sizes against the block footers, pow2-pad the batch dim, pack.
+
+    Returns ``(packed u8, out_lens i64 (B,), b)`` — ``b`` the real (un-
+    padded) block count — or None when the native tokenizer is missing.
+    Raises IOError when the tokenizer disagrees with the footers.
+    """
     from spark_bam_tpu.native.build import tokenize_deflate_native
 
     with obs.span("inflate.tokenize", blocks=len(offsets)):
@@ -111,36 +219,58 @@ def inflate_blocks_device(
         dist = np.concatenate(
             [dist, np.zeros((b_pad - b, STRIDE), dtype=np.uint16)]
         )
+    with obs.span("inflate.pack", blocks=b, bytes=lit.nbytes + dist.nbytes):
+        packed = pack_tokens(lit, dist)
+    return packed, out_lens, b
+
+
+def _record_rounds(rounds_dev) -> None:
+    """Feed the rounds-to-convergence histogram (costs one scalar sync —
+    only under a live registry)."""
     if obs.enabled():
-        # Phase-split timing: H2D transfer (jnp.asarray materializes the
-        # tokens on device) vs the LZ77 kernel + D2H. The explicit sync
-        # between phases exists only under a live registry — the
-        # production path keeps the async single-expression dispatch.
-        with obs.span("inflate.h2d", blocks=b, bytes=lit.nbytes + dist.nbytes):
-            lit_d = jnp.asarray(lit)
-            dist_d = jnp.asarray(dist)
-            lit_d.block_until_ready()
-            dist_d.block_until_ready()
+        try:
+            obs.observe("inflate.rounds", int(rounds_dev), unit="rounds")
+        except Exception:
+            pass
+
+
+def inflate_blocks_device(
+    comp: np.ndarray,
+    offsets: np.ndarray,
+    lengths: np.ndarray,
+    out_lengths: np.ndarray,
+) -> np.ndarray | None:
+    """Two-phase inflate of raw-DEFLATE payloads: host tokenize + packed
+    H2D + device LZ77 resolution, all blocks in ONE kernel launch. Returns
+    the concatenated output bytes, or None when the native tokenizer is
+    unavailable (callers fall back to zlib)."""
+    tp = tokenize_pack(comp, offsets, lengths, out_lengths)
+    if tp is None:
+        return None
+    packed, out_lens, b = tp
+    if obs.enabled():
+        # Phase-split timing: H2D transfer (one packed buffer) vs the LZ77
+        # kernel + D2H. The explicit sync between phases exists only under
+        # a live registry — the production path keeps the async dispatch.
+        with obs.span("inflate.h2d", blocks=b, bytes=packed.nbytes):
+            packed_dev = jnp.asarray(packed)
+            packed_dev.block_until_ready()
+        obs.count("inflate.h2d_bytes", int(packed.nbytes))
         with obs.span("inflate.device_kernel", blocks=b):
-            resolved = np.asarray(resolve_lz77(lit_d, dist_d))[:b]
+            resolved_dev, rounds_dev = _resolve_packed(packed_dev)
+            resolved = np.asarray(resolved_dev)[:b]
+        _record_rounds(rounds_dev)
         obs.count("inflate.device_windows")
     else:
-        resolved = np.asarray(
-            resolve_lz77(jnp.asarray(lit), jnp.asarray(dist))
-        )[:b]
+        resolved_dev, rounds_dev = _dispatch_resolve(packed)
+        resolved = np.asarray(resolved_dev)[:b]
     return np.concatenate(
         [resolved[i, :n] for i, n in enumerate(out_lens.tolist())]
     ) if len(out_lens) else np.empty(0, dtype=np.uint8)
 
 
-def inflate_group_device(
-    ch,
-    metas: list[Metadata],
-    file_total: int | None = None,
-    at_eof: bool = False,
-) -> FlatView | None:
-    """Two-phase device inflate of a run of blocks → FlatView (the device
-    producer counterpart of bgzf/flat.py inflate_blocks)."""
+def _read_group_payloads(ch, metas: list[Metadata]):
+    """Concatenate a group's raw-DEFLATE payloads (host read phase)."""
     comp_parts, offs, lens = [], [], []
     off = 0
     for m in metas:
@@ -152,12 +282,52 @@ def inflate_group_device(
     comp = (
         np.concatenate(comp_parts) if comp_parts else np.empty(0, dtype=np.uint8)
     )
+    return comp, np.array(offs, dtype=np.int64), np.array(lens, dtype=np.int64)
+
+
+def tokenize_group(ch, metas: list[Metadata]):
+    """Read + tokenize + pack one window group of blocks. Returns
+    ``(packed, out_lens, b)`` or None (tokenizer unavailable); raises
+    IOError on footer disagreement. This is the host half the fully
+    device-resident count path feeds to ``checker.count_window_tokens``."""
+    comp, offs, lens = _read_group_payloads(ch, metas)
     usizes = np.array([m.uncompressed_size for m in metas], dtype=np.int64)
-    data = inflate_blocks_device(
-        comp, np.array(offs, dtype=np.int64), np.array(lens, dtype=np.int64), usizes
-    )
-    if data is None:
-        return None
+    return tokenize_pack(comp, offs, lens, usizes)
+
+
+class _PendingDeviceView:
+    """A window group whose resolve dispatch is in flight: the device
+    arrays plus everything needed to materialize a FlatView later (the
+    double-buffering seam — workers dispatch, the consumer materializes)."""
+
+    __slots__ = ("resolved_dev", "rounds_dev", "out_lens", "b", "metas",
+                 "file_total", "at_eof")
+
+    def __init__(self, resolved_dev, rounds_dev, out_lens, b, metas,
+                 file_total, at_eof):
+        self.resolved_dev = resolved_dev
+        self.rounds_dev = rounds_dev
+        self.out_lens = out_lens
+        self.b = b
+        self.metas = metas
+        self.file_total = file_total
+        self.at_eof = at_eof
+
+    def materialize(self) -> FlatView:
+        with obs.span("inflate.device_kernel", blocks=self.b):
+            resolved = np.asarray(self.resolved_dev)[: self.b]
+        _record_rounds(self.rounds_dev)
+        obs.count("inflate.device_windows")
+        data = np.concatenate(
+            [resolved[i, :n] for i, n in enumerate(self.out_lens.tolist())]
+        ) if len(self.out_lens) else np.empty(0, dtype=np.uint8)
+        return _group_view(data, self.metas, self.file_total, self.at_eof)
+
+
+def _group_view(
+    data: np.ndarray, metas: list[Metadata], file_total, at_eof
+) -> FlatView:
+    usizes = np.array([m.uncompressed_size for m in metas], dtype=np.int64)
     block_flat = np.zeros(len(metas), dtype=np.int64)
     if len(metas):
         np.cumsum(usizes[:-1], out=block_flat[1:])
@@ -169,6 +339,47 @@ def inflate_group_device(
         file_total,
         at_eof or (file_total is not None and total == file_total),
     )
+
+
+def dispatch_group_device(
+    ch,
+    metas: list[Metadata],
+    file_total: int | None = None,
+    at_eof: bool = False,
+) -> _PendingDeviceView | None:
+    """Host phases + async device dispatch for one group; no sync. Returns
+    None when the native tokenizer is unavailable."""
+    comp, offs, lens = _read_group_payloads(ch, metas)
+    usizes = np.array([m.uncompressed_size for m in metas], dtype=np.int64)
+    tp = tokenize_pack(comp, offs, lens, usizes)
+    if tp is None:
+        return None
+    packed, out_lens, b = tp
+    if obs.enabled():
+        with obs.span("inflate.h2d", blocks=b, bytes=packed.nbytes):
+            packed_dev = jnp.asarray(packed)
+            packed_dev.block_until_ready()
+        obs.count("inflate.h2d_bytes", int(packed.nbytes))
+        resolved_dev, rounds_dev = _resolve_packed(packed_dev)
+    else:
+        resolved_dev, rounds_dev = _dispatch_resolve(packed)
+    return _PendingDeviceView(
+        resolved_dev, rounds_dev, out_lens, b, metas, file_total, at_eof
+    )
+
+
+def inflate_group_device(
+    ch,
+    metas: list[Metadata],
+    file_total: int | None = None,
+    at_eof: bool = False,
+) -> FlatView | None:
+    """Two-phase device inflate of a run of blocks → FlatView (the device
+    producer counterpart of bgzf/flat.py inflate_blocks; synchronous)."""
+    pending = dispatch_group_device(ch, metas, file_total, at_eof)
+    if pending is None:
+        return None
+    return pending.materialize()
 
 
 def inflate_file_device(path) -> FlatView | None:
@@ -225,7 +436,14 @@ def window_plan(metas: list[Metadata], window_uncompressed: int) -> list[list[Me
 
 
 class InflatePipeline:
-    """Double-buffered host-inflate → device-window stream."""
+    """Double-buffered host-inflate → device-window stream.
+
+    With ``device_copy``, worker threads run the host phases (read +
+    tokenize + pack) and the *async* device dispatch for up to ``depth``
+    groups ahead; the consumer thread materializes resolved windows one at
+    a time. Tokenize of window k+1 therefore overlaps the device resolve
+    and D2H of window k — the device never idles on the host entropy
+    phase."""
 
     def __init__(
         self,
@@ -255,6 +473,14 @@ class InflatePipeline:
         self.depth = max(1, depth)
         self._warned_device_demote = False
 
+    def _demote_warn(self):
+        if not self._warned_device_demote:
+            self._warned_device_demote = True
+            log.warning(
+                "device inflate failed; demoting window(s) to host zlib "
+                "(reported once per stream)", exc_info=True,
+            )
+
     def __iter__(self) -> Iterator[FlatView]:
         ch = open_channel(self.path)
         pool = ThreadPoolExecutor(max_workers=self.depth)
@@ -265,18 +491,14 @@ class InflatePipeline:
                 # the tokenizer can't take (or a size disagreement) demotes
                 # the window, never kills the pipeline.
                 try:
-                    view = inflate_group_device(ch, group, file_total=self.total)
+                    pending = dispatch_group_device(
+                        ch, group, file_total=self.total
+                    )
                 except Exception:
-                    if not self._warned_device_demote:
-                        self._warned_device_demote = True
-                        log.warning(
-                            "device inflate failed; demoting window(s) to "
-                            "host zlib (reported once per stream)",
-                            exc_info=True,
-                        )
-                    view = None
-                if view is not None:
-                    return view
+                    self._demote_warn()
+                    pending = None
+                if pending is not None:
+                    return pending
             return inflate_blocks(
                 ch, group, file_total=self.total, threads=self.threads
             )
@@ -299,6 +521,20 @@ class InflatePipeline:
                 nxt = i + self.depth
                 if nxt < len(self.groups):
                     pending.append(pool.submit(produce, self.groups[nxt]))
+                if isinstance(view, _PendingDeviceView):
+                    # Materialize on the consumer thread: workers are
+                    # already tokenizing the NEXT groups while this D2H
+                    # syncs (the double-buffering overlap point). An async
+                    # dispatch error surfaces here — demote just this
+                    # window to host zlib.
+                    try:
+                        view = view.materialize()
+                    except Exception:
+                        self._demote_warn()
+                        view = inflate_blocks(
+                            ch, self.groups[i], file_total=self.total,
+                            threads=self.threads,
+                        )
                 if i == len(self.groups) - 1:
                     view.at_eof = True
                 yield view

@@ -33,6 +33,17 @@ a worst-case cigar array resolves in-slab. Wired into the product behind
 kernel; the chain walk is unchanged). On non-TPU backends it runs in
 interpret mode — the parity artifact (tests/test_pallas.py) pins it
 against both the XLA flag pass and the NumPy engine.
+
+``lz77_resolve_pallas`` — the fused device half of the two-phase inflate
+(tpu/inflate.py): one grid row per BGZF block, token rows in VMEM,
+pointer-doubling with an **in-kernel early exit** the moment every chain
+has reached its root literal (``lax.while_loop``; worst case
+log2(64 Ki) = 16 rounds, typical BAM blocks converge in a handful).
+Unlike the flag kernels this one keeps the per-row ``take_along_axis`` —
+the indices stay inside the 64 Ki block row, but Mosaic may still refuse
+the gather on some TPU generations, so the inflate dispatcher treats any
+lowering failure as a demotion to the (identical-math, also early-exit)
+XLA resolve and logs once. Parity is pinned in interpret mode.
 """
 
 from __future__ import annotations
@@ -201,6 +212,69 @@ def _full_flags_kernel(p_hbm, lengths_ref, nc_ref, n_ref, out_ref, slab, sem):
     F = jnp.where(few_fixed, _I32(BIT["tooFewFixedBlockBytes"]), F)
 
     out_ref[...] = F
+
+
+# ----------------------------------------------------- fused LZ77 kernel
+
+# Token-row width: one BGZF block inflates to ≤ 64 KiB (bgzf/block.py
+# MAX_BLOCK_SIZE); keep the constant local to avoid a tpu/inflate.py cycle.
+from spark_bam_tpu.bgzf.block import MAX_BLOCK_SIZE as _LZ_STRIDE  # noqa: E402
+
+_LZ_ROUNDS = (_LZ_STRIDE - 1).bit_length()
+
+
+def _lz77_kernel(lit_ref, dist_ref, out_ref, rounds_ref):
+    dist = dist_ref[...].astype(_I32)                       # (1, S)
+    iota = lax.broadcasted_iota(_I32, dist.shape, 1)
+    parent = iota - dist                                    # dist=0 ⇒ self
+
+    def cond(state):
+        _, r, done = state
+        return jnp.logical_and(~done, r < _LZ_ROUNDS)
+
+    def body(state):
+        p, r, _ = state
+        nxt = jnp.take_along_axis(p, p, axis=1)
+        # Fixed point ⇔ every pointer already names a root (the only
+        # self-parents); one extra gather is the convergence test itself.
+        return nxt, r + _I32(1), jnp.all(nxt == p)
+
+    roots, r, _ = lax.while_loop(
+        cond, body, (parent, _I32(0), jnp.bool_(False))
+    )
+    out_ref[...] = jnp.take_along_axis(lit_ref[...], roots, axis=1)
+    rounds_ref[0, 0] = r
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def lz77_resolve_pallas(
+    lit: jnp.ndarray,   # (B, 64 Ki) uint8 literal plane
+    dist: jnp.ndarray,  # (B, 64 Ki) uint16 back-reference distances (0 = literal)
+    interpret: bool = False,
+):
+    """Resolve LZ77 chains for a batch of tokenized BGZF blocks in one
+    launch, early-exiting per block row. Returns ``(resolved (B, S) u8,
+    rounds () i32)`` — rounds is the batch max, comparable to the XLA
+    resolve's global round count."""
+    b, s = lit.shape
+    out, rounds = pl.pallas_call(
+        _lz77_kernel,
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((1, s), lambda i: (i, 0)),
+            pl.BlockSpec((1, s), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, s), lambda i: (i, 0)),
+            pl.BlockSpec((1, 1), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, s), jnp.uint8),
+            jax.ShapeDtypeStruct((b, 1), jnp.int32),
+        ],
+        interpret=interpret,
+    )(lit, dist)
+    return out, jnp.max(rounds)
 
 
 # --------------------------------------------------- funnel stage-0 kernel

@@ -41,10 +41,20 @@ window runs one fused kernel whose owned-span count reduces on-chip, the
 scalars accumulate on device, and a handful of integers cross the wire
 per ~2^30 positions (reference workload: count-reads,
 docs/benchmarks.md:53-59).
+
+When the device inflate is live (``Config.device_inflate`` /
+``fused_count``), ``count_reads`` goes one step further and runs the
+**fully device-resident** loop: the host ships only the packed LZ77
+token planes per window, and ``checker.count_window_tokens`` resolves +
+assembles + funnels + walks inside one XLA program — the inflated bytes
+never exist on host, the halo carry stays in HBM between windows, and
+only the count scalars cross back. Host tokenize of the next windows
+overlaps the device's current one via the same prefetch pool.
 """
 
 from __future__ import annotations
 
+import time
 from typing import Callable, Iterator
 
 import numpy as np
@@ -471,6 +481,13 @@ class StreamChecker:
         """
         if not self.use_device:
             return self._count_via_spans()
+        fused = self.config.fused_count
+        if fused is None:
+            fused = self.pipeline.device_copy
+        if fused:
+            res = self._count_reads_fused()
+            if res is not None:
+                return res
         total = 0
         dev_total = None
         dev_esc = None
@@ -547,6 +564,178 @@ class StreamChecker:
                 self.progress = saved
         return total
 
+    def _count_reads_fused(self) -> int | None:
+        """The fully device-resident count loop: packed tokens in, scalars
+        out, carry chained in HBM.
+
+        Per window group, the host runs only the entropy phase
+        (read + tokenize + pack, prefetched ``pipeline.depth`` groups
+        ahead on worker threads) and ships ONE packed u8 buffer;
+        ``checker.count_window_tokens`` does LZ77 resolve → window
+        assembly → funnel/deep check → chain walk in one XLA program, with
+        the (halo,) carry fed device-to-device between windows — the
+        serial carry dependency chains the kernels in the device stream
+        while the host tokenizes ahead, so neither side idles. Pacing,
+        flush, and escape checkpoints mirror ``count_reads``.
+
+        Returns None to demote to the classic (host-inflate) streaming
+        loop: tokenizer unavailable, a stream it rejects, or a window
+        group that cannot fit the kernel geometry. Nothing is consumed
+        from ``self.pipeline`` before demotion — the classic path restarts
+        cleanly. Escapes (chains beyond the halo) go to the exact spans
+        path, as everywhere.
+        """
+        from concurrent.futures import ThreadPoolExecutor
+
+        from spark_bam_tpu.native.build import load_native
+        from spark_bam_tpu.core.channel import open_channel
+        from spark_bam_tpu.tpu.checker import make_count_window_tokens
+        from spark_bam_tpu.tpu.inflate import tokenize_group
+
+        lib = load_native()
+        if lib is None or not hasattr(lib, "sbt_tokenize_deflate"):
+            return None
+        groups = self.pipeline.groups
+        if not groups:
+            return None
+        w = self.kernel_window
+        halo = self.halo
+        # Every window must fit the kernel: carry (≤ halo) + group bytes.
+        if max(
+            sum(m.uncompressed_size for m in g) for g in groups
+        ) + halo > w:
+            return None
+
+        kernel = make_count_window_tokens(
+            w, halo, self.config.reads_to_check,
+            flags_impl=self._flags_impl(),
+            funnel=self.config.funnel_enabled(),
+        )
+        funnel = self.config.funnel_enabled()
+        lens_dev, nc = self._device_inputs()
+
+        total = 0
+        dev_total = dev_esc = dev_surv = None
+        windows = 0
+        chunk = 0
+        screened = 0
+        flush_every = self.flush_every
+        escaped = False
+        demoted = False
+        ring: list = []
+        carry_dev = jnp.zeros(halo, dtype=jnp.uint8)
+        carry_len = 0
+        base = 0
+
+        ch = open_channel(self.path)
+        pool = ThreadPoolExecutor(max_workers=self.pipeline.depth)
+        try:
+            pending = [
+                pool.submit(tokenize_group, ch, g)
+                for g in groups[: self.pipeline.depth]
+            ]
+            for gi in range(len(groups)):
+                fut = pending.pop(0)
+                t0 = time.perf_counter()
+                try:
+                    tp = fut.result()
+                except Exception:
+                    # A stream the tokenizer rejects (or a footer
+                    # disagreement): demote the whole count to the host-
+                    # inflate loop — correctness never depends on phase 1.
+                    demoted = True
+                    break
+                wait_ms = (time.perf_counter() - t0) * 1e3
+                obs.observe("inflate.stall_ms", wait_ms, unit="ms")
+                if wait_ms > 1.0:
+                    obs.count("inflate.stalls")
+                if tp is None:
+                    demoted = True
+                    break
+                nxt = gi + self.pipeline.depth
+                if nxt < len(groups):
+                    pending.append(
+                        pool.submit(tokenize_group, ch, groups[nxt])
+                    )
+                packed, out_lens, _b = tp
+                n = carry_len + int(out_lens.sum())
+                at_eof = gi == len(groups) - 1
+                own_end = n if at_eof else max(n - halo, 0)
+                lo = min(max(self.header_end_abs - base, 0), own_end)
+                obs.count("inflate.h2d_bytes", int(packed.nbytes))
+                out = kernel(
+                    jnp.asarray(packed),
+                    jnp.asarray(out_lens.astype(np.int32)),
+                    carry_dev, lens_dev, nc,
+                    jnp.int32(carry_len), jnp.int32(n),
+                    jnp.bool_(at_eof), jnp.int32(lo), jnp.int32(own_end),
+                )
+                carry_dev = out["carry"]
+                carry_len = n - own_end
+                base += own_end
+                if obs.enabled():
+                    obs.observe(
+                        "inflate.rounds", int(out["rounds"]), unit="rounds"
+                    )
+                    obs.count("inflate.device_windows")
+                dev_total = (
+                    out["count"] if dev_total is None
+                    else dev_total + out["count"]
+                )
+                dev_esc = (
+                    out["esc_count"] if dev_esc is None
+                    else dev_esc + out["esc_count"]
+                )
+                dev_surv = (
+                    out["survivors"] if dev_surv is None
+                    else dev_surv + out["survivors"]
+                )
+                screened += n
+                ring.append(out["count"])
+                if len(ring) > self.ring_depth:
+                    ring.pop(0).block_until_ready()
+                windows += 1
+                chunk += 1
+                obs.count("check.windows")
+                obs.count("check.positions", own_end)
+                if self.progress is not None:
+                    self.progress(windows, base, self.total)
+                # Same escape-checkpoint policy as count_reads: one early
+                # sync at window 4, then flush-aligned.
+                if windows == 4 and int(dev_esc):
+                    escaped = True
+                    break
+                if chunk >= flush_every:
+                    if int(dev_esc):
+                        escaped = True
+                        break
+                    total += int(dev_total)
+                    if funnel:
+                        self._funnel_add(screened, int(dev_surv))
+                    dev_total = dev_esc = dev_surv = None
+                    chunk = 0
+                    screened = 0
+        finally:
+            pool.shutdown(wait=True, cancel_futures=True)
+            ch.close()
+        if demoted:
+            return None
+        if not escaped and dev_total is not None:
+            if int(dev_esc):
+                escaped = True
+            else:
+                total += int(dev_total)
+                if funnel:
+                    self._funnel_add(screened, int(dev_surv))
+        if escaped:
+            obs.count("check.count_escape_retries")
+            saved, self.progress = self.progress, None
+            try:
+                return self._count_via_spans()
+            finally:
+                self.progress = saved
+        return total
+
     def count_reads_resident(
         self, chunk_windows: int | None = None,
         first_chunk_windows: int = 4,
@@ -577,12 +766,19 @@ class StreamChecker:
         from spark_bam_tpu.tpu.checker import PAD, make_count_scan
 
         w = self.kernel_window
-        # ≤ 1 GiB of chunk bytes at the PACKED stride (w+PAD): keeps the
-        # int32 ``starts`` offsets < 2^30 even after pow2 bucketing (the
-        # bucket can double a non-pow2 row count), and per-chunk positions
-        # < 2^31 for the on-device sums. Floor-pow2 so the bucket never
-        # exceeds the cap.
-        max_windows = max(1, (1 << 30) // (w + PAD))
+        # Chunk bytes at the PACKED stride (w+PAD) are capped by
+        # ``Config.resident_chunk_bytes`` (≤ 1 GiB): the 1 GiB ceiling keeps
+        # the int32 ``starts`` offsets < 2^30 even after pow2 bucketing (the
+        # bucket can double a non-pow2 row count) and per-chunk positions
+        # < 2^31 for the on-device sums; the config default (256 MiB) also
+        # leaves HBM headroom for the scan body's intermediates — BENCH_r05's
+        # resident leg OOM-crashed the TPU worker with 1 GiB chunks in
+        # flight ×2 plus the window intermediates. Floor-pow2 so the bucket
+        # never exceeds the cap.
+        cap_bytes = min(
+            1 << 30, max(self.config.resident_chunk_bytes, w + PAD)
+        )
+        max_windows = max(1, cap_bytes // (w + PAD))
         max_windows = 1 << (max_windows.bit_length() - 1)
         if chunk_windows is None:
             chunk_windows = max_windows

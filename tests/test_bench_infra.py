@@ -221,3 +221,57 @@ def test_history_append(tmp_path, monkeypatch, capsys):
     entry = json.loads(hist[0])
     assert entry["error"] == "fixture unavailable"
     assert "ts" in entry
+
+
+def test_ladder_skips_when_probe_dead(monkeypatch):
+    """A probe that never reaches backend_ok must skip the whole window
+    ladder with one clear warning — not burn an init timeout per rung
+    (the r05 window=32MB/16MB double-burn)."""
+    calls = []
+
+    def fake_child(args, timeout_s):
+        calls.append(args)
+        assert args == ["--child-probe"]
+        return {}, ["start"], "timed out after 240s (last stage: start)"
+
+    monkeypatch.setattr(bench, "_run_child", fake_child)
+    results, stages, errors = bench._device_ladder("big.bam", 1, "q.bam", 1)
+    assert results == {}
+    assert len(calls) == 1  # probe only, no --child-all rungs
+    assert any("skipping device window ladder" in e for e in errors)
+
+
+def test_ladder_proceeds_past_healthy_probe(monkeypatch):
+    calls = []
+
+    def fake_child(args, timeout_s):
+        calls.append(args)
+        if args == ["--child-probe"]:
+            return (
+                {"probe": {"backend": "tpu"}},
+                ["start", "backend_ok:tpu"], None,
+            )
+        return {"steady": {"pps": 1.0}}, ["start", "backend_ok:tpu"], None
+
+    monkeypatch.setattr(bench, "_run_child", fake_child)
+    results, _, errors = bench._device_ladder("big.bam", 1, "q.bam", 1)
+    assert "steady" in results
+    assert calls[0] == ["--child-probe"]
+    assert calls[1][0] == "--child-all"
+    assert not errors
+
+
+def test_ladder_probe_disabled_by_env(monkeypatch):
+    """SB_BENCH_PROBE_S=0 removes the gate (escape hatch if the probe
+    itself ever misbehaves)."""
+    monkeypatch.setenv("SB_BENCH_PROBE_S", "0")
+    calls = []
+
+    def fake_child(args, timeout_s):
+        calls.append(args)
+        return {"steady": {"pps": 1.0}}, ["start", "backend_ok:tpu"], None
+
+    monkeypatch.setattr(bench, "_run_child", fake_child)
+    results, _, _ = bench._device_ladder("big.bam", 1, "q.bam", 1)
+    assert "steady" in results
+    assert calls[0][0] == "--child-all"

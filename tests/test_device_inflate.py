@@ -208,6 +208,151 @@ def test_whole_bam_matches_host_inflate(bam2):
     assert dev.at_eof
 
 
+def test_resolve_early_exit_rounds():
+    """The early-exit resolve reports rounds-to-convergence: a literal-only
+    batch costs exactly one gather (the convergence test itself), a
+    block-spanning distance-1 run needs the full log2(64 Ki) doubling."""
+    from spark_bam_tpu.tpu.inflate import _DOUBLING_ROUNDS, resolve_lz77
+
+    data = b"a" * (STRIDE - 1)
+    comp = np.frombuffer(_deflate(data), dtype=np.uint8)
+    lit, dist, _ = tokenize_deflate_native(
+        comp, np.array([0], dtype=np.int64),
+        np.array([len(comp)], dtype=np.int64), stride=STRIDE,
+    )
+    deep, rounds_deep = resolve_lz77(lit, dist)
+    assert bytes(np.asarray(deep)[0, : len(data)]) == data
+    assert int(rounds_deep) == _DOUBLING_ROUNDS == 16
+
+    lits_only, rounds_lit = resolve_lz77(lit, np.zeros_like(dist))
+    assert np.array_equal(np.asarray(lits_only), np.asarray(lit))
+    assert int(rounds_lit) == 1
+
+
+def test_pack_unpack_roundtrip():
+    """The packed single-buffer H2D layout must resolve identically to the
+    two-array path (and the u16 dist plane must survive the bitcast)."""
+    from spark_bam_tpu.tpu.inflate import (
+        _resolve_packed, pack_tokens, resolve_lz77,
+    )
+
+    rng = np.random.default_rng(7)
+    datas = [b"ab" * 20_000, rng.integers(0, 256, 5_000, dtype=np.uint8).tobytes()]
+    comps = [np.frombuffer(_deflate(d), dtype=np.uint8) for d in datas]
+    offsets = np.zeros(len(comps), dtype=np.int64)
+    lengths = np.array([len(c) for c in comps], dtype=np.int64)
+    np.cumsum(lengths[:-1], out=offsets[1:])
+    lit, dist, _ = tokenize_deflate_native(
+        np.concatenate(comps), offsets, lengths, stride=STRIDE,
+    )
+    want, rounds_a = resolve_lz77(lit, dist)
+    got, rounds_b = _resolve_packed(pack_tokens(lit, dist))
+    assert np.array_equal(np.asarray(got), np.asarray(want))
+    assert int(rounds_a) == int(rounds_b)
+
+
+def test_pallas_lz77_parity():
+    """The fused Pallas kernel (interpret mode on this backend) must agree
+    with the XLA resolve bit-for-bit, early exit included."""
+    import jax.numpy as jnp
+
+    from spark_bam_tpu.tpu.inflate import resolve_lz77
+    from spark_bam_tpu.tpu.pallas_kernels import lz77_resolve_pallas
+
+    rng = np.random.default_rng(8)
+    datas = [
+        b"a" * (STRIDE - 1),             # max-depth distance-1 chain
+        b"xy" * 10_000,                  # distance-2 overlaps
+        rng.integers(0, 256, 30_000, dtype=np.uint8).tobytes(),
+        b"hello world " * 400,
+    ]
+    comps = [np.frombuffer(_deflate(d), dtype=np.uint8) for d in datas]
+    offsets = np.zeros(len(comps), dtype=np.int64)
+    lengths = np.array([len(c) for c in comps], dtype=np.int64)
+    np.cumsum(lengths[:-1], out=offsets[1:])
+    lit, dist, _ = tokenize_deflate_native(
+        np.concatenate(comps), offsets, lengths, stride=STRIDE,
+    )
+    want, rounds_xla = resolve_lz77(lit, dist)
+    got, rounds_pl = lz77_resolve_pallas(
+        jnp.asarray(lit), jnp.asarray(dist), interpret=True
+    )
+    assert np.array_equal(np.asarray(got), np.asarray(want))
+    assert int(rounds_pl) == int(rounds_xla)
+
+
+@pytest.mark.parametrize("distance", [1, 2, 3, 7])
+def test_overlapping_copy_distances(distance):
+    """Overlapping copies at tiny distances (copy source overlaps its own
+    destination — the serial-inflate special case) across a near-block-
+    sized run."""
+    motif = bytes(range(65, 65 + distance))
+    reps = (STRIDE - 1) // distance
+    _roundtrip_one(motif * reps)
+
+
+def test_zero_length_final_block():
+    """A batch whose FINAL block inflates to zero bytes (BGZF writers emit
+    empty blocks mid-stream and the EOF sentinel is one): the zero-length
+    row must occupy no output range."""
+    datas = [b"payload " * 512, b"tail", b""]
+    comps = [np.frombuffer(_deflate(d), dtype=np.uint8) for d in datas]
+    offsets = np.zeros(len(comps), dtype=np.int64)
+    lengths = np.array([len(c) for c in comps], dtype=np.int64)
+    np.cumsum(lengths[:-1], out=offsets[1:])
+    out = inflate_blocks_device(
+        np.concatenate(comps), offsets, lengths,
+        np.array([len(d) for d in datas], dtype=np.int64),
+    )
+    assert out.tobytes() == b"".join(datas)
+
+
+def test_fuzz_mutant_corpus_never_wrong_bytes():
+    """fuzz-decode's structure-aware mutator over compressed payloads:
+    whatever a mutant does, the device inflate must return bytes identical
+    to host zlib's decode or raise cleanly — NEVER wrong bytes. (The
+    out_lengths footer is the original's, so mutants that change the
+    decoded size must be rejected by the size check.)"""
+    from spark_bam_tpu.tools.fuzz_decode import _Rng, _mutate
+
+    rng = np.random.default_rng(9)
+    bases = [
+        b"the quick brown fox " * 200,
+        rng.integers(0, 256, 8_000, dtype=np.uint8).tobytes(),
+        b"z" * 50_000,
+    ]
+    checked = 0
+    agreed = 0
+    for bi, data in enumerate(bases):
+        comp = _deflate(data)
+        for i in range(60):
+            r = _Rng(1000 * bi + i)
+            mutant = _mutate(comp, r.below(len(comp)), r)
+            try:
+                host = zlib.decompress(mutant, -15)
+            except zlib.error:
+                host = None
+            try:
+                out = inflate_blocks_device(
+                    np.frombuffer(mutant, dtype=np.uint8),
+                    np.array([0], dtype=np.int64),
+                    np.array([len(mutant)], dtype=np.int64),
+                    np.array([len(data)], dtype=np.int64),
+                )
+            except (IOError, ValueError):
+                out = "rejected"
+            checked += 1
+            if isinstance(out, np.ndarray):
+                # Device accepted: zlib must agree byte-for-byte.
+                assert host is not None and out.tobytes() == host, (
+                    f"device inflate returned wrong bytes for mutant "
+                    f"base={bi} i={i}"
+                )
+                agreed += 1
+    assert checked == 180
+    assert agreed > 0  # identity/benign mutants must flow through
+
+
 def test_count_reads_with_device_inflate_config(bam1):
     """spark.bam.device.inflate=true must flow through the config surface
     into the streaming pipeline and still count exactly."""

@@ -1,0 +1,106 @@
+"""Regression coverage for the resident-mode worker crash (BENCH_r05
+``e2e_resident_error``): ``count_reads_resident`` must complete and match
+the streaming count — in-process on the CPU backend for tier-1, and
+through the exact ``bench.py --child-resident … cpu`` child the bench
+harness spawns, so the crash is reproducible in-harness rather than only
+on a live TPU."""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from spark_bam_tpu.core.config import Config
+from spark_bam_tpu.native.build import load_native
+from spark_bam_tpu.tpu.stream_check import StreamChecker
+
+from tests.bam_factories import random_bam
+
+pytestmark = pytest.mark.skipif(
+    load_native() is None, reason="native runtime unavailable"
+)
+
+CFG = dict(window_uncompressed=128 << 10, halo=32 << 10)
+
+BENCH = Path(__file__).resolve().parent.parent / "bench.py"
+
+
+def _streaming_count(path, **cfg):
+    return StreamChecker(
+        path, Config(device_inflate=False, fused_count=False), **cfg
+    ).count_reads()
+
+
+def test_resident_matches_streaming_in_process(tmp_path):
+    path = tmp_path / "r.bam"
+    random_bam(path, 21, contigs=(("chr1", 5_000_000),), dup_rate=0.05)
+    want = _streaming_count(path, **CFG)
+    got = StreamChecker(path, Config(), **CFG).count_reads_resident(
+        chunk_windows=4, first_chunk_windows=2
+    )
+    assert got == want
+
+
+def test_resident_tiny_chunk_cap_still_exact(tmp_path):
+    """A pathologically small ``resident_chunk_bytes`` (the r05 OOM fix
+    knob at its floor) degrades chunk size, never correctness."""
+    path = tmp_path / "tiny.bam"
+    random_bam(path, 22, contigs=(("chr1", 5_000_000),), dup_rate=0.05)
+    want = _streaming_count(path, **CFG)
+    got = StreamChecker(
+        path, Config(resident_chunk_bytes=1), **CFG
+    ).count_reads_resident(chunk_windows=256)
+    assert got == want
+
+
+def _parse_protocol(out: str):
+    stages, results = [], {}
+    for line in out.splitlines():
+        if line.startswith("##STAGE "):
+            stages.append(line[len("##STAGE "):].strip())
+        elif line.startswith("##RESULT "):
+            payload = json.loads(line[len("##RESULT "):])
+            results[payload.pop("leg")] = payload
+    return stages, results
+
+
+def test_bench_child_resident_cpu_completes(tmp_path):
+    """The harness child itself: ``--child-resident <mb> <bam> <reads> <cw>
+    cpu`` must emit an ``e2e_resident`` RESULT with ``count_ok`` true and
+    no ``e2e_resident_error`` stage."""
+    path = tmp_path / "child.bam"
+    random_bam(path, 23, contigs=(("chr1", 5_000_000),), dup_rate=0.05)
+    reads = _streaming_count(path, **CFG)
+    proc = subprocess.run(
+        [sys.executable, str(BENCH), "--child-resident", "8", str(path),
+         str(reads), "4", "cpu"],
+        capture_output=True, text=True, timeout=570,
+    )
+    stages, results = _parse_protocol(proc.stdout)
+    errors = [s for s in stages if s.startswith("e2e_resident_error")]
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert not errors, errors
+    assert any(s.startswith("backend_ok:cpu") for s in stages), stages
+    assert "e2e_resident" in results, (stages, proc.stdout[-2000:])
+    leg = results["e2e_resident"]
+    assert leg["count_ok"] is True, leg
+    assert leg["boundaries"] == reads
+
+
+def test_bench_child_resident_unrequested_cpu_skips(tmp_path):
+    """Without the explicit cpu platform arg, a CPU backend still skips
+    the device leg (it is a device benchmark) — but cleanly, via a
+    RESULT line, not a silent empty child."""
+    path = tmp_path / "skip.bam"
+    random_bam(path, 24, contigs=(("chr1", 1_000_000),))
+    proc = subprocess.run(
+        [sys.executable, str(BENCH), "--child-resident", "8", str(path), "1"],
+        capture_output=True, text=True, timeout=570,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    _, results = _parse_protocol(proc.stdout)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert results.get("resident_child", {}).get("skipped") is True, results
