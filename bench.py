@@ -50,6 +50,7 @@ import subprocess
 import sys
 import tempfile
 import time
+from contextlib import contextmanager
 from pathlib import Path
 
 import numpy as np
@@ -84,6 +85,10 @@ E2E_TARGET_BYTES = int(os.environ.get("SB_BENCH_E2E_BYTES", str(1 << 30)))
 # degraded-tunnel window (~10 s/window regime ⇒ ~8 windows ≈ 80 s), big
 # enough to be a real whole-file streaming workload.
 QUICK_E2E_BYTES = int(os.environ.get("SB_BENCH_QUICK_BYTES", str(64 << 20)))
+# The remote-latency A/B streams this much through the fakestore twice
+# (legacy + plan); sized so the plan path's fixed per-file costs are noise
+# against the steady-state rates, without the leg dominating the bench.
+REMOTE_E2E_BYTES = int(os.environ.get("SB_BENCH_REMOTE_BYTES", str(192 << 20)))
 # CPU e2e baseline is measured on a capped prefix and reported as a rate
 # (the full file at CPU rates would dominate the bench's wall-clock).
 CPU_E2E_CAP_BYTES = 256 << 20
@@ -1295,42 +1300,215 @@ def baselines(flat, lengths, n_python: int = 40_000):
     return python_pps, native_pps
 
 
+@contextmanager
+def _env_patch(**kv):
+    """Temporarily set/unset env vars (None = unset)."""
+    old = {k: os.environ.get(k) for k in kv}
+    try:
+        for k, v in kv.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        yield
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 def remote_latency_leg(path: str, latency_s: float = 0.1):
-    """The founding-problem regime, measured: stream ``path`` through the
-    production inflate pipeline over a ``gs://`` URL served by an
-    in-process object store with ``latency_s`` injected per request
+    """The founding-problem regime, measured over a ``gs://`` URL served
+    by an in-process object store with ``latency_s`` injected per request
     (reference docs/benchmarks.md runs everything on GCS; ComputeSplits
-    tunes ``fs.gs.io.buffersize`` for exactly this). Reports effective
-    bytes/s and the latency-hiding factor vs the serial floor
-    (requests × RTT). Host-side only — no device involvement."""
+    tunes ``fs.gs.io.buffersize`` for exactly this). Two measurements:
+
+    - **Data-plane A/B** (``remote_plan_speedup``, ``…latency_hiding``):
+      byte-identical sequential drains at the channel seam — the legacy
+      cursor-relative ``PrefetchChannel`` (``mode=legacy``) vs the
+      plan-driven ``PlannedChannel`` fed the ``.sbi`` block table. This
+      isolates the thing the data plane changed: request scheduling.
+      (An end-to-end A/B would understate it — inflate is serial per
+      process, so on few-core hosts the decode floor dominates the fast
+      side's wall while hiding inside the slow side's stalls.)
+    - **Pipeline end-to-end** (``remote_gs_Bps``, ``…uncompressed_Bps``):
+      the production ``InflatePipeline`` over the plan path with a warm
+      ``.sbi`` (an untimed warm-up pass builds it, as a fleet's first
+      member would) — comparable with earlier rounds' ``remote_gs_Bps``.
+
+    Host-side only — no device involvement."""
     from spark_bam_tpu.benchmarks.fakestore import FakeObjectStore
+    from spark_bam_tpu.core.channel import open_channel
+    from spark_bam_tpu.core.remote_plan import set_remote_config
+    from spark_bam_tpu.tpu.inflate import InflatePipeline
 
     data = Path(path).read_bytes()
-    old = os.environ.get("SPARK_BAM_GS_ENDPOINT")
-    with FakeObjectStore(data, key="remote.bam", latency_s=latency_s) as srv:
-        os.environ["SPARK_BAM_GS_ENDPOINT"] = srv.url_base
-        try:
-            from spark_bam_tpu.tpu.inflate import InflatePipeline
+    url = "gs://bench/remote.bam"
+    step = 1 << 20
 
-            url = "gs://bench/remote.bam"
+    def drain_bytes(plan=None) -> float:
+        ch = open_channel(url)
+        try:
+            if plan is not None and hasattr(ch, "set_plan"):
+                ch.set_plan(plan)
             t0 = time.perf_counter()
-            done = 0
-            for view in InflatePipeline(url, window_uncompressed=32 << 20):
-                done += view.size
+            got = 0
+            for pos in range(0, len(data), step):
+                got += len(ch.read_at(pos, step))
             wall = time.perf_counter() - t0
-            serial_floor = srv.stats["requests"] * latency_s
-            return {
-                "remote_gs_Bps": round(len(data) / wall),
-                "remote_gs_uncompressed_Bps": round(done / wall),
-                "remote_gs_requests": srv.stats["requests"],
-                "remote_gs_rtt_ms": round(latency_s * 1000),
-                "remote_gs_latency_hiding": round(serial_floor / wall, 2),
-            }
         finally:
-            if old is None:
-                os.environ.pop("SPARK_BAM_GS_ENDPOINT", None)
-            else:
-                os.environ["SPARK_BAM_GS_ENDPOINT"] = old
+            ch.close()
+        if got != len(data):
+            raise RuntimeError(f"drained {got} != {len(data)}")
+        return wall
+
+    def drain_pipeline() -> tuple[float, int]:
+        t0 = time.perf_counter()
+        done = 0
+        for view in InflatePipeline(url, window_uncompressed=32 << 20):
+            done += view.size
+        return time.perf_counter() - t0, done
+
+    with FakeObjectStore(data, key="remote.bam", latency_s=latency_s) as srv, \
+            tempfile.TemporaryDirectory() as cache_dir, \
+            _env_patch(
+                SPARK_BAM_GS_ENDPOINT=srv.url_base,
+                SPARK_BAM_CACHE_DIR=cache_dir,
+                SPARK_BAM_CACHE=None,
+            ):
+        # -- legacy drain: PrefetchChannel, no cache tier -----------------
+        set_remote_config("mode=legacy")
+        try:
+            legacy_wall = drain_bytes()
+        finally:
+            set_remote_config(None)
+        with _env_patch(SPARK_BAM_CACHE="readwrite"):
+            from spark_bam_tpu.bgzf.index_blocks import blocks_metadata
+
+            # Warm the .sbi block table (untimed), as a fleet's first
+            # member would; re-reading it afterwards is cache-tier cheap.
+            metas = blocks_metadata(url)
+            # -- plan drain: same bytes, scheduled from the block table --
+            req0 = srv.stats["requests"]
+            plan_wall = drain_bytes(
+                plan=[(m.start, m.start + m.compressed_size) for m in metas]
+            )
+            requests = srv.stats["requests"] - req0
+            # -- pipeline end-to-end over the plan path ------------------
+            e2e_wall, done = drain_pipeline()
+        serial_floor = requests * latency_s
+        return {
+            "remote_gs_Bps": round(len(data) / e2e_wall),
+            "remote_gs_uncompressed_Bps": round(done / e2e_wall),
+            "remote_gs_legacy_Bps": round(len(data) / legacy_wall),
+            "remote_plan_Bps": round(len(data) / plan_wall),
+            "remote_plan_speedup": round(legacy_wall / plan_wall, 2),
+            "remote_gs_requests": requests,
+            "remote_gs_rtt_ms": round(latency_s * 1000),
+            "remote_gs_latency_hiding": round(serial_floor / plan_wall, 2),
+        }
+
+
+def remote_depth_ladder_leg(
+    latency_s: float = 0.1, bandwidth_Bps: float = 80 << 20,
+    size: int = 16 << 20,
+):
+    """Throughput vs fixed prefetch depth on a latency+bandwidth-modeled
+    store: a raw sequential drain of a ``size``-byte object through
+    ``open_channel`` at pinned depths. The curve should climb with depth
+    (latency-bound: each extra in-flight request hides another RTT) until
+    the shared pipe saturates (bandwidth-bound) — the knee is the BDP the
+    adaptive mode converges to on its own."""
+    from spark_bam_tpu.benchmarks.fakestore import FakeObjectStore
+    from spark_bam_tpu.core.channel import open_channel
+    from spark_bam_tpu.core.remote_plan import set_remote_config
+
+    data = bytes((i * 131 + (i >> 9)) & 0xFF for i in range(size))
+    step = 512 << 10
+    ladder = {}
+    with FakeObjectStore(
+        data, key="ladder.bin", latency_s=latency_s,
+        bandwidth_Bps=bandwidth_Bps,
+    ) as srv, _env_patch(SPARK_BAM_GS_ENDPOINT=srv.url_base):
+        for depth in (1, 2, 4, 8, 16, 32):
+            set_remote_config(f"depth={depth},request=512KB")
+            try:
+                ch = open_channel("gs://bench/ladder.bin")
+                t0 = time.perf_counter()
+                got = 0
+                for pos in range(0, size, step):
+                    got += len(ch.read_at(pos, step))
+                wall = time.perf_counter() - t0
+                ch.close()
+            finally:
+                set_remote_config(None)
+            if got != size:
+                raise RuntimeError(f"depth {depth}: drained {got} != {size}")
+            ladder[str(depth)] = round(size / wall)
+    return {
+        "remote_depth_ladder": ladder,
+        "remote_depth_ladder_rtt_ms": round(latency_s * 1000),
+        "remote_depth_ladder_bandwidth_Bps": round(bandwidth_Bps),
+    }
+
+
+def fleet_leg(
+    n_files: int = 64, file_bytes: int = 1 << 20, latency_s: float = 0.05,
+):
+    """Fleet mode, measured: ``n_files`` synthetic BAMs behind one
+    latency-injected store, all streamed concurrently through the
+    resilient executor (one partition per file, bounded backlog) with the
+    data plane's shared connection pool + in-flight GET quota
+    (core/remote_plan.py). Reports aggregate bytes/s across the fleet."""
+    from spark_bam_tpu.benchmarks.fakestore import FakeObjectStore
+    from spark_bam_tpu.benchmarks.synth import synth_bam
+    from spark_bam_tpu.core.channel import open_channel
+    from spark_bam_tpu.parallel.executor import ParallelConfig, run_partitions
+
+    with tempfile.TemporaryDirectory() as tmp:
+        bam = Path(tmp) / "fleet.bam"
+        synth_bam(bam, file_bytes)
+        data = bam.read_bytes()
+    objects = {f"f{i}.bam": data for i in range(n_files)}
+
+    def drain(url: str) -> int:
+        ch = open_channel(url)
+        try:
+            got = 0
+            step = 512 << 10
+            pos = 0
+            while True:
+                piece = ch.read_at(pos, step)
+                if not piece:
+                    return got
+                got += len(piece)
+                pos += len(piece)
+        finally:
+            ch.close()
+
+    with FakeObjectStore(
+        objects=objects, latency_s=latency_s
+    ) as srv, _env_patch(SPARK_BAM_GS_ENDPOINT=srv.url_base):
+        urls = [f"gs://bench/f{i}.bam" for i in range(n_files)]
+        t0 = time.perf_counter()
+        sizes, _ = run_partitions(
+            drain, urls, ParallelConfig("threads", workers=16)
+        )
+        wall = time.perf_counter() - t0
+        total = sum(sizes)
+        if total != n_files * len(data):
+            raise RuntimeError(
+                f"fleet drained {total} != {n_files * len(data)}"
+            )
+        return {
+            "fleet_Bps": round(total / wall),
+            "fleet_files": n_files,
+            "fleet_bytes": total,
+            "fleet_requests": srv.stats["requests"],
+            "fleet_rtt_ms": round(latency_s * 1000),
+        }
 
 
 def split_resolution_leg(split_size: int = 2 << 20):
@@ -1972,11 +2150,28 @@ def _main_measure(record, warnings, errors):
         record["device_inflate_vs_host"] = dinf["device_vs_host"]
         record["device_inflate_equal"] = dinf["equal"]
     # --- remote-latency leg (host-side; the GCS founding-problem number) --
-    if quick_path:
-        try:
-            record.update(remote_latency_leg(quick_path))
-        except Exception as e:
-            warnings.append(f"remote latency leg: {type(e).__name__}: {e}")
+    # Dedicated ≥REMOTE_E2E_BYTES file: the plan path's fixed costs (the
+    # .sbi freshness probe, the first prefetch fill) amortize with size,
+    # so the quick 64 MB file understates the steady-state A/B.
+    try:
+        from spark_bam_tpu.benchmarks.synth import ensure_big_bam as _ebb
+
+        rp, _ = _ebb(REMOTE_E2E_BYTES)
+        record.update(remote_latency_leg(str(rp)))
+    except Exception as e:
+        warnings.append(f"remote latency leg: {type(e).__name__}: {e}")
+    # Throughput vs pinned prefetch depth on a latency+bandwidth-modeled
+    # store (host-side; the adaptive mode's convergence target).
+    try:
+        record.update(remote_depth_ladder_leg())
+    except Exception as e:
+        warnings.append(f"remote depth ladder: {type(e).__name__}: {e}")
+    # Fleet mode: 64 BAMs drained concurrently through the executor with
+    # the shared remote pool/quota (host-side; aggregate throughput).
+    try:
+        record.update(fleet_leg())
+    except Exception as e:
+        warnings.append(f"fleet leg: {type(e).__name__}: {e}")
     # Load-path split resolution A/B (host-side, self-contained fixture,
     # sampled-equality gated).
     try:

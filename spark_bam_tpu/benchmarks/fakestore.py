@@ -1,14 +1,35 @@
-"""In-process fake object store: ranged GETs + injected latency.
+"""In-process fake object store: ranged GETs + injected pathologies.
 
 One implementation for every consumer that needs a stand-in GCS/S3/HTTP
-origin — the bench's remote-latency leg and the cloud/remote test suites —
-so Range-handling fixes land once. Serves a single object at any path
-ending in the registered key; everything else 404s (sidecar probes must
-read as absent)."""
+origin — the bench's remote legs and the cloud/remote test suites — so
+Range-handling fixes land once. Serves one object (``data``/``key``) or
+many (``objects``) at any path ending in a registered key; everything else
+404s (sidecar probes must read as absent).
+
+Beyond base ``latency_s``, the store models the failure modes the remote
+data plane (core/remote_plan.py) is built to absorb, all **seeded and
+offline** so hedging/adaptive-depth tests are deterministic without a
+network:
+
+- ``jitter_s``: uniform per-request latency jitter on top of the base.
+- ``straggler_rate``/``straggler_factor``: a seeded fraction of requests
+  take ``factor``× the base latency — the tail hedged GETs must cut.
+- ``throttle_rate``/``retry_after_s``: a seeded fraction answer
+  429 + ``Retry-After`` (object-store throttling storms).
+- ``bandwidth_Bps``: a shared-pipe bandwidth model — concurrent responses
+  serialize through one token bucket, so throughput stops scaling with
+  request depth once the pipe saturates (the depth ladder's knee).
+- ``ignore_range``: answer 200 + full body despite a ``Range`` header
+  (the misbehaving-origin case ``HttpRangeChannel`` must reject).
+
+Per-request randomness comes from ``random.Random(seed ^ request_index)``
+— the same seed replays the same storm, mirroring the chaos harness
+(core/faults.py)."""
 
 from __future__ import annotations
 
 import http.server
+import random
 import threading
 import time
 
@@ -16,60 +37,145 @@ import time
 class FakeObjectStore:
     """``with FakeObjectStore(data, key="obj.bam", latency_s=0.1) as s:``
     exposes ``s.url_base`` (http://127.0.0.1:port) and live ``s.stats``
-    (``requests``, ``auth_failures``)."""
+    (``requests``, ``auth_failures``, ``stragglers``, ``throttles``)."""
 
     def __init__(
         self,
-        data: bytes,
+        data: bytes = b"",
         key: str = "remote.bam",
         latency_s: float = 0.0,
         require_bearer: str | None = None,
+        objects: "dict[str, bytes] | None" = None,
+        jitter_s: float = 0.0,
+        straggler_rate: float = 0.0,
+        straggler_factor: float = 10.0,
+        throttle_rate: float = 0.0,
+        retry_after_s: float = 0.05,
+        bandwidth_Bps: float | None = None,
+        seed: int = 0,
+        ignore_range: bool = False,
     ):
-        self.data = data
-        self.key = key
+        #: key → bytes; the single-object (data, key) form maps into it.
+        self.objects = dict(objects) if objects is not None else {key: data}
         self.latency_s = latency_s
         self.require_bearer = require_bearer
-        self.stats = {"requests": 0, "auth_failures": 0}
+        self.jitter_s = jitter_s
+        self.straggler_rate = straggler_rate
+        self.straggler_factor = straggler_factor
+        self.throttle_rate = throttle_rate
+        self.retry_after_s = retry_after_s
+        self.bandwidth_Bps = bandwidth_Bps
+        self.seed = seed
+        self.ignore_range = ignore_range
+        self.stats = {
+            "requests": 0, "auth_failures": 0,
+            "stragglers": 0, "throttles": 0,
+        }
+        self._lock = threading.Lock()
+        self._bw_free_at = 0.0  # shared-pipe model: when the pipe frees up
         store = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
-            def _empty(self, status: int):
+            # HTTP/1.1 keep-alive, like a real object-store front end.
+            # The default (HTTP/1.0, close-per-response) forces every GET
+            # through a fresh TCP connect; under deep prefetch bursts the
+            # listener backlog overflows and dropped SYNs retransmit after
+            # ~1 s, which reads as fake 10×-RTT stragglers.
+            protocol_version = "HTTP/1.1"
+
+            def _empty(self, status: int, headers: dict | None = None):
                 self.send_response(status)
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.send_header("Content-Length", "0")
                 self.end_headers()
 
-            def _gate(self) -> bool:
-                store.stats["requests"] += 1
-                if store.latency_s:
-                    time.sleep(store.latency_s)
-                if not self.path.endswith("/" + store.key):
+            def _object(self) -> bytes | None:
+                for key, data in store.objects.items():
+                    if self.path.endswith("/" + key):
+                        return data
+                return None
+
+            def _gate(self) -> bytes | None:
+                """Admission: accounting, latency model, 404/403/429.
+                Returns the object bytes, or None when a response was
+                already sent."""
+                with store._lock:
+                    store.stats["requests"] += 1
+                    idx = store.stats["requests"]
+                # Deterministic per-request pathology: same seed, same
+                # request ordinal → same jitter/straggler/throttle draw.
+                rng = random.Random((store.seed << 20) ^ idx)
+                wait = store.latency_s
+                if store.jitter_s:
+                    wait += rng.uniform(0.0, store.jitter_s)
+                if (
+                    store.straggler_rate
+                    and rng.random() < store.straggler_rate
+                ):
+                    with store._lock:
+                        store.stats["stragglers"] += 1
+                    wait *= store.straggler_factor
+                if wait:
+                    time.sleep(wait)
+                if (
+                    store.throttle_rate
+                    and rng.random() < store.throttle_rate
+                ):
+                    with store._lock:
+                        store.stats["throttles"] += 1
+                    self._empty(
+                        429, {"Retry-After": f"{store.retry_after_s:g}"}
+                    )
+                    return None
+                data = self._object()
+                if data is None:
                     self._empty(404)
-                    return False
+                    return None
                 if store.require_bearer is not None:
                     ok = (
                         self.headers.get("Authorization")
                         == f"Bearer {store.require_bearer}"
                     )
                     if not ok:
-                        store.stats["auth_failures"] += 1
+                        with store._lock:
+                            store.stats["auth_failures"] += 1
                         self._empty(403)
-                        return False
-                return True
+                        return None
+                return data
+
+            def _pipe(self, nbytes: int) -> None:
+                """Shared-bandwidth model: every response reserves pipe
+                time; concurrent transfers queue behind each other, so
+                aggregate throughput caps at ``bandwidth_Bps`` no matter
+                the request depth."""
+                if not store.bandwidth_Bps:
+                    return
+                cost = nbytes / store.bandwidth_Bps
+                with store._lock:
+                    now = time.monotonic()
+                    start = max(now, store._bw_free_at)
+                    store._bw_free_at = start + cost
+                    done_at = store._bw_free_at
+                delay = done_at - time.monotonic()
+                if delay > 0:
+                    time.sleep(delay)
 
             def do_HEAD(self):
-                if not self._gate():
+                data = self._gate()
+                if data is None:
                     return
                 self.send_response(200)
-                self.send_header("Content-Length", str(len(store.data)))
+                self.send_header("Content-Length", str(len(data)))
                 self.send_header("Accept-Ranges", "bytes")
                 self.end_headers()
 
             def do_GET(self):
-                if not self._gate():
+                data = self._gate()
+                if data is None:
                     return
-                data = store.data
                 rng = self.headers.get("Range")
-                if rng:
+                if rng and not store.ignore_range:
                     lo_s, _, hi_s = rng.split("=")[1].partition("-")
                     lo = int(lo_s)
                     # RFC 9110: an open-ended "bytes=lo-" runs to the end.
@@ -84,6 +190,7 @@ class FakeObjectStore:
                         self.end_headers()
                         return
                     body = data[lo:hi + 1]
+                    self._pipe(len(body))
                     self.send_response(206)
                     self.send_header(
                         "Content-Range",
@@ -91,6 +198,7 @@ class FakeObjectStore:
                     )
                 else:
                     body = data
+                    self._pipe(len(body))
                     self.send_response(200)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
@@ -101,6 +209,7 @@ class FakeObjectStore:
 
         class _Server(http.server.ThreadingHTTPServer):
             daemon_threads = True
+            request_queue_size = 128  # absorb depth-64 connect bursts
 
         self._srv = _Server(("127.0.0.1", 0), Handler)
         self.url_base = f"http://127.0.0.1:{self._srv.server_port}"
@@ -108,6 +217,15 @@ class FakeObjectStore:
             target=self._srv.serve_forever, daemon=True
         )
         self._thread.start()
+
+    @property
+    def data(self) -> bytes:
+        """Single-object back-compat accessor (first registered object)."""
+        return next(iter(self.objects.values()))
+
+    @property
+    def key(self) -> str:
+        return next(iter(self.objects))
 
     def close(self):
         self._srv.shutdown()

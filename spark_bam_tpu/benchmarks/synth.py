@@ -35,6 +35,60 @@ FIXTURE = Path("/root/reference/test_bams/src/main/resources/2.bam")
 FIXTURE_READS = 2500
 
 
+def synthetic_fixture(
+    cache_dir: Path = Path("/tmp/spark_bam_bench"), reads: int = 2500
+) -> Path:
+    """Deterministic in-package seed BAM for hosts without the reference
+    fixture assets: coordinate-sorted mapped reads over two contigs,
+    written with the package's own encoder. Cached across runs."""
+    cache_dir.mkdir(parents=True, exist_ok=True)
+    out = cache_dir / f"synthetic_fixture_{reads}.bam"
+    if out.exists():
+        return out
+    import random
+
+    from spark_bam_tpu.bam.header import BamHeader, ContigLengths
+    from spark_bam_tpu.bam.record import BamRecord
+    from spark_bam_tpu.bam.writer import write_bam
+    from spark_bam_tpu.core.pos import Pos
+
+    rng = random.Random(0x5BA17)
+    contigs = [("chr1", 248_956_422), ("chr2", 242_193_529)]
+    text = "@HD\tVN:1.6\tSO:coordinate\n" + "".join(
+        f"@SQ\tSN:{name}\tLN:{length}\n" for name, length in contigs
+    )
+    header = BamHeader(
+        ContigLengths(dict(enumerate(contigs))), Pos(0, 0), 0, text
+    )
+
+    def records():
+        per_contig = -(-reads // len(contigs))
+        i = 0
+        for ref_id in range(len(contigs)):
+            pos = 0
+            for _ in range(per_contig):
+                if i >= reads:
+                    return
+                pos += rng.randrange(1, 400)
+                read_len = rng.randrange(80, 151)
+                yield BamRecord(
+                    ref_id=ref_id, pos=pos, mapq=rng.randrange(1, 60),
+                    bin=0, flag=0, next_ref_id=-1, next_pos=-1, tlen=0,
+                    read_name=f"syn{i:06d}",
+                    cigar=[(read_len, 0)],
+                    seq="".join(rng.choices("ACGT", k=read_len)),
+                    qual=bytes(
+                        rng.randrange(2, 41) for _ in range(read_len)
+                    ),
+                )
+                i += 1
+
+    tmp = out.with_suffix(".tmp")
+    write_bam(tmp, header, records())
+    os.replace(tmp, out)
+    return out
+
+
 def _count_records(rec_bytes: memoryview) -> int:
     """Record count of a flat record region (length-prefix walk)."""
     import struct
@@ -66,6 +120,8 @@ def synth_bam(
 
     Returns a manifest dict: reps, reads, compressed/uncompressed sizes.
     """
+    if not Path(fixture).exists():
+        fixture = synthetic_fixture()
     flat = flatten_file(fixture)
     hdr = read_header(fixture)
     split = hdr.uncompressed_size

@@ -91,6 +91,53 @@ def read_block_payload(ch: ByteChannel, meta: Metadata):
     return comp[header.size: meta.compressed_size - FOOTER_SIZE]
 
 
+def read_run_payloads(
+    ch: ByteChannel, metas: list[Metadata], threads: int = 8
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """``(comp, offsets, lengths)`` for a run of blocks: a u8 buffer plus
+    each block's raw-DEFLATE payload ``(offset, length)`` into it.
+
+    A contiguous run — the BGZF norm, and what the window planner hands
+    out — is fetched with ONE positioned read, so a plan-driven remote
+    channel (core/remote_plan.py) sees a single large request instead of
+    one call per block; per-call locking/assembly overhead is what
+    dominates thousand-block windows on a busy host. Non-contiguous runs
+    fan per-block reads across ``threads`` so high-latency channels still
+    overlap round-trips."""
+    offsets = np.empty(len(metas), dtype=np.int64)
+    lengths = np.empty(len(metas), dtype=np.int64)
+    if not metas:
+        return np.empty(0, dtype=np.uint8), offsets, lengths
+    lo = metas[0].start
+    hi = metas[-1].start + metas[-1].compressed_size
+    if hi - lo == sum(m.compressed_size for m in metas):
+        blob = ch.read_at(lo, hi - lo)
+        if len(blob) != hi - lo:
+            raise EOFError(f"wanted {hi - lo} bytes at {lo}, got {len(blob)}")
+        for i, m in enumerate(metas):
+            at = m.start - lo
+            header = Header.parse(blob[at: at + 18])
+            offsets[i] = at + header.size
+            lengths[i] = m.compressed_size - header.size - FOOTER_SIZE
+        return np.frombuffer(blob, dtype=np.uint8), offsets, lengths
+    with ThreadPoolExecutor(max_workers=min(8, max(threads, 1))) as pool:
+        parts = list(
+            pool.map(
+                lambda m: np.frombuffer(
+                    read_block_payload(ch, m), dtype=np.uint8
+                ),
+                metas,
+            )
+        )
+    off = 0
+    for i, part in enumerate(parts):
+        offsets[i] = off
+        lengths[i] = len(part)
+        off += len(part)
+    comp = np.concatenate(parts) if parts else np.empty(0, dtype=np.uint8)
+    return comp, offsets, lengths
+
+
 def _inflate_one(ch: ByteChannel, meta: Metadata, out: np.ndarray, flat_off: int):
     payload = read_block_payload(ch, meta)
     data = inflate_block_payload(payload, meta.uncompressed_size)
@@ -120,23 +167,7 @@ def _inflate_fast_native(
             offsets[i] = m.start + header.size
             lengths[i] = m.compressed_size - header.size - FOOTER_SIZE
     else:
-        # Fan the payload reads out (read_at is positioned + thread-safe)
-        # so high-latency channels overlap round-trips, then concatenate.
-        with ThreadPoolExecutor(max_workers=min(8, max(threads, 1))) as pool:
-            parts = list(
-                pool.map(
-                    lambda m: np.frombuffer(
-                        read_block_payload(ch, m), dtype=np.uint8
-                    ),
-                    metas,
-                )
-            )
-        off = 0
-        for i, part in enumerate(parts):
-            offsets[i] = off
-            lengths[i] = len(part)
-            off += len(part)
-        comp = np.concatenate(parts) if parts else np.empty(0, dtype=np.uint8)
+        comp, offsets, lengths = read_run_payloads(ch, metas, threads=threads)
 
     n_chunks = max(1, min(threads, len(metas) // 32))
     if n_chunks == 1:

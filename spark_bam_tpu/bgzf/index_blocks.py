@@ -15,7 +15,12 @@ from typing import Iterable
 
 from spark_bam_tpu.bgzf.block import Metadata
 from spark_bam_tpu.bgzf.stream import MetadataStream
-from spark_bam_tpu.core.channel import open_channel, path_exists, path_size
+from spark_bam_tpu.core.channel import (
+    is_url,
+    open_channel,
+    path_exists,
+    path_size,
+)
 from spark_bam_tpu.core.faults import Unrecoverable
 
 log = logging.getLogger(__name__)
@@ -99,12 +104,32 @@ def validate_blocks_index(blocks: list[Metadata], file_size: int) -> str | None:
     return None
 
 
-def blocks_metadata(bam_path, strict: bool = False) -> Iterable[Metadata]:
-    """All block Metadata of a BAM: from the sidecar when present *and*
-    consistent with the file (start-chain contiguity + size coverage —
-    a stale sidecar from an overwritten BAM must not poison the split
-    plan), else by scan. ``strict`` raises on a stale sidecar instead of
-    silently rescanning, mirroring FaultPolicy's strict mode."""
+def blocks_metadata(
+    bam_path, strict: bool = False, config=None
+) -> Iterable[Metadata]:
+    """All block Metadata of a BAM: from the ``.blocks`` sidecar when
+    present *and* consistent with the file (start-chain contiguity + size
+    coverage — a stale sidecar from an overwritten BAM must not poison the
+    split plan), else from the ``.sbi`` cache tier (fingerprint-validated;
+    sbi/store.py), else by scan — with the scan result written through to
+    the ``.sbi`` tier so the next load (and every fleet member after the
+    first) derives its fetch plan without touching the BAM body.
+    ``strict`` raises on a stale sidecar instead of silently rescanning,
+    mirroring FaultPolicy's strict mode."""
+    from spark_bam_tpu.sbi.store import cached_blocks, store_blocks
+
+    remote = is_url(str(bam_path))
+    if remote:
+        # Remote paths consult the ``.sbi`` tier FIRST: a warm hit costs
+        # two round-trips (the fingerprint's size + head-CRC probe), while
+        # the ``.blocks`` existence probe alone is a round-trip against a
+        # sidecar that usually does not exist. Local paths keep
+        # sidecar-first — the existence check is free and a user-authored
+        # sidecar should win. The fingerprint binds the hit to the current
+        # file bytes, so precedence cannot serve a stale table.
+        blocks = cached_blocks(bam_path, config)
+        if blocks is not None:
+            return blocks
     sidecar = str(bam_path) + ".blocks"
     if path_exists(sidecar):
         blocks = read_blocks_index(sidecar)
@@ -120,5 +145,14 @@ def blocks_metadata(bam_path, strict: bool = False) -> Iterable[Metadata]:
             "ignoring stale .blocks sidecar %s (%s); rescanning", sidecar,
             reason,
         )
+    if not remote:
+        blocks = cached_blocks(bam_path, config)
+        if blocks is not None:
+            return blocks
     with open_channel(bam_path) as ch:
-        return list(MetadataStream(ch))
+        blocks = list(MetadataStream(ch))
+    try:
+        store_blocks(bam_path, blocks, config)
+    except Exception:  # write-through is an accelerator, never a failure
+        log.debug("block-table write-through failed", exc_info=True)
+    return blocks

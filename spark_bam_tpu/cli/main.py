@@ -73,6 +73,16 @@ def _add_limits(sub):
     )
 
 
+def _add_remote(sub):
+    sub.add_argument(
+        "--remote", default=None, metavar="SPEC",
+        help="remote data-plane tuning, e.g. "
+             "'mode=plan,depth=8,gap=128KB,request=512KB,hedge=3,pool=64' "
+             "(mode=legacy restores cursor read-ahead; depth=0 adapts; "
+             "SPARK_BAM_REMOTE env var works too; docs/remote.md)",
+    )
+
+
 def _add_funnel(sub):
     sub.add_argument(
         "--funnel", default=None, choices=("on", "off", "auto"),
@@ -89,6 +99,7 @@ def _add_common(sub, split_default=None):
     _add_faults(sub)
     _add_cache(sub)
     _add_limits(sub)
+    _add_remote(sub)
     _add_funnel(sub)
     sub.add_argument("-m", "--max-split-size", default=split_default,
                      help="split size (byte shorthand like 2MB ok)")
@@ -298,6 +309,15 @@ def main(argv=None) -> int:
             # every parser this invocation touches decodes under them.
             set_limits(DecodeLimits.parse(args.limits))
             config = config.replace(limits=args.limits)
+        if getattr(args, "remote", None) is not None:
+            from spark_bam_tpu.core.remote_plan import (
+                RemoteConfig, set_remote_config,
+            )
+
+            # Fail before any work starts, then install process-wide so
+            # every channel this invocation opens rides the tuned plane.
+            set_remote_config(RemoteConfig.parse(args.remote))
+            config = config.replace(remote=args.remote)
         if getattr(args, "funnel", None) is not None:
             config = config.replace(funnel=args.funnel)
         config.funnel_enabled()  # fail early on a bad SPARK_BAM_FUNNEL
@@ -471,6 +491,10 @@ def main(argv=None) -> int:
     finally:
         if chaos_state is not None:
             uninstall_chaos()
+        if getattr(args, "remote", None) is not None:
+            from spark_bam_tpu.core.remote_plan import set_remote_config
+
+            set_remote_config(None)  # in-process callers (tests) reset clean
         root_span.__exit__(None, None, None)
         if metrics_out:
             # Export after the root span closes so it lands in the trace;

@@ -14,6 +14,7 @@ storage IO"; latency-injection proof in tests/test_remote.py).
 
 from __future__ import annotations
 
+import inspect
 import io
 import mmap
 import os
@@ -239,7 +240,13 @@ def _raw_url_channel(url: str) -> ByteChannel:
     scheme = _URL_RE.match(url).group(1)
     _ensure_builtin_scheme(scheme)
     if scheme in _SCHEMES:
-        return _SCHEMES[scheme](url)
+        fn = _SCHEMES[scheme]
+        # Built-in cloud backends default prefetch=True; a metadata probe
+        # wants the bare transport. Handlers without the knob get the
+        # plain call.
+        if "prefetch" in inspect.signature(fn).parameters:
+            return fn(url, prefetch=False)
+        return fn(url)
     if scheme in ("http", "https"):
         from spark_bam_tpu.core.remote import HttpRangeChannel
 
@@ -282,9 +289,10 @@ def open_channel(path, cached: bool = False) -> ByteChannel:
     """Open a channel for a path — the single pluggable IO seam.
 
     Local paths are mmap-backed. ``http(s)://`` URLs get an
-    ``HttpRangeChannel`` wrapped in a ``PrefetchChannel`` (read-ahead hides
-    the round-trips; SURVEY.md §7 hard-part 5). Other ``scheme://`` URLs
-    dispatch through ``register_scheme``.
+    ``HttpRangeChannel`` wrapped by the remote data plane (plan-driven
+    coalesced prefetch — core/remote_plan.py — or the legacy cursor
+    read-ahead under ``mode=legacy``; SURVEY.md §7 hard-part 5). Other
+    ``scheme://`` URLs dispatch through ``register_scheme``.
     """
     s = str(path)
     m = _URL_RE.match(s)
@@ -294,12 +302,10 @@ def open_channel(path, cached: bool = False) -> ByteChannel:
         if scheme in _SCHEMES:  # registrations override built-ins
             ch: ByteChannel = _SCHEMES[scheme](s)
         elif scheme in ("http", "https"):
-            from spark_bam_tpu.core.prefetch import PrefetchChannel
             from spark_bam_tpu.core.remote import HttpRangeChannel
+            from spark_bam_tpu.core.remote_plan import wrap_remote
 
-            ch = PrefetchChannel(
-                HttpRangeChannel(s), chunk_size=1 << 20, depth=4, workers=8
-            )
+            ch = wrap_remote(HttpRangeChannel(s))
         else:
             raise ValueError(f"no channel backend for scheme {scheme!r}: {s}")
     else:

@@ -4,9 +4,10 @@ The reference's founding problem was GCS seek latency (its docs' headline
 numbers are all measured on GCS; google-cloud-nio + ``fs.gs.io.buffersize``
 at cli/.../spark/ComputeSplits.scala:47-54). Here cloud objects ride the
 same stack every remote byte does: ``HttpRangeChannel`` (keep-alive
-range-GETs, retry/jitter/Retry-After — core/remote.py) wrapped in
-``PrefetchChannel`` read-ahead (core/prefetch.py), so sequential scans
-overlap round-trips and the inflate fan-out overlaps random ones.
+range-GETs — core/remote.py) wrapped by the remote data plane
+(plan-driven coalesced prefetch with hedged GETs, core/remote_plan.py;
+or the legacy cursor read-ahead under ``mode=legacy``), so sequential
+scans overlap round-trips and the inflate fan-out overlaps random ones.
 
 Auth is env-sourced — no SDK dependency:
 
@@ -39,13 +40,8 @@ import os
 import urllib.parse
 
 from spark_bam_tpu.core.channel import ByteChannel, register_scheme
-from spark_bam_tpu.core.prefetch import PrefetchChannel
 from spark_bam_tpu.core.remote import HttpRangeChannel
-
-#: PrefetchChannel shape for cloud objects: 1 MiB chunks × depth 4 × 8
-#: workers ≈ 4 MiB in flight — enough to hide a 100 ms RTT at ~40 MB/s per
-#: stream before the inflate fan-out adds its own concurrency.
-_PREFETCH = dict(chunk_size=1 << 20, depth=4, workers=8)
+from spark_bam_tpu.core.remote_plan import wrap_remote
 
 
 def _split_bucket_key(url: str, scheme: str) -> tuple[str, str]:
@@ -81,7 +77,7 @@ def gs_https_url(url: str):
 def open_gs(url: str, prefetch: bool = True) -> ByteChannel:
     https, headers = gs_https_url(url)
     ch: ByteChannel = HttpRangeChannel(https, headers=headers)
-    return PrefetchChannel(ch, **_PREFETCH) if prefetch else ch
+    return wrap_remote(ch) if prefetch else ch
 
 
 # ------------------------------------------------------------------- s3://
@@ -173,7 +169,7 @@ def s3_https_url(url: str):
 def open_s3(url: str, prefetch: bool = True) -> ByteChannel:
     https, headers = s3_https_url(url)
     ch: ByteChannel = HttpRangeChannel(https, headers=headers)
-    return PrefetchChannel(ch, **_PREFETCH) if prefetch else ch
+    return wrap_remote(ch) if prefetch else ch
 
 
 register_scheme("gs", open_gs)

@@ -121,6 +121,11 @@ class Config:
     # Compact DecodeLimits spec ("record=32MB,refs=1000"; "" = defaults).
     # Same string-spec pattern; ``decode_limits`` parses it (cached).
     limits: str = ""
+    # --- remote data plane (core/remote_plan.py; docs/remote.md) ---
+    # Compact RemoteConfig spec ("mode=plan,depth=8,gap=128KB,hedge=3";
+    # "" = defaults: plan-driven, adaptive depth). Same string-spec
+    # pattern; ``remote_config`` parses it (cached).
+    remote: str = ""
     # --- candidate funnel (tpu/checker.py; docs/design.md) ---
     # Two-stage checker hot path: cheap fixed-block prefilter over every
     # position, full 19-flag pass only on survivors. "auto" (default)
@@ -179,6 +184,13 @@ class Config:
         from spark_bam_tpu.core.guard import DecodeLimits
 
         return DecodeLimits.parse(self.limits)
+
+    @property
+    def remote_config(self):
+        """The parsed ``RemoteConfig`` for this config's ``remote`` spec."""
+        from spark_bam_tpu.core.remote_plan import RemoteConfig
+
+        return RemoteConfig.parse(self.remote)
 
     def funnel_enabled(self, full_masks: bool = False) -> bool:
         """Whether a projection should run the two-stage candidate funnel.

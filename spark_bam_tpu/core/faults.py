@@ -204,6 +204,38 @@ def with_retries(fn, policy: "FaultPolicy", what: str = "operation"):
     raise last  # unreachable; satisfies control-flow analysis
 
 
+# --------------------------------------------------------------- latency
+class LatencyTracker:
+    """Sliding-window latency stats for hedging decisions.
+
+    The executor hedges partitions at N× the median completed-attempt
+    latency; the remote data plane (core/remote_plan.py) hedges individual
+    GETs the same way. Both need a thread-safe rolling median that refuses
+    to guess before it has seen enough samples (``MIN_SAMPLES``, matching
+    the executor's ``_HEDGE_MIN_SAMPLES``)."""
+
+    MIN_SAMPLES = 3
+
+    def __init__(self, window: int = 64):
+        from collections import deque
+
+        self._samples: "deque[float]" = deque(maxlen=window)
+        self._lock = threading.Lock()
+
+    def record(self, ms: float) -> None:
+        with self._lock:
+            self._samples.append(ms)
+
+    def median(self) -> float | None:
+        """Median of the recent window, or None below ``MIN_SAMPLES``."""
+        import statistics
+
+        with self._lock:
+            if len(self._samples) < self.MIN_SAMPLES:
+                return None
+            return statistics.median(self._samples)
+
+
 # ------------------------------------------------------------------- chaos
 class ChaosError(IOError):
     """Injected transient I/O failure (retryable by design)."""

@@ -40,6 +40,7 @@ class PrefetchChannel(ByteChannel):
         self.max_chunks = max_chunks or max(4 * (depth + 1), 16)
         self._pool = ThreadPoolExecutor(max_workers=workers)
         self._inflight: OrderedDict[int, Future] = OrderedDict()
+        self._pins: dict[int, int] = {}
         self._lock = threading.Lock()
 
     def _fetch(self, idx: int) -> Future:
@@ -59,28 +60,58 @@ class PrefetchChannel(ByteChannel):
     def _read_at(self, pos: int, n: int) -> bytes:
         first = pos // self.chunk_size
         last = (pos + max(n, 1) - 1) // self.chunk_size
-        # Kick off the window we need plus read-ahead.
-        for idx in range(first, last + 1 + self.depth):
-            self._fetch(idx)
-        out = []
-        remaining = n
-        cur = pos
-        for idx in range(first, last + 1):
-            chunk = self._fetch(idx).result()
-            off = cur - idx * self.chunk_size
-            piece = chunk[off: off + remaining]
-            if not piece:
-                break
-            out.append(piece)
-            cur += len(piece)
-            remaining -= len(piece)
-            if remaining <= 0:
-                break
-        # Retire least-recently-used chunks to bound memory.
+        # Pin the window this read will consume: eviction must not race a
+        # concurrent reader at a far-apart offset into dropping our chunks
+        # between fetch and result() (two readers with a small max_chunks
+        # would otherwise thrash each other into re-fetching everything).
         with self._lock:
-            while len(self._inflight) > self.max_chunks:
-                self._inflight.popitem(last=False)
+            for idx in range(first, last + 1):
+                self._pins[idx] = self._pins.get(idx, 0) + 1
+        try:
+            # Kick off the window we need plus read-ahead.
+            for idx in range(first, last + 1 + self.depth):
+                self._fetch(idx)
+            out = []
+            remaining = n
+            cur = pos
+            for idx in range(first, last + 1):
+                chunk = self._fetch(idx).result()
+                off = cur - idx * self.chunk_size
+                piece = chunk[off: off + remaining]
+                if not piece:
+                    break
+                out.append(piece)
+                cur += len(piece)
+                remaining -= len(piece)
+                if remaining <= 0:
+                    break
+        finally:
+            with self._lock:
+                for idx in range(first, last + 1):
+                    left = self._pins.get(idx, 0) - 1
+                    if left <= 0:
+                        self._pins.pop(idx, None)
+                    else:
+                        self._pins[idx] = left
+                self._evict_locked()
         return b"".join(out)
+
+    def _evict_locked(self) -> None:
+        # Retire least-recently-used chunks to bound memory — but never a
+        # pinned chunk (an outstanding reader holds it) or a pending fetch
+        # (dropping it just re-pays the request). May transiently stay over
+        # max_chunks while every chunk is pinned or in flight.
+        excess = len(self._inflight) - self.max_chunks
+        if excess <= 0:
+            return
+        for idx in list(self._inflight):
+            if excess <= 0:
+                break
+            fut = self._inflight[idx]
+            if self._pins.get(idx) or not fut.done():
+                continue
+            del self._inflight[idx]
+            excess -= 1
 
     @property
     def size(self) -> int:

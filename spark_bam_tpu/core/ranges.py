@@ -60,6 +60,56 @@ class RangeSet:
         return "RangeSet(%s)" % ",".join(f"{r.start}-{r.end}" for r in self.ranges)
 
 
+# ------------------------------------------------------------- fetch planner
+
+def _split_run(start: int, end: int, max_request: int) -> list[ByteRange]:
+    """One coalesced run → ~equal fetches of at most ``max_request`` bytes.
+    ceil-divided so a run just over the cap becomes two near-halves rather
+    than a full request plus a sliver."""
+    length = end - start
+    n = -(-length // max_request)
+    step = -(-length // n)
+    return [ByteRange(s, min(s + step, end)) for s in range(start, end, step)]
+
+
+def plan_fetches(
+    ranges: "RangeSet | Iterable[ByteRange]",
+    *,
+    gap: int = 128 << 10,
+    max_request: int = 512 << 10,
+) -> list[ByteRange]:
+    """Coalesce the byte ranges a job will touch into ranged-GET requests.
+
+    Adjacent ranges separated by at most ``gap`` cold bytes merge into one
+    run (fetching a small gap is cheaper than paying another round-trip);
+    runs longer than ``max_request`` split into near-equal fetches so they
+    can pipeline. The result is the data plane's request plan
+    (core/remote_plan.py): sorted, non-overlapping, covering every input
+    byte, with every fetch at most ``max_request`` long and every fetched
+    non-input byte inside a gap of at most ``gap`` bytes.
+    """
+    if gap < 0:
+        raise ValueError(f"gap must be >= 0: {gap}")
+    if max_request <= 0:
+        raise ValueError(f"max_request must be > 0: {max_request}")
+    rs = ranges if isinstance(ranges, RangeSet) else RangeSet(ranges)
+    fetches: list[ByteRange] = []
+    run_start = run_end = None
+    for r in rs.ranges:
+        if r.start == r.end:
+            continue
+        if run_start is None:
+            run_start, run_end = r.start, r.end
+        elif r.start - run_end <= gap:
+            run_end = max(run_end, r.end)
+        else:
+            fetches.extend(_split_run(run_start, run_end, max_request))
+            run_start, run_end = r.start, r.end
+    if run_start is not None:
+        fetches.extend(_split_run(run_start, run_end, max_request))
+    return fetches
+
+
 def parse_range(s: str) -> ByteRange:
     """One range: ``start-end`` | ``start+length`` | ``point``."""
     s = s.strip()

@@ -28,6 +28,19 @@ import urllib.parse
 from spark_bam_tpu.core.channel import ByteChannel
 
 
+def _content_range_start(content_range: str | None) -> int | None:
+    """First byte position from ``Content-Range: bytes lo-hi/total``;
+    None when absent or not a byte-range form (e.g. ``bytes */total``)."""
+    if not content_range:
+        return None
+    value = content_range.strip()
+    if not value.startswith("bytes"):
+        return None
+    span = value[len("bytes"):].strip().split("/", 1)[0]
+    lo = span.split("-", 1)[0].strip()
+    return int(lo) if lo.isdigit() else None
+
+
 def _parse_retry_after(value: str | None) -> float:
     """``Retry-After`` as seconds: delta-seconds or an HTTP-date (RFC 9110
     §10.2.3 allows either form); unparseable/absent → 0 (jittered
@@ -162,12 +175,36 @@ class HttpRangeChannel(ByteChannel):
             "GET", {"Range": f"bytes={pos}-{pos + n - 1}"}
         )
         if resp.status == 206:
-            self._learn_size(resp.headers.get("Content-Range"))
+            content_range = resp.headers.get("Content-Range")
+            self._learn_size(content_range)
+            # Verify the 206 actually starts where we asked: a proxy or
+            # misbehaving server answering a different range would
+            # otherwise hand corrupt bytes to the decoder as if correct.
+            got = _content_range_start(content_range)
+            if got is not None and got != pos:
+                from spark_bam_tpu.core.guard import StructurallyInvalid
+
+                raise StructurallyInvalid(
+                    f"server answered range starting at {got}, "
+                    f"requested {pos} (Content-Range: {content_range!r})",
+                    path=self.url, pos=pos,
+                )
             return body
         if resp.status == 200:
-            # Server ignored the Range header; slice the full body.
-            self._size = len(body)
-            return body[pos: pos + n]
+            # Server ignored the Range header and sent the full body. A
+            # 200 is only honest when we asked from byte 0 and got at most
+            # what we asked for; otherwise silently slicing would mask a
+            # broken range path (and re-download the object per read).
+            if pos == 0 and len(body) <= n:
+                self._size = len(body)
+                return body
+            from spark_bam_tpu.core.guard import StructurallyInvalid
+
+            raise StructurallyInvalid(
+                f"server ignored Range header (HTTP 200 full body, "
+                f"{len(body)} bytes) for range {pos}+{n}",
+                path=self.url, pos=pos,
+            )
         if resp.status == 416:  # requested range past EOF
             self._learn_size(resp.headers.get("Content-Range"))
             return b""

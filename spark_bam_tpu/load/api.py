@@ -403,6 +403,36 @@ def load_bam(
     )
 
 
+def load_fleet(
+    paths,
+    split_size=None,
+    config: Config = Config(),
+    parallel: ParallelConfig = ParallelConfig(),
+) -> Dataset:
+    """Many BAMs as ONE dataset, partitioned by file — fleet mode
+    (docs/remote.md). Each partition opens its own channels inside the
+    worker (zero serial driver-side remote reads — hadoop-bam's original
+    sin), rides the resilient executor's retry/hedge ledger, and shares
+    the process-wide remote GET quota (core/remote_plan.py) plus the
+    ``.sbi`` cache tier, so 64+ concurrent objects cannot stampede the
+    store. Yields (path, Pos, BamRecord) triples."""
+    paths = [str(p) for p in paths]
+
+    def compute(path):
+        # Header/split resolution happens HERE, in the partition, under
+        # the sequential inner executor — the outer pool is the only
+        # parallelism, so attempts stay independently retryable.
+        ds = load_reads_and_positions(
+            path, split_size, config, ParallelConfig("sequential")
+        )
+        for split in ds.partitions:
+            for pos, rec in ds.compute(split):
+                yield path, pos, rec
+
+    obs.gauge("load.fleet_files").set(len(paths))
+    return Dataset(paths, compute, parallel, policy=config.fault_policy)
+
+
 def load_splits_and_reads(
     path,
     split_size=None,

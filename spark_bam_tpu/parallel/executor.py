@@ -322,11 +322,29 @@ def _run_pooled(fn, items, config, policy, reports) -> list:
         unresolved -= 1
         _fail_partition(reports[i], err, policy)
 
+    # Feed the pool a bounded backlog instead of submitting every
+    # partition upfront: a fleet load (load/api.load_fleet) can carry
+    # hundreds of partitions, and a full-depth queue defeats both the
+    # deadline watchdog (queued futures age without running) and the
+    # remote data plane's in-flight quota (every queued partition would
+    # open its channels the moment a worker frees up, all at once).
+    backlog_cap = max(2 * config.num_workers, 4)
+    next_to_submit = 0
+
+    def feed() -> None:
+        nonlocal next_to_submit
+        while (
+            next_to_submit < n
+            and len(inflight) - len(abandoned) < backlog_cap
+        ):
+            submit(next_to_submit, 0, speculative=False)
+            next_to_submit += 1
+
     try:
-        for i in range(n):
-            submit(i, 0, speculative=False)
+        feed()
         watch = policy.deadline is not None or policy.hedge_after is not None
         while unresolved:
+            feed()
             now = time.monotonic()
             for entry in [e for e in retry_due if e[0] <= now]:
                 retry_due.remove(entry)

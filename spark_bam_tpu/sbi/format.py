@@ -111,10 +111,25 @@ def fingerprint_of(bam_path, config) -> Fingerprint:
     paths have no stable mtime; size + head-CRC carry the freshness check
     there (callers wrap this in ``with_retries`` for remote transports)."""
     path = str(bam_path)
-    size = path_size(path)
-    mtime_ns = 0 if is_url(path) else os.stat(path).st_mtime_ns
-    with open_channel(path) as ch:
-        head = bytes(ch.read_at(0, min(HEADER_CRC_SPAN, size)))
+    if is_url(path):
+        # Raw backend channel, ONE connection, head first: servers answer
+        # the ranged GET with the object's total size in Content-Range
+        # (RFC 9110 clamps a long range to EOF), so the usual freshness
+        # probe is ONE round-trip — ``size`` only HEADs when the server
+        # omitted the total. The prefetching wrapper ``open_channel``
+        # installs would re-probe the size and read megabytes ahead of
+        # the CRC span — pure waste at RTT prices.
+        from spark_bam_tpu.core.channel import _raw_url_channel
+
+        with _raw_url_channel(path) as ch:
+            head = bytes(ch.read_at(0, HEADER_CRC_SPAN))
+            size = ch.size
+        mtime_ns = 0
+    else:
+        size = path_size(path)
+        mtime_ns = os.stat(path).st_mtime_ns
+        with open_channel(path) as ch:
+            head = bytes(ch.read_at(0, min(HEADER_CRC_SPAN, size)))
     return Fingerprint(
         size, mtime_ns, zlib.crc32(head) & 0xFFFFFFFF, config_digest(config)
     )

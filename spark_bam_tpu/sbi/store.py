@@ -364,3 +364,43 @@ class CacheStore:
             obs.gauge("cache.bytes").set(total)
         except OSError:
             pass  # eviction is best-effort; the cache stays correct
+
+# ------------------------------------------------------- block-table tier
+def cached_blocks(bam_path, config=None):
+    """The ``.sbi`` block table for ``bam_path``, or None (cache off /
+    miss / sidecar has no SECTION_BLOCKS). This is the data plane's warm
+    path: a fleet load that has seen a BAM before derives its exact fetch
+    plan without a metadata scan (docs/remote.md)."""
+    from spark_bam_tpu.core.config import default_config
+
+    config = config or default_config()
+    mode = config.cache_mode
+    if not (mode.enabled and mode.read):
+        return None
+    index = CacheStore.from_env(policy=config.fault_policy).load(
+        bam_path, config, strict=mode.strict
+    )
+    if index is None or index.blocks is None:
+        return None
+    return list(index.blocks)
+
+
+def store_blocks(bam_path, blocks, config=None) -> str | None:
+    """Write-through of a freshly scanned block table into the ``.sbi``
+    tier (preserving any other sections the sidecar holds); returns the
+    sidecar path or None when caching is off / the store can't hold it."""
+    from spark_bam_tpu.core.config import default_config
+
+    config = config or default_config()
+    mode = config.cache_mode
+    if not (mode.enabled and mode.write):
+        return None
+    store = CacheStore.from_env(policy=config.fault_policy)
+    index = SbiIndex(
+        fingerprint=with_retries(
+            lambda: fingerprint_of(bam_path, config), store.policy,
+            "fingerprint",
+        ),
+        blocks=list(blocks),
+    )
+    return store.merge_and_store(bam_path, config, index)

@@ -67,7 +67,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from spark_bam_tpu.bgzf.block import MAX_BLOCK_SIZE, Metadata
-from spark_bam_tpu.bgzf.flat import FlatView, inflate_blocks, read_block_payload
+from spark_bam_tpu.bgzf.flat import FlatView, inflate_blocks, read_run_payloads
 from spark_bam_tpu.core.channel import open_channel
 
 # Fixed token-row width: one BGZF block inflates to ≤ MAX_BLOCK_SIZE
@@ -270,19 +270,9 @@ def inflate_blocks_device(
 
 
 def _read_group_payloads(ch, metas: list[Metadata]):
-    """Concatenate a group's raw-DEFLATE payloads (host read phase)."""
-    comp_parts, offs, lens = [], [], []
-    off = 0
-    for m in metas:
-        payload = np.frombuffer(read_block_payload(ch, m), dtype=np.uint8)
-        comp_parts.append(payload)
-        offs.append(off)
-        lens.append(len(payload))
-        off += len(payload)
-    comp = (
-        np.concatenate(comp_parts) if comp_parts else np.empty(0, dtype=np.uint8)
-    )
-    return comp, np.array(offs, dtype=np.int64), np.array(lens, dtype=np.int64)
+    """A group's payload buffer + per-block (offset, length) — one bulk
+    positioned read for contiguous runs (host read phase)."""
+    return read_run_payloads(ch, metas)
 
 
 def tokenize_group(ch, metas: list[Metadata]):
@@ -483,6 +473,14 @@ class InflatePipeline:
 
     def __iter__(self) -> Iterator[FlatView]:
         ch = open_channel(self.path)
+        if hasattr(ch, "set_plan"):
+            # Remote data plane (core/remote_plan.py): the block table IS
+            # the exact byte plan — hand it over so the channel coalesces
+            # ranged GETs and prefetches in plan order instead of blindly
+            # reading ahead of the cursor.
+            ch.set_plan(
+                (m.start, m.start + m.compressed_size) for m in self.metas
+            )
         pool = ThreadPoolExecutor(max_workers=self.depth)
 
         def produce(group):

@@ -1,5 +1,6 @@
 """Prefetch channel over a deliberately slow backend."""
 
+import threading
 import time
 
 import pytest
@@ -61,3 +62,58 @@ def test_prefetch_overlaps_latency(bam2):
 
     assert n1 == n2 == 25
     assert t_pre < t_serial
+
+
+class CountingMemChannel(ByteChannel):
+    def __init__(self, data: bytes):
+        super().__init__()
+        self.data = data
+        self.reads = 0
+        self._lock = threading.Lock()
+
+    def _read_at(self, pos, n):
+        with self._lock:
+            self.reads += 1
+        return self.data[pos: pos + n]
+
+    @property
+    def size(self):
+        return len(self.data)
+
+    def close(self):
+        pass
+
+
+def test_far_apart_readers_do_not_thrash_eviction():
+    """Regression: two readers at far-apart offsets with a tiny
+    ``max_chunks`` used to evict each other's chunks between fetch and
+    ``result()``, re-fetching every chunk repeatedly (and, in the worst
+    interleaving, returning bytes fetched twice). Pinned chunks make the
+    inner read count exact: one fetch per distinct chunk, regardless of
+    interleaving."""
+    chunk = 1024
+    data = bytes((i * 7) & 0xFF for i in range(16 * chunk))
+    inner = CountingMemChannel(data)
+    # depth=0: no read-ahead, so every inner read is one requested chunk;
+    # max_chunks=1: maximum eviction pressure.
+    ch = PrefetchChannel(inner, chunk_size=chunk, depth=0, workers=4,
+                         max_chunks=1)
+    errors = []
+
+    def scan(chunks):
+        try:
+            for idx in chunks:
+                got = ch.read_at(idx * chunk, chunk)
+                if got != data[idx * chunk: (idx + 1) * chunk]:
+                    errors.append(idx)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    t1 = threading.Thread(target=scan, args=(range(0, 8),))
+    t2 = threading.Thread(target=scan, args=(range(8, 16),))
+    t1.start(); t2.start()
+    t1.join(); t2.join()
+    assert not errors
+    # 16 distinct chunks → exactly 16 inner reads: no thrash re-fetching.
+    assert inner.reads == 16
+    ch.close()

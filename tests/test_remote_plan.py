@@ -1,0 +1,416 @@
+"""Plan-driven remote data plane (core/remote_plan.py, core/ranges.py).
+
+Covers the coalescing planner's invariants (property-tested over seeded
+random range sets), ``PlannedChannel`` correctness + request coalescing,
+hedged GETs (one slow replica must not stall the pipeline), adaptive
+depth, config plumbing, and the hardened ``HttpRangeChannel`` Range
+verification — all against the in-process ``FakeObjectStore`` (seeded, no
+network)."""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+import pytest
+
+from spark_bam_tpu.benchmarks.fakestore import FakeObjectStore
+from spark_bam_tpu.core.channel import ByteChannel
+from spark_bam_tpu.core.guard import MalformedInputError
+from spark_bam_tpu.core.ranges import ByteRange, RangeSet, plan_fetches
+from spark_bam_tpu.core.remote import HttpRangeChannel
+from spark_bam_tpu.core.remote_plan import (
+    PlannedChannel,
+    RemoteConfig,
+    active_remote_config,
+    set_remote_config,
+    wrap_remote,
+)
+
+DATA = bytes((i * 31 + (i >> 8)) & 0xFF for i in range(1 << 20))  # 1 MiB
+
+
+# ------------------------------------------------------------- plan_fetches
+
+def _random_ranges(rng: random.Random, n: int, span: int) -> list[ByteRange]:
+    out = []
+    for _ in range(n):
+        start = rng.randrange(span)
+        out.append(ByteRange(start, start + rng.randrange(0, span // 4)))
+    return out
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_plan_fetches_properties(seed):
+    rng = random.Random(seed)
+    gap = rng.choice([0, 1, 512, 64 << 10])
+    max_request = rng.choice([1 << 10, 64 << 10, 512 << 10])
+    ranges = _random_ranges(rng, rng.randrange(1, 40), 4 << 20)
+    rs = RangeSet(ranges)
+    fetches = plan_fetches(rs, gap=gap, max_request=max_request)
+
+    # Sorted and non-overlapping.
+    for a, b in zip(fetches, fetches[1:]):
+        assert a.end <= b.start
+    # Every fetch within the size cap.
+    assert all(f.end - f.start <= max_request for f in fetches)
+    # Coverage: every input byte is fetched.
+    for r in rs.ranges:
+        for pos in (r.start, r.end - 1) if r.end > r.start else ():
+            assert any(pos in f for f in fetches)
+    # Gap threshold: every fetched byte is an input byte or inside a
+    # skippable gap no wider than ``gap``.
+    covered = RangeSet(fetches)
+    for a, b in zip(rs.ranges, rs.ranges[1:]):
+        if b.start - a.end > gap:  # a cold gap the planner must skip
+            mid_zone = not covered.overlaps(a.end, b.start)
+            assert mid_zone, (
+                f"cold gap [{a.end},{b.start}) fetched with gap={gap}"
+            )
+
+
+def test_plan_fetches_coalesces_and_splits():
+    fetches = plan_fetches(
+        [ByteRange(0, 100), ByteRange(150, 250)], gap=50, max_request=1000
+    )
+    assert fetches == [ByteRange(0, 250)]  # gap of 50 merged
+    fetches = plan_fetches([ByteRange(0, 1001)], gap=0, max_request=1000)
+    assert len(fetches) == 2  # near-halves, not 1000 + 1
+    assert {f.end - f.start for f in fetches} == {501, 500}
+    assert plan_fetches([ByteRange(5, 5)]) == []  # empty ranges drop
+
+
+def test_plan_fetches_validates():
+    with pytest.raises(ValueError):
+        plan_fetches([ByteRange(0, 10)], gap=-1)
+    with pytest.raises(ValueError):
+        plan_fetches([ByteRange(0, 10)], max_request=0)
+
+
+# ------------------------------------------------------------- RemoteConfig
+
+def test_remote_config_parse_roundtrip():
+    c = RemoteConfig.parse("mode=plan,depth=8,gap=64KB,request=256KB,"
+                           "hedge=2.5,pool=16,cache=1MB")
+    assert (c.mode, c.depth, c.coalesce_gap, c.max_request) == (
+        "plan", 8, 64 << 10, 256 << 10
+    )
+    assert (c.hedge, c.pool, c.cache_bytes) == (2.5, 16, 1 << 20)
+    assert RemoteConfig.parse("") == RemoteConfig()
+    assert RemoteConfig.parse("hedge=off").hedge == 0.0
+
+
+@pytest.mark.parametrize("spec", [
+    "mode=warp", "depth=-1", "pool=0", "hedge=-1", "request=0", "nope=1",
+    "depth", "gap=-5",
+])
+def test_remote_config_rejects(spec):
+    with pytest.raises(ValueError):
+        RemoteConfig.parse(spec)
+
+
+def test_remote_config_env_and_install(monkeypatch):
+    monkeypatch.setenv("SPARK_BAM_REMOTE", "depth=7")
+    assert active_remote_config().depth == 7
+    set_remote_config("depth=9")
+    try:
+        assert active_remote_config().depth == 9
+    finally:
+        set_remote_config(None)
+    assert active_remote_config().depth == 7
+
+
+def test_config_remote_knob():
+    from spark_bam_tpu.core.config import Config
+
+    assert Config(remote="pool=5").remote_config.pool == 5
+
+
+# ----------------------------------------------------------- PlannedChannel
+
+class CountingChannel(ByteChannel):
+    """In-memory inner channel with request accounting + optional per-read
+    hooks (latency injection for hedging tests)."""
+
+    def __init__(self, data: bytes, delay_s: float = 0.0):
+        super().__init__()
+        self.data = data
+        self.delay_s = delay_s
+        self.reads: list[tuple[int, int]] = []
+        self.hook = None
+        self._lock = threading.Lock()
+
+    def _read_at(self, pos: int, n: int) -> bytes:
+        with self._lock:
+            self.reads.append((pos, n))
+        if self.hook:
+            self.hook(pos, n)
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        return self.data[pos: pos + n]
+
+    @property
+    def size(self) -> int:
+        return len(self.data)
+
+    def close(self) -> None:
+        pass
+
+
+def test_planned_channel_byte_identical_and_coalesced():
+    inner = CountingChannel(DATA)
+    ch = PlannedChannel(
+        inner, config=RemoteConfig.parse("gap=4KB,request=64KB,hedge=off")
+    )
+    # A blocky plan: 64 × 8 KiB ranges with 2 KiB gaps → coalesces into
+    # far fewer fetches than ranges.
+    blocks = [(i * 10_240, i * 10_240 + 8_192) for i in range(64)]
+    ch.set_plan(blocks)
+    for start, end in blocks:
+        assert ch.read_at(start, end - start) == DATA[start:end]
+    fetch_reads = [r for r in inner.reads]
+    assert len(fetch_reads) < 16  # 64 ranges collapsed into ≤ a dozen GETs
+    # Reads spanning a gap still come back byte-identical.
+    assert ch.read_at(8_000, 4_096) == DATA[8_000: 8_000 + 4_096]
+    ch.close()
+
+
+def test_planned_channel_off_plan_and_eof():
+    inner = CountingChannel(DATA)
+    ch = PlannedChannel(
+        inner, plan=[(0, 4_096)],
+        config=RemoteConfig.parse("hedge=off,gap=0"),
+    )
+    # Far off-plan read: served direct, byte-identical.
+    assert ch.read_at(500_000, 1_000) == DATA[500_000:501_000]
+    # Past-EOF read: empty, like every other channel.
+    assert ch.read_at(len(DATA) + 5, 64) == b""
+    ch.close()
+
+
+def test_planned_channel_whole_file_fallback():
+    inner = CountingChannel(DATA)
+    ch = PlannedChannel(
+        inner, config=RemoteConfig.parse("request=128KB,hedge=off")
+    )
+    assert ch.read_at(0, len(DATA)) == DATA  # no plan installed
+    # The fallback plan split the file instead of one giant GET.
+    assert len([r for r in inner.reads if r[1] > 0]) >= 8
+    # set_plan after the first fetch is a no-op, not an error.
+    ch.set_plan([(0, 10)])
+    assert ch.read_at(10, 10) == DATA[10:20]
+    ch.close()
+
+
+def test_planned_channel_concurrent_readers():
+    inner = CountingChannel(DATA)
+    ch = PlannedChannel(
+        inner,
+        plan=[(0, len(DATA))],
+        config=RemoteConfig.parse("request=32KB,hedge=off,cache=64KB"),
+    )
+    errors = []
+
+    def scan(offset):
+        try:
+            for pos in range(offset, len(DATA), 64 << 10):
+                want = DATA[pos: pos + 1024]
+                got = ch.read_at(pos, 1024)
+                if got != want:
+                    errors.append((pos, len(got)))
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=scan, args=(off,))
+        for off in (0, 17, 300_000, 700_001)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    ch.close()
+
+
+def test_planned_channel_adaptive_depth_grows():
+    inner = CountingChannel(DATA, delay_s=0.005)
+    ch = PlannedChannel(
+        inner,
+        plan=[(0, len(DATA))],
+        config=RemoteConfig.parse("request=32KB,hedge=off,depth=0"),
+    )
+    d0 = ch.depth
+    for pos in range(0, 512 << 10, 32 << 10):  # serial scan, always stalls
+        ch.read_at(pos, 1024)
+    assert ch.depth > d0  # stall-driven growth kicked in
+    ch.close()
+
+
+def test_planned_channel_fixed_depth_stays():
+    inner = CountingChannel(DATA, delay_s=0.002)
+    ch = PlannedChannel(
+        inner,
+        plan=[(0, len(DATA))],
+        config=RemoteConfig.parse("request=64KB,hedge=off,depth=2"),
+    )
+    for pos in range(0, 256 << 10, 64 << 10):
+        ch.read_at(pos, 512)
+    assert ch.depth == 2
+    ch.close()
+
+
+def test_hedged_read_does_not_stall_on_slow_replica():
+    """One straggler GET (blocked on an Event) must not stall the read:
+    the hedge twin answers while the primary is still stuck."""
+    inner = CountingChannel(DATA)
+    release = threading.Event()
+    stalled = threading.Event()
+    state = {"first": True}
+    lock = threading.Lock()
+
+    def hook(pos, n):
+        with lock:
+            first = state["first"]
+            state["first"] = False
+        if first:
+            stalled.set()
+            release.wait(timeout=30)
+
+    ch = PlannedChannel(
+        inner,
+        plan=[(0, 64 << 10)],
+        config=RemoteConfig.parse("request=64KB,hedge=3,depth=1"),
+    )
+    # Prime the latency tracker so the hedge trigger has a median.
+    for _ in range(3):
+        ch._latency.record(5.0)
+    inner.hook = hook
+    t0 = time.perf_counter()
+    got = ch.read_at(0, 4_096)
+    wall = time.perf_counter() - t0
+    assert got == DATA[:4_096]            # byte-identical despite the hedge
+    assert stalled.is_set()               # the primary really did stall
+    assert wall < 5.0                     # …and we did not wait for it
+    assert len(inner.reads) >= 2          # a twin was actually issued
+    release.set()
+    ch.close()
+
+
+# ------------------------------------------------------------------ routing
+
+def test_wrap_remote_legacy_mode():
+    from spark_bam_tpu.core.prefetch import PrefetchChannel
+
+    set_remote_config("mode=legacy")
+    try:
+        ch = wrap_remote(CountingChannel(DATA))
+        assert isinstance(ch, PrefetchChannel)
+        assert ch.read_at(100, 50) == DATA[100:150]
+        ch.close()
+    finally:
+        set_remote_config(None)
+
+
+def test_open_channel_routes_http_through_plan(monkeypatch):
+    from spark_bam_tpu.core.channel import open_channel
+
+    with FakeObjectStore(DATA, key="obj.bin") as store:
+        ch = open_channel(store.url_base + "/obj.bin")
+        assert isinstance(ch, PlannedChannel)
+        assert bytes(ch.read_at(12_345, 100)) == DATA[12_345:12_445]
+        ch.close()
+
+
+def test_cli_remote_flag_rejected_early(tmp_path, capsys):
+    from spark_bam_tpu.cli.main import main
+
+    rc = main(["count-reads", "--remote", "mode=bogus", str(tmp_path / "x.bam")])
+    assert rc == 2
+    assert "remote" in capsys.readouterr().err
+
+
+# ------------------------------------- HttpRangeChannel range verification
+
+def test_http_200_full_body_rejected_at_offset():
+    with FakeObjectStore(DATA, key="obj.bin", ignore_range=True) as store:
+        ch = HttpRangeChannel(store.url_base + "/obj.bin")
+        with pytest.raises(MalformedInputError):
+            ch.read_at(1_000, 100)
+        ch.close()
+
+
+def test_http_200_full_body_ok_from_zero():
+    # Asking for the whole object from byte 0 may legitimately answer 200.
+    small = DATA[:4_096]
+    with FakeObjectStore(small, key="obj.bin", ignore_range=True) as store:
+        ch = HttpRangeChannel(store.url_base + "/obj.bin")
+        assert bytes(ch.read_at(0, len(small))) == small
+        ch.close()
+
+
+def test_http_429_storm_absorbed():
+    """A seeded throttling storm costs retries, not correctness."""
+    with FakeObjectStore(
+        DATA, key="obj.bin", throttle_rate=0.3, retry_after_s=0.01, seed=7
+    ) as store:
+        ch = HttpRangeChannel(store.url_base + "/obj.bin", retries=8)
+        for pos in range(0, 256 << 10, 16 << 10):
+            assert bytes(ch.read_at(pos, 1_024)) == DATA[pos: pos + 1_024]
+        assert store.stats["throttles"] > 0  # the storm actually happened
+        ch.close()
+
+
+def test_fakestore_seeded_pathologies_deterministic():
+    kw = dict(
+        key="o.bin", jitter_s=0.0, straggler_rate=0.5, throttle_rate=0.25,
+        seed=42,
+    )
+    outcomes = []
+    for _ in range(2):
+        with FakeObjectStore(DATA[:1024], **kw) as store:
+            ch = HttpRangeChannel(store.url_base + "/o.bin", retries=8)
+            for pos in (0, 100, 200, 300):
+                ch.read_at(pos, 10)
+            outcomes.append(
+                (store.stats["stragglers"], store.stats["throttles"])
+            )
+            ch.close()
+    assert outcomes[0] == outcomes[1]  # same seed → same storm
+
+
+# ----------------------------------------------------- straggler acceptance
+
+@pytest.mark.slow
+def test_straggler_p99_within_2x_no_straggler():
+    """Acceptance: seeded 5% straggler rate (10× latency) keeps p99 window
+    fetch within 2× of the clean run, byte-identical output."""
+    latency = 0.02
+
+    def run(straggler_rate):
+        times = []
+        out = []
+        with FakeObjectStore(
+            DATA, key="o.bin", latency_s=latency,
+            straggler_rate=straggler_rate, straggler_factor=10.0, seed=3,
+        ) as store:
+            ch = PlannedChannel(
+                HttpRangeChannel(store.url_base + "/o.bin"),
+                plan=[(0, len(DATA))],
+                config=RemoteConfig.parse("request=64KB,depth=4,hedge=3"),
+            )
+            for pos in range(0, len(DATA), 64 << 10):
+                t0 = time.perf_counter()
+                out.append(bytes(ch.read_at(pos, 64 << 10)))
+                times.append(time.perf_counter() - t0)
+            ch.close()
+        times.sort()
+        return times[int(len(times) * 0.99) - 1], b"".join(out)
+
+    p99_clean, bytes_clean = run(0.0)
+    p99_straggle, bytes_straggle = run(0.05)
+    assert bytes_clean == bytes_straggle == DATA
+    assert p99_straggle <= max(2 * p99_clean, 10 * latency), (
+        f"p99 {p99_straggle:.3f}s vs clean {p99_clean:.3f}s"
+    )
