@@ -257,6 +257,30 @@ def build_parser() -> argparse.ArgumentParser:
     sub.add_argument("-o", "--out", default=None,
                      help="write the JSON summary here instead of stdout")
 
+    # Long-running split/record daemon over the device mesh: warm steps,
+    # warm flat views, warm .sbi tier; newline-JSON protocol
+    # (docs/serving.md).
+    sub = sp.add_parser("serve")
+    _add_metrics(sub)
+    _add_faults(sub)
+    _add_cache(sub)
+    _add_limits(sub)
+    _add_remote(sub)
+    _add_funnel(sub)
+    sub.add_argument(
+        "--serve", default=None, metavar="SPEC",
+        help="serving knobs, e.g. 'batch=16,tick=2,plan_queue=64,"
+             "scan_queue=128,workers=2,window=1MB,halo=64KB,cache=256MB' "
+             "(SPARK_BAM_SERVE env var works too; docs/serving.md)",
+    )
+    sub.add_argument(
+        "--listen", default="tcp:127.0.0.1:8765", metavar="ADDR",
+        help="unix:<path> or tcp:<host>:<port> (default tcp:127.0.0.1:8765)",
+    )
+    sub.add_argument("--reads-to-check", type=int, default=None)
+    sub.add_argument("-w", "--warn", action="store_true",
+                     help="root log level WARN")
+
     # Render a --metrics-out JSONL trace as the reference stats format.
     sub = sp.add_parser("metrics-report")
     sub.add_argument("-o", "--out", default=None, help="write output to file")
@@ -321,6 +345,15 @@ def main(argv=None) -> int:
         if getattr(args, "funnel", None) is not None:
             config = config.replace(funnel=args.funnel)
         config.funnel_enabled()  # fail early on a bad SPARK_BAM_FUNNEL
+        if getattr(args, "serve", None) is not None:
+            from spark_bam_tpu.serve import ServeConfig
+
+            ServeConfig.parse(args.serve)  # fail before any work starts
+            config = config.replace(serve=args.serve)
+        if getattr(args, "listen", None) is not None:
+            from spark_bam_tpu.serve import ServeAddress
+
+            ServeAddress(args.listen)  # fail before any work starts
         if getattr(args, "chaos", None):
             chaos_state = install_chaos(args.chaos)
     except ValueError as e:
@@ -465,6 +498,23 @@ def main(argv=None) -> int:
             p.echo(json.dumps(summary, indent=2, sort_keys=True))
             if summary["violations"]:
                 return 1
+        elif cmd == "serve":
+            from spark_bam_tpu.serve import ServeAddress, SplitService, serve_forever
+
+            service = SplitService(config)
+            addr = ServeAddress(args.listen)
+            where = addr.path if addr.kind == "unix" else f"{addr.host}:{addr.port}"
+            print(
+                f"serving on {args.listen} ({where}; "
+                f"{service.mesh.devices.size} devices) — Ctrl-C to stop",
+                file=sys.stderr,
+            )
+            try:
+                serve_forever(service, args.listen)
+            except KeyboardInterrupt:
+                pass
+            finally:
+                service.close()
         elif cmd == "metrics-report":
             from spark_bam_tpu.cli import metrics_report
 

@@ -126,6 +126,12 @@ class Config:
     # "" = defaults: plan-driven, adaptive depth). Same string-spec
     # pattern; ``remote_config`` parses it (cached).
     remote: str = ""
+    # --- serving daemon (serve/; docs/serving.md) ---
+    # Compact ServeConfig spec ("batch=16,tick=2,scan_queue=128,window=1MB";
+    # "" = defaults). Same string-spec pattern; ``serve_config`` parses it
+    # (cached). Governs the long-running split/record service's batching,
+    # admission limits, and resident-cache budgets.
+    serve: str = ""
     # --- candidate funnel (tpu/checker.py; docs/design.md) ---
     # Two-stage checker hot path: cheap fixed-block prefilter over every
     # position, full 19-flag pass only on survivors. "auto" (default)
@@ -191,6 +197,13 @@ class Config:
         from spark_bam_tpu.core.remote_plan import RemoteConfig
 
         return RemoteConfig.parse(self.remote)
+
+    @property
+    def serve_config(self):
+        """The parsed ``ServeConfig`` for this config's ``serve`` spec."""
+        from spark_bam_tpu.serve.config import ServeConfig
+
+        return ServeConfig.parse(self.serve)
 
     def funnel_enabled(self, full_masks: bool = False) -> bool:
         """Whether a projection should run the two-stage candidate funnel.

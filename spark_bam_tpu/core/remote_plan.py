@@ -74,6 +74,7 @@ class RemoteConfig:
     max_request: int = 512 << 10   # split coalesced runs beyond this
     hedge: float = 3.0            # hedge a GET at N× median latency; 0 = off
     pool: int = 64                # process-wide in-flight GET quota
+    bucket_quota: int = 0         # per-bucket in-flight GET cap; 0 = off
     cache_bytes: int = 256 << 20  # completed-segment retention budget
 
     MODES = ("auto", "plan", "legacy")
@@ -96,6 +97,10 @@ class RemoteConfig:
             )
         if self.pool < 1:
             raise ValueError(f"remote pool must be >= 1: {self.pool}")
+        if self.bucket_quota < 0:
+            raise ValueError(
+                f"remote bucket quota must be >= 0 (0 = off): {self.bucket_quota}"
+            )
         if self.hedge < 0:
             raise ValueError(f"remote hedge must be >= 0 (0 = off): {self.hedge}")
 
@@ -109,6 +114,8 @@ class RemoteConfig:
         "max_request": "max_request",
         "hedge": "hedge",
         "pool": "pool",
+        "bucket": "bucket_quota",
+        "bucket_quota": "bucket_quota",
         "cache": "cache_bytes",
         "cache_bytes": "cache_bytes",
     }
@@ -197,6 +204,58 @@ def _quota_sem(n: int) -> threading.BoundedSemaphore:
         return sem
 
 
+# The global quota bounds TOTAL wire concurrency; per-bucket quotas bound
+# each origin's share of it, so one hot bucket in a fleet load cannot
+# monopolize the pool (and cannot trip one store's rate limiting while
+# the others idle). A bucket is the origin of the channel's URL
+# (scheme://netloc); channels without a URL share the anonymous bucket "".
+_bucket_sems: "dict[tuple[str, int], threading.BoundedSemaphore]" = {}
+_bucket_inflight: "dict[str, dict[str, int]]" = {}
+
+
+def _bucket_of(inner) -> str:
+    url = getattr(inner, "url", "") or ""
+    if "://" not in url:
+        return ""
+    from urllib.parse import urlsplit
+
+    parts = urlsplit(url)
+    return f"{parts.scheme}://{parts.netloc}"
+
+
+def _bucket_sem(bucket: str, n: int) -> threading.BoundedSemaphore:
+    with _pool_lock:
+        sem = _bucket_sems.get((bucket, n))
+        if sem is None:
+            sem = _bucket_sems[(bucket, n)] = threading.BoundedSemaphore(n)
+        return sem
+
+
+def _bucket_enter(bucket: str) -> None:
+    with _pool_lock:
+        st = _bucket_inflight.setdefault(bucket, {"cur": 0, "high": 0})
+        st["cur"] += 1
+        if st["cur"] > st["high"]:
+            st["high"] = st["cur"]
+
+
+def _bucket_exit(bucket: str) -> None:
+    with _pool_lock:
+        _bucket_inflight[bucket]["cur"] -= 1
+
+
+def bucket_inflight_stats() -> "dict[str, dict[str, int]]":
+    """Per-bucket in-flight GET counters: {bucket: {cur, high}} (tests,
+    operator stats)."""
+    with _pool_lock:
+        return {b: dict(st) for b, st in _bucket_inflight.items()}
+
+
+def reset_bucket_stats() -> None:
+    with _pool_lock:
+        _bucket_inflight.clear()
+
+
 # ------------------------------------------------------------------ channel
 class PlannedChannel(ByteChannel):
     """Plan-driven read-ahead over a remote ``ByteChannel``.
@@ -240,6 +299,11 @@ class PlannedChannel(ByteChannel):
         self._depth = self.cfg.depth or 8
         self._latency = LatencyTracker()
         self._quota = _quota_sem(self.cfg.pool)
+        self._bucket = _bucket_of(inner)
+        self._bucket_quota = (
+            _bucket_sem(self._bucket, self.cfg.bucket_quota)
+            if self.cfg.bucket_quota else None
+        )
         if plan is not None:
             self.set_plan(plan)
 
@@ -278,16 +342,33 @@ class PlannedChannel(ByteChannel):
     # ------------------------------------------------------------- fetching
     def _fetch_job(self, start: int, length: int) -> bytes:
         t0 = time.perf_counter()
-        with self._quota:
+        # Bucket quota OUTSIDE the global quota: a hot bucket's excess GETs
+        # queue on their own semaphore without pinning pool-wide slots, so
+        # other buckets' fetches keep flowing.
+        if self._bucket_quota is not None:
+            self._bucket_quota.acquire()
             waited_ms = (time.perf_counter() - t0) * 1e3
             if waited_ms > 1.0:
-                obs.observe("remote.quota_wait_ms", waited_ms, unit="ms")
-            t1 = time.perf_counter()
-            data = with_retries(
-                lambda: self.inner._read_at(start, length), self.policy,
-                "remote GET",
-            )
-            ms = (time.perf_counter() - t1) * 1e3
+                obs.observe("remote.bucket_wait_ms", waited_ms, unit="ms")
+        try:
+            t0 = time.perf_counter()
+            with self._quota:
+                waited_ms = (time.perf_counter() - t0) * 1e3
+                if waited_ms > 1.0:
+                    obs.observe("remote.quota_wait_ms", waited_ms, unit="ms")
+                t1 = time.perf_counter()
+                _bucket_enter(self._bucket)
+                try:
+                    data = with_retries(
+                        lambda: self.inner._read_at(start, length), self.policy,
+                        "remote GET",
+                    )
+                finally:
+                    _bucket_exit(self._bucket)
+                ms = (time.perf_counter() - t1) * 1e3
+        finally:
+            if self._bucket_quota is not None:
+                self._bucket_quota.release()
         self._latency.record(ms)
         obs.count("remote.gets")
         obs.count("remote.bytes", len(data))

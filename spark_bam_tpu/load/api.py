@@ -27,7 +27,7 @@ from spark_bam_tpu.bgzf.find_block_start import find_block_start
 from spark_bam_tpu.bgzf.stream import SeekableBlockStream, SeekableUncompressedBytes
 from spark_bam_tpu.check.eager import EagerChecker
 from spark_bam_tpu.core.channel import open_channel, path_exists, path_size
-from spark_bam_tpu.core.config import Config
+from spark_bam_tpu.core.config import Config, parse_bytes
 from spark_bam_tpu.core.faults import (
     BlockCorruptionError,
     BlockGapError,
@@ -358,6 +358,53 @@ def _consult_split_cache(path, splits, header, config: Config, size: int):
         ),
     )
     return sbi_plan.plan_to_starts(splits, entries) or {}
+
+
+def split_starts(
+    path,
+    split_size=None,
+    config: Config = Config(),
+    pool=None,
+) -> "list[tuple[FileSplit, Pos | None]]":
+    """Resolved first-record positions for every file split of ``path`` —
+    the split-plan product without materializing a record ``Dataset``
+    (what the serve/ daemon answers ``plan`` requests with).
+
+    Cache-first: a warm ``.sbi`` split plan serves every split with ZERO
+    ``_resolve_split_start`` calls (the ``load.split_resolutions`` counter
+    stays flat — the daemon's repeat-plan fast path). Cold splits resolve
+    in parallel through ``run_partitions`` under the config's fault
+    policy; ``pool`` lends a persistent executor (the daemon's) so
+    per-request pool spin-up never lands on the hot path. ``None``
+    positions mark splits that own no record start (reference
+    ``PLAN_NONE``) or whose scan could not prove one.
+    """
+    from spark_bam_tpu.check.checker import NoReadFoundException
+    from spark_bam_tpu.parallel.executor import run_partitions
+
+    size = (
+        parse_bytes(split_size) if split_size is not None
+        else config.split_size_or(Config.LOAD_SPLIT_SIZE_DEFAULT)
+    )
+    policy = config.fault_policy
+    header = with_retries(lambda: read_header(path), policy, "read_header")
+    splits = with_retries(lambda: file_splits(path, size), policy, "file_splits")
+    resolved = dict(_consult_split_cache(path, splits, header, config, size))
+    missing = [s for s in splits if s not in resolved]
+    if missing:
+        def resolve(split):
+            try:
+                return _resolve_split_start(path, split, header, config)
+            except NoReadFoundException:
+                return None
+
+        results, _ = run_partitions(
+            resolve, missing,
+            ParallelConfig("threads", workers=min(len(missing), 8)),
+            policy, pool=pool,
+        )
+        resolved.update(zip(missing, results))
+    return [(s, resolved.get(s)) for s in splits]
 
 
 def load_reads_and_positions(

@@ -203,6 +203,7 @@ def run_partitions(
     items: Sequence[T],
     config: ParallelConfig = ParallelConfig(),
     policy: FaultPolicy | None = None,
+    pool=None,
 ) -> tuple[list[R | None], JobReport]:
     """Apply ``fn`` to every partition under ``policy``, preserving order.
 
@@ -210,6 +211,13 @@ def run_partitions(
     only) hold ``None`` in ``results`` and are listed in
     ``report.quarantined``. Strict mode raises the partition's final error
     after its retries are exhausted.
+
+    ``pool`` lends an existing executor (a ``ThreadPoolExecutor``-shaped
+    object) for the pooled modes instead of spawning one per call — the
+    serving daemon (serve/) runs many small jobs against one persistent
+    pool, where per-call pool spin-up/teardown would dominate. A lent
+    pool is never shut down here; on failure only this job's in-flight
+    futures are cancelled. Callers own sizing/lifetime.
     """
     global _last_report
     policy = policy or FaultPolicy()
@@ -228,7 +236,7 @@ def run_partitions(
     if config.mode == "sequential" or len(items) <= 1:
         results = _run_sequential(fn, items, policy, reports)
     else:
-        results = _run_pooled(fn, items, config, policy, reports)
+        results = _run_pooled(fn, items, config, policy, reports, pool=pool)
     rec1, blk1 = guard.loss_totals()
     report.lost_records = rec1 - rec0
     report.lost_blocks = blk1 - blk0
@@ -275,11 +283,12 @@ def _run_sequential(fn, items, policy, reports) -> list:
     return results
 
 
-def _run_pooled(fn, items, config, policy, reports) -> list:
+def _run_pooled(fn, items, config, policy, reports, pool=None) -> list:
     n = len(items)
     pool_cls = (
         ThreadPoolExecutor if config.mode == "threads" else ProcessPoolExecutor
     )
+    owns_pool = pool is None
     results: list = [None] * n
     resolved = [False] * n
     attempts_started = [0] * n          # non-speculative attempts submitted
@@ -289,7 +298,8 @@ def _run_pooled(fn, items, config, policy, reports) -> list:
     abandoned: set[Future] = set()      # deadline-expired but still running
     retry_due: list[tuple[float, int, int]] = []  # (due, partition, attempt)
     unresolved = n
-    pool = pool_cls(max_workers=config.num_workers)
+    if owns_pool:
+        pool = pool_cls(max_workers=config.num_workers)
 
     def submit(i: int, attempt_no: int, speculative: bool) -> None:
         if not speculative:
@@ -434,9 +444,15 @@ def _run_pooled(fn, items, config, policy, reports) -> list:
     except BaseException:
         # Strict-mode failure (or interrupt): stop feeding the pool and
         # don't join running attempts — they're discarded, not awaited.
-        pool.shutdown(wait=False, cancel_futures=True)
+        # A lent pool outlives this job: cancel only our own futures.
+        if owns_pool:
+            pool.shutdown(wait=False, cancel_futures=True)
+        else:
+            for fut in inflight:
+                fut.cancel()
         raise
-    pool.shutdown(wait=False)
+    if owns_pool:
+        pool.shutdown(wait=False)
     return results
 
 

@@ -19,6 +19,7 @@ validation (``__graft_entry__.dryrun_multichip``).
 from __future__ import annotations
 
 import functools
+import threading
 
 import numpy as np
 
@@ -32,6 +33,100 @@ from spark_bam_tpu.tpu.checker import PAD, check_window
 def make_mesh(devices=None, axis: str = "data") -> Mesh:
     devices = devices if devices is not None else jax.devices()
     return Mesh(np.array(devices), (axis,))
+
+
+class MeshSteps:
+    """Resident per-mesh step registry: shardings and jit'd ``shard_map``
+    steps built ONCE and reused for the mesh's lifetime.
+
+    Every ``make_shard_map_*_step`` call closes over fresh Python
+    functions, so calling a maker per request yields a distinct jit object
+    and a full re-trace each time — fine for one-shot batch jobs, fatal
+    for a serving daemon dispatching per tick. ``MeshSteps`` keys each
+    step by its static parameters, so same-shape requests share one
+    compiled executable (the serve/ tier's "build at startup, serve
+    forever" contract — ROADMAP item 3).
+
+    Thread-safe: the serving loop builds steps from worker threads.
+    """
+
+    def __init__(self, mesh: Mesh, axis: str = "data"):
+        self.mesh = mesh
+        self.axis = axis
+        self.data_sharding = NamedSharding(mesh, P(axis))
+        self.replicated = NamedSharding(mesh, P())
+        self._steps: dict = {}
+        self._lock = threading.Lock()
+
+    def put(self, arr):
+        """Place a batch-dim array with ``P(axis)`` sharding."""
+        return jax.device_put(arr, self.data_sharding)
+
+    def put_replicated(self, arr):
+        return jax.device_put(arr, self.replicated)
+
+    def _get(self, key, maker):
+        with self._lock:
+            step = self._steps.get(key)
+            if step is None:
+                step = self._steps[key] = maker()
+            return step
+
+    def count_step(self, reads_to_check: int = 10, flags_impl: str = "xla",
+                   funnel: bool = False):
+        return self._get(
+            ("count", reads_to_check, flags_impl, funnel),
+            lambda: make_shard_map_count_step(
+                self.mesh, reads_to_check=reads_to_check, axis=self.axis,
+                flags_impl=flags_impl, funnel=funnel,
+            ),
+        )
+
+    def confusion_step(self, reads_to_check: int = 10,
+                       flags_impl: str = "xla", funnel: bool = False):
+        return self._get(
+            ("confusion", reads_to_check, flags_impl, funnel),
+            lambda: make_shard_map_confusion_step(
+                self.mesh, reads_to_check=reads_to_check, axis=self.axis,
+                flags_impl=flags_impl, funnel=funnel,
+            ),
+        )
+
+    def full_step(self, reads_to_check: int = 10, flags_impl: str = "xla",
+                  k_positions: int = 4096):
+        return self._get(
+            ("full", reads_to_check, flags_impl, k_positions),
+            lambda: make_shard_map_full_step(
+                self.mesh, reads_to_check=reads_to_check, axis=self.axis,
+                flags_impl=flags_impl, k_positions=k_positions,
+            ),
+        )
+
+    def serve_step(self, reads_to_check: int = 10, flags_impl: str = "xla",
+                   funnel: bool = False):
+        return self._get(
+            ("serve", reads_to_check, flags_impl, funnel),
+            lambda: make_shard_map_serve_step(
+                self.mesh, reads_to_check=reads_to_check, axis=self.axis,
+                flags_impl=flags_impl, funnel=funnel,
+            ),
+        )
+
+
+_mesh_steps: dict = {}
+_mesh_steps_lock = threading.Lock()
+
+
+def mesh_steps(mesh: Mesh, axis: str = "data") -> MeshSteps:
+    """The process-wide ``MeshSteps`` registry for ``mesh`` — every tier
+    (stream_mesh workloads, the serve/ daemon) shares the same compiled
+    steps instead of rebuilding them per call."""
+    key = (mesh, axis)
+    with _mesh_steps_lock:
+        st = _mesh_steps.get(key)
+        if st is None:
+            st = _mesh_steps[key] = MeshSteps(mesh, axis)
+        return st
 
 
 def init_distributed(
@@ -113,9 +208,12 @@ def shard_windows(
     windows: np.ndarray,
     axis: str = "data",
 ):
-    """Place a (B, W+PAD) batch with batch-dim sharding over the mesh."""
-    sharding = NamedSharding(mesh, P(axis))
-    return jax.device_put(windows, sharding)
+    """Place a (B, W+PAD) batch with batch-dim sharding over the mesh.
+
+    Delegates to the mesh's cached ``MeshSteps`` shardings so repeated
+    placements (a serving loop's per-tick batches) reuse one
+    ``NamedSharding`` instead of constructing it per call."""
+    return mesh_steps(mesh, axis).put(windows)
 
 
 def _shard_map_compat():
@@ -396,6 +494,56 @@ def make_shard_map_full_step(
             mesh=mesh,
             in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis), P(), P()),
             out_specs=(P(), P(axis), P(axis), P(axis), P(axis)),
+            check_rep=False,
+        )
+    )
+
+
+def make_shard_map_serve_step(
+    mesh: Mesh, reads_to_check: int = 10, axis: str = "data",
+    flags_impl: str = "xla", funnel: bool = False,
+):
+    """Sharded serving step: PER-ROW (boundary count, owned escapes) with
+    NO cross-device reduction — ``out_specs=P(axis)`` keeps each row's
+    pair on its shard so the host can scatter results back to the
+    individual requests a batch coalesced (parallel/serve batching).
+
+    Unlike the count step, ``lengths``/``num_contigs`` are per-row
+    ``(B, Cmax)`` / ``(B,)`` inputs sharded with the batch: rows from
+    DIFFERENT files (different contig dictionaries) share one dispatch,
+    which is what lets a serving tick batch a fleet of BAMs together.
+    The batch shape is fixed by the caller (pad to ``batch_rows``), so
+    the jit traces exactly once per step config.
+    """
+    shard_map = _shard_map_compat()
+    pallas_interpret = (
+        flags_impl == "pallas"
+        and mesh.devices.flat[0].platform != "tpu"
+    )
+
+    def one(window, n, at_eof, lo, own, lengths, num_contigs):
+        res = check_window(
+            window, lengths, num_contigs, n, at_eof,
+            reads_to_check=reads_to_check, flags_impl=flags_impl,
+            pallas_interpret=pallas_interpret, funnel=funnel,
+        )
+        w = window.shape[0] - PAD
+        i = jnp.arange(w, dtype=jnp.int32)
+        m = (i >= lo) & (i < own)
+        return jnp.stack([
+            jnp.sum((res["verdict"] & m).astype(jnp.int32)),
+            jnp.sum((res["escaped"] & m).astype(jnp.int32)),
+        ])
+
+    def local_step(windows, ns, at_eofs, los, owns, lengths, ncs):
+        return jax.vmap(one)(windows, ns, at_eofs, los, owns, lengths, ncs)
+
+    return jax.jit(
+        shard_map(
+            local_step,
+            mesh=mesh,
+            in_specs=(P(axis),) * 7,
+            out_specs=P(axis),
             check_rep=False,
         )
     )

@@ -55,11 +55,7 @@ from spark_bam_tpu.bgzf.block import MAX_BLOCK_SIZE
 from spark_bam_tpu.bgzf.flat import inflate_blocks
 from spark_bam_tpu.core.channel import open_channel
 from spark_bam_tpu.core.config import Config
-from spark_bam_tpu.parallel.mesh import (
-    make_mesh,
-    make_shard_map_confusion_step,
-    make_shard_map_count_step,
-)
+from spark_bam_tpu.parallel.mesh import make_mesh, mesh_steps
 from spark_bam_tpu.tpu.checker import PAD
 from spark_bam_tpu.tpu.inflate import (
     inflate_group_device,
@@ -450,8 +446,10 @@ def count_reads_sharded(
         num_processes=num_processes, process_id=process_id,
         chunk_bytes=chunk_bytes,
     )
-    step = make_shard_map_count_step(
-        st.mesh, reads_to_check=config.reads_to_check, axis=st.axis,
+    # Cached per (mesh, params): repeat invocations — and the serve/
+    # daemon's ticks — reuse one traced executable instead of re-jitting.
+    step = mesh_steps(st.mesh, st.axis).count_step(
+        reads_to_check=config.reads_to_check,
         flags_impl=config.flags_impl, funnel=config.funnel_enabled(),
     )
     count = escapes = steps = 0
@@ -557,7 +555,6 @@ def full_check_summary_sharded(
     outputs; multi-host full-check would need an all-gather of variable
     site lists)."""
     from spark_bam_tpu.check.flags import FLAG_NAMES
-    from spark_bam_tpu.parallel.mesh import make_shard_map_full_step
 
     if jax.process_count() > 1:
         raise NotImplementedError(
@@ -568,8 +565,8 @@ def full_check_summary_sharded(
     st = _ShardedStream(
         path, config, mesh, window_uncompressed, halo, metas
     )
-    step = make_shard_map_full_step(
-        st.mesh, reads_to_check=config.reads_to_check, axis=st.axis,
+    step = mesh_steps(st.mesh, st.axis).full_step(
+        reads_to_check=config.reads_to_check,
         flags_impl=config.flags_impl, k_positions=k_positions,
     )
     n_flags = len(FLAG_NAMES)
@@ -826,8 +823,8 @@ def check_bam_sharded(
         with_truth=True, num_processes=num_processes, process_id=process_id,
     )
     truth_flats = _truth_flats(path, records_path, st.metas)
-    step = make_shard_map_confusion_step(
-        st.mesh, reads_to_check=config.reads_to_check, axis=st.axis,
+    step = mesh_steps(st.mesh, st.axis).confusion_step(
+        reads_to_check=config.reads_to_check,
         flags_impl=config.flags_impl, funnel=config.funnel_enabled(),
     )
 

@@ -365,6 +365,33 @@ class CacheStore:
         except OSError:
             pass  # eviction is best-effort; the cache stays correct
 
+
+# ------------------------------------------------------------ shared store
+_shared: "CacheStore | None" = None
+_shared_lock = threading.Lock()
+
+
+def shared_store(policy: FaultPolicy | None = None) -> CacheStore:
+    """The process-wide ``CacheStore`` (env-resolved once) — the serving
+    daemon's shared index tier: every request consults ONE store instance
+    instead of re-reading ``SPARK_BAM_CACHE_DIR``/budget per call. The
+    store itself is stateless (sidecars live on disk), so sharing is safe;
+    a caller-supplied ``policy`` on first use pins the retry policy for
+    the daemon's lifetime."""
+    global _shared
+    with _shared_lock:
+        if _shared is None:
+            _shared = CacheStore.from_env(policy=policy)
+        return _shared
+
+
+def reset_shared_store() -> None:
+    """Drop the memoized store (tests that repoint SPARK_BAM_CACHE_DIR)."""
+    global _shared
+    with _shared_lock:
+        _shared = None
+
+
 # ------------------------------------------------------- block-table tier
 def cached_blocks(bam_path, config=None):
     """The ``.sbi`` block table for ``bam_path``, or None (cache off /

@@ -1,0 +1,51 @@
+"""Sharded split service: a long-running daemon over the device mesh.
+
+Promotes the one-shot CLI paths (plan/count/record-starts/fleet) into a
+serving loop that keeps compiled mesh steps, flat views and the ``.sbi``
+index tier warm across requests, coalesces concurrent requests into one
+device dispatch per tick, and sheds load with typed responses when the
+queue is full. See docs/serving.md.
+"""
+
+from spark_bam_tpu.serve.admission import AdmissionGate, Overloaded
+from spark_bam_tpu.serve.batcher import Batcher, RowTask
+from spark_bam_tpu.serve.client import ServeClient, ServeClientError
+from spark_bam_tpu.serve.config import MAX_CONTIGS, ServeConfig
+from spark_bam_tpu.serve.protocol import (
+    OPS,
+    ProtocolError,
+    decode_request,
+    encode,
+    error_response,
+    ok_response,
+)
+from spark_bam_tpu.serve.server import (
+    ServeAddress,
+    ServerThread,
+    serve_forever,
+    start_server,
+)
+from spark_bam_tpu.serve.service import ServiceError, SplitService
+
+__all__ = [
+    "AdmissionGate",
+    "Batcher",
+    "MAX_CONTIGS",
+    "OPS",
+    "Overloaded",
+    "ProtocolError",
+    "RowTask",
+    "ServeAddress",
+    "ServeClient",
+    "ServeClientError",
+    "ServeConfig",
+    "ServerThread",
+    "ServiceError",
+    "SplitService",
+    "decode_request",
+    "encode",
+    "error_response",
+    "ok_response",
+    "serve_forever",
+    "start_server",
+]

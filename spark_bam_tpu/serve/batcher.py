@@ -1,0 +1,186 @@
+"""Request batcher: coalesce concurrent window rows into one device tick.
+
+Scan-class requests (count/fleet) are expanded by the service into
+window-row tasks; the batcher gathers rows arriving within ``tick_ms``
+of the first, pads to the FIXED batch shape ``(batch_rows, window+PAD)``
+and dispatches the mesh-cached serve step exactly once per tick. Fixed
+shape + cached step ⇒ one trace at warm-up, zero re-traces in steady
+state, which is the entire perf story of the daemon (docs/serving.md).
+
+Rows from different files coalesce in one tick: the serve step takes
+per-row contig dictionaries, so batching is purely shape-keyed.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import Counter, deque
+from concurrent.futures import Future
+
+import numpy as np
+
+from spark_bam_tpu import obs
+from spark_bam_tpu.serve.config import MAX_CONTIGS
+from spark_bam_tpu.tpu.checker import PAD
+
+
+class RowTask:
+    """One window row awaiting a device verdict.
+
+    ``future`` resolves to ``(boundary_count, escaped_count)`` for the
+    row's owned span, or to ``TimeoutError`` when the owning request's
+    deadline passed while the row was still queued (load shedding).
+    """
+
+    __slots__ = ("window", "n", "at_eof", "lo", "own", "lengths", "nc",
+                 "deadline_ts", "enqueued_ts", "future")
+
+    def __init__(self, window, n, at_eof, lo, own, lengths, nc,
+                 deadline_ts=None):
+        self.window = window          # (W+PAD,) uint8, already padded
+        self.n = int(n)
+        self.at_eof = bool(at_eof)
+        self.lo = int(lo)
+        self.own = int(own)
+        self.lengths = lengths        # (MAX_CONTIGS,) int32
+        self.nc = int(nc)
+        self.deadline_ts = deadline_ts  # monotonic seconds or None
+        self.enqueued_ts = time.monotonic()
+        self.future: Future = Future()
+
+
+class Batcher:
+    """Tick loop turning queued :class:`RowTask`s into serve-step calls."""
+
+    def __init__(self, steps, width: int, batch_rows: int, tick_ms: float,
+                 reads_to_check: int = 10, flags_impl: str = "xla",
+                 funnel: bool = False):
+        ndev = steps.mesh.devices.size
+        self.steps = steps
+        self.width = int(width)                      # window + PAD
+        self.batch_rows = -(-int(batch_rows) // ndev) * ndev
+        self.tick_s = float(tick_ms) / 1000.0
+        self._step = steps.serve_step(
+            reads_to_check=reads_to_check, flags_impl=flags_impl,
+            funnel=funnel,
+        )
+        self._queue: "deque[RowTask]" = deque()
+        self._cond = threading.Condition()
+        self._running = threading.Event()
+        self._running.set()
+        self._closed = False
+        self.batch_sizes: "Counter[int]" = Counter()
+        self._thread = threading.Thread(
+            target=self._loop, name="serve-batcher", daemon=True
+        )
+        self._thread.start()
+
+    def submit(self, task: RowTask) -> Future:
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("batcher is closed")
+            self._queue.append(task)
+            self._cond.notify()
+        return task.future
+
+    def pause(self) -> None:
+        """Hold dispatch (tests use this to force a full-batch coalesce)."""
+        self._running.clear()
+
+    def resume(self) -> None:
+        self._running.set()
+        with self._cond:
+            self._cond.notify()
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify()
+        self._running.set()
+        self._thread.join(timeout=10)
+        for t in list(self._queue):
+            t.future.set_exception(RuntimeError("batcher closed"))
+        self._queue.clear()
+
+    # ------------------------------------------------------------------
+
+    def _take_batch(self) -> "list[RowTask]":
+        """Block for the first row, then gather up to ``batch_rows`` rows
+        arriving within one tick. Returns [] only at close."""
+        with self._cond:
+            while not self._queue and not self._closed:
+                self._cond.wait(0.05)
+            if not self._queue:
+                return []
+            deadline = time.monotonic() + self.tick_s
+            while len(self._queue) < self.batch_rows:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    break
+                self._cond.wait(left)
+            batch = []
+            while self._queue and len(batch) < self.batch_rows:
+                batch.append(self._queue.popleft())
+            return batch
+
+    def _loop(self) -> None:
+        while True:
+            self._running.wait()
+            batch = self._take_batch()
+            if not batch and self._closed:
+                return
+            if not batch:
+                continue
+            # Shed rows whose request deadline already passed.
+            now = time.monotonic()
+            live = []
+            for t in batch:
+                if t.deadline_ts is not None and now > t.deadline_ts:
+                    obs.count("serve.shed")
+                    t.future.set_exception(
+                        TimeoutError("deadline expired in serve queue")
+                    )
+                else:
+                    live.append(t)
+            if not live:
+                continue
+            try:
+                self._dispatch(live)
+            except BaseException as exc:  # scatter failure to every row
+                for t in live:
+                    if not t.future.done():
+                        t.future.set_exception(exc)
+
+    def _dispatch(self, batch: "list[RowTask]") -> None:
+        B, width = self.batch_rows, self.width
+        ws = np.zeros((B, width), dtype=np.uint8)
+        ns = np.zeros(B, dtype=np.int32)
+        eofs = np.zeros(B, dtype=bool)
+        los = np.zeros(B, dtype=np.int32)
+        owns = np.zeros(B, dtype=np.int32)
+        lens = np.zeros((B, MAX_CONTIGS), dtype=np.int32)
+        ncs = np.ones(B, dtype=np.int32)  # benign dict for padding rows
+        now = time.monotonic()
+        for i, t in enumerate(batch):
+            ws[i, : len(t.window)] = t.window
+            ns[i] = t.n
+            eofs[i] = t.at_eof
+            los[i] = t.lo
+            owns[i] = t.own
+            lens[i, : len(t.lengths)] = t.lengths
+            ncs[i] = t.nc
+            obs.observe("serve.queue_ms", (now - t.enqueued_ts) * 1000.0)
+        # Padding rows keep lo == own == 0: empty owned span, zero counts.
+        put = self.steps.put
+        out = self._step(
+            put(ws), put(ns), put(eofs), put(los), put(owns),
+            put(lens), put(ncs),
+        )
+        res = np.asarray(out)
+        self.batch_sizes[len(batch)] += 1
+        obs.count("serve.batches")
+        obs.observe("serve.batch_rows", len(batch))
+        for i, t in enumerate(batch):
+            if not t.future.done():
+                t.future.set_result((int(res[i, 0]), int(res[i, 1])))

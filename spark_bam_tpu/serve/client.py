@@ -1,0 +1,71 @@
+"""Minimal blocking client for the split service (tests, bench, scripts).
+
+One socket, one request at a time; the server supports pipelining but
+this client keeps the common case trivial. Raises
+:class:`ServeClientError` for non-ok responses so callers get typed
+failures instead of dicts to inspect.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+
+from spark_bam_tpu.serve.server import MAX_LINE, ServeAddress
+
+
+class ServeClientError(RuntimeError):
+    """Server answered ``ok: false``; ``error``/``retry_after_ms`` attached."""
+
+    def __init__(self, resp: dict):
+        self.resp = resp
+        self.error = resp.get("error", "Internal")
+        self.retry_after_ms = resp.get("retry_after_ms")
+        super().__init__(f"{self.error}: {resp.get('message', '')}")
+
+
+class ServeClient:
+    def __init__(self, address, timeout: float = 120.0):
+        """``address`` is a spec string (``tcp:host:port`` / ``unix:path``),
+        a ``(host, port)`` tuple, or a unix socket path."""
+        if isinstance(address, tuple):
+            self._sock = socket.create_connection(address, timeout=timeout)
+        else:
+            addr = ServeAddress(str(address) if str(address).startswith(("unix:", "tcp:"))
+                                else ("unix:" + str(address) if "/" in str(address)
+                                      else str(address)))
+            if addr.kind == "unix":
+                self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                self._sock.settimeout(timeout)
+                self._sock.connect(addr.path)
+            else:
+                self._sock = socket.create_connection(
+                    (addr.host, addr.port), timeout=timeout
+                )
+        self._rfile = self._sock.makefile("rb")
+        self._next_id = 0
+
+    def request(self, op: str, **fields) -> dict:
+        """Send one request and block for its response payload."""
+        self._next_id += 1
+        req = {"op": op, "id": self._next_id, **fields}
+        self._sock.sendall((json.dumps(req) + "\n").encode())
+        line = self._rfile.readline(MAX_LINE)
+        if not line:
+            raise ConnectionError("server closed the connection")
+        resp = json.loads(line)
+        if not resp.get("ok"):
+            raise ServeClientError(resp)
+        return resp
+
+    def close(self) -> None:
+        try:
+            self._rfile.close()
+        finally:
+            self._sock.close()
+
+    def __enter__(self) -> "ServeClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,104 @@
+"""Serving-daemon knobs: batching, admission limits, resident budgets.
+
+Parsed from the same compact ``k=v,...`` spec pattern as ``FaultPolicy``/
+``RemoteConfig`` so it threads through ``Config.serve`` /
+``SPARK_BAM_SERVE`` / ``--serve`` unchanged. Tuning notes in
+docs/serving.md.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from functools import lru_cache
+
+from spark_bam_tpu.core.config import parse_bytes
+
+#: Per-row contig-dictionary capacity of the serve step. Fixed so every
+#: batch shares ONE compiled shape regardless of which files it mixes;
+#: a file with more contigs is answered with a typed error (docs/serving.md).
+MAX_CONTIGS = 1024
+
+
+@dataclass(frozen=True)
+class ServeConfig:
+    """Knobs for the long-running split/record service (serve/)."""
+
+    batch_rows: int = 8           # window rows per device dispatch (rounded
+                                  # up to a mesh-size multiple at startup)
+    tick_ms: float = 2.0          # batcher gather window after first arrival
+    plan_queue: int = 64          # admission cap, plan class (plan/record_starts)
+    scan_queue: int = 64          # admission cap, scan class (count/fleet)
+    workers: int = 2              # plan-class handler / row-prep threads
+    window: int = 1 << 20         # uncompressed bytes per row window
+    halo: int = 1 << 16           # trailing lookahead per row
+    flat_cache: int = 256 << 20   # resident flat-view byte budget (LRU)
+
+    def __post_init__(self):
+        if self.batch_rows < 1 or self.workers < 1:
+            raise ValueError(
+                f"serve batch_rows/workers must be >= 1: "
+                f"{self.batch_rows}/{self.workers}"
+            )
+        if self.tick_ms < 0:
+            raise ValueError(f"serve tick must be >= 0 ms: {self.tick_ms}")
+        if self.plan_queue < 1 or self.scan_queue < 1:
+            raise ValueError(
+                f"serve queue limits must be >= 1: "
+                f"plan={self.plan_queue} scan={self.scan_queue}"
+            )
+        if self.halo < 1 or self.window <= self.halo:
+            raise ValueError(
+                f"serve window {self.window} must exceed halo {self.halo} "
+                "(>= 1)"
+            )
+        if self.flat_cache < 1:
+            raise ValueError(f"serve flat cache must be >= 1: {self.flat_cache}")
+
+    _KEYS = {
+        "batch": "batch_rows",
+        "batch_rows": "batch_rows",
+        "tick": "tick_ms",
+        "tick_ms": "tick_ms",
+        "plan_queue": "plan_queue",
+        "planq": "plan_queue",
+        "scan_queue": "scan_queue",
+        "scanq": "scan_queue",
+        "workers": "workers",
+        "window": "window",
+        "halo": "halo",
+        "cache": "flat_cache",
+        "flat_cache": "flat_cache",
+    }
+    _BYTE_KEYS = ("window", "halo", "flat_cache")
+
+    @staticmethod
+    @lru_cache(maxsize=64)
+    def parse(spec: str) -> "ServeConfig":
+        """``"batch=16,tick=2,scan_queue=128,window=1MB,halo=64KB"`` (any
+        subset; ``""`` ⇒ defaults). Byte-valued keys take size shorthand."""
+        kw: dict = {}
+        for part in (spec or "").split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if "=" not in part:
+                raise ValueError(f"Bad serve-config entry {part!r} in {spec!r}")
+            key, value = (t.strip() for t in part.split("=", 1))
+            field = ServeConfig._KEYS.get(key.replace("-", "_"))
+            if field is None:
+                raise ValueError(
+                    f"Unknown serve-config key {key!r}: expected one of "
+                    f"{', '.join(sorted(set(ServeConfig._KEYS)))}"
+                )
+            if field in ServeConfig._BYTE_KEYS:
+                kw[field] = parse_bytes(value)
+            elif field == "tick_ms":
+                kw[field] = float(value)
+            else:
+                kw[field] = int(value)
+        return ServeConfig(**kw)
+
+    @staticmethod
+    def from_env(env=None) -> "ServeConfig":
+        return ServeConfig.parse((env or os.environ).get("SPARK_BAM_SERVE", ""))

@@ -1,0 +1,50 @@
+"""Newline-delimited JSON wire protocol for the split service.
+
+One request object per line, one response object per line. Requests carry
+an ``op`` plus op-specific fields and an optional client-chosen ``id``
+echoed back verbatim, so clients may pipeline. Responses are either
+
+    {"id": ..., "ok": true, ...payload}
+    {"id": ..., "ok": false, "error": "<Type>", "message": "...", ...}
+
+Error types are stable strings (``Overloaded``, ``DeadlineExceeded``,
+``ProtocolError``, ``NotFound``, ``Unsupported``, ``Internal``) —
+docs/serving.md tabulates them.
+"""
+
+from __future__ import annotations
+
+import json
+
+#: ops answered by the service; anything else is a ProtocolError.
+OPS = ("ping", "stats", "plan", "record_starts", "count", "fleet")
+
+
+class ProtocolError(ValueError):
+    """Malformed request line (bad JSON, missing/unknown fields)."""
+
+
+def decode_request(line: "str | bytes") -> dict:
+    try:
+        req = json.loads(line)
+    except Exception as exc:
+        raise ProtocolError(f"request is not valid JSON: {exc}") from exc
+    if not isinstance(req, dict):
+        raise ProtocolError(f"request must be a JSON object, got {type(req).__name__}")
+    op = req.get("op")
+    if op not in OPS:
+        raise ProtocolError(f"unknown op {op!r}: expected one of {', '.join(OPS)}")
+    return req
+
+
+def encode(obj: dict) -> bytes:
+    return (json.dumps(obj, separators=(",", ":"), sort_keys=True) + "\n").encode()
+
+
+def ok_response(req: dict, **payload) -> dict:
+    return {"id": req.get("id"), "ok": True, **payload}
+
+
+def error_response(req: dict, error: str, message: str, **extra) -> dict:
+    return {"id": req.get("id"), "ok": False, "error": error,
+            "message": message, **extra}

@@ -1,0 +1,191 @@
+"""Async accept loop: newline-JSON over a unix socket or localhost TCP.
+
+The event loop only parses lines and shuttles futures — all real work
+happens on the service's worker pool and the batcher thread, so a slow
+request never stalls accepts. Each connection may pipeline requests;
+responses carry the client's ``id`` and may complete out of order.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+
+from spark_bam_tpu import obs
+from spark_bam_tpu.serve.admission import Overloaded
+from spark_bam_tpu.serve.protocol import (
+    ProtocolError,
+    decode_request,
+    encode,
+    error_response,
+)
+from spark_bam_tpu.serve.service import SplitService
+
+#: Longest accepted request line; beyond this the connection is dropped.
+MAX_LINE = 4 << 20
+
+
+async def _handle_connection(service: SplitService, reader, writer) -> None:
+    obs.count("serve.connections")
+    wlock = asyncio.Lock()
+
+    async def write(resp: dict) -> None:
+        async with wlock:
+            writer.write(encode(resp))
+            await writer.drain()
+
+    async def one(req: dict) -> None:
+        try:
+            fut = service.submit(req)
+        except Overloaded as exc:
+            await write(error_response(
+                req, "Overloaded", str(exc),
+                retry_after_ms=exc.retry_after_ms,
+            ))
+            return
+        await write(await asyncio.wrap_future(fut))
+
+    pending: "set[asyncio.Task]" = set()
+    try:
+        while True:
+            try:
+                line = await reader.readline()
+            except (asyncio.LimitOverrunError, ValueError):
+                await write(error_response(
+                    {}, "ProtocolError", f"request line exceeds {MAX_LINE} bytes"
+                ))
+                break
+            if not line:
+                break
+            if not line.strip():
+                continue
+            try:
+                req = decode_request(line)
+            except ProtocolError as exc:
+                await write(error_response({}, "ProtocolError", str(exc)))
+                continue
+            task = asyncio.ensure_future(one(req))
+            pending.add(task)
+            task.add_done_callback(pending.discard)
+        if pending:
+            await asyncio.gather(*pending, return_exceptions=True)
+    finally:
+        for task in pending:
+            task.cancel()
+        writer.close()
+        try:
+            await writer.wait_closed()
+        except Exception:
+            pass
+
+
+class ServeAddress:
+    """Where a server listens: ``unix:<path>`` or ``tcp:<host>:<port>``."""
+
+    def __init__(self, spec: str):
+        self.spec = spec
+        if spec.startswith("unix:"):
+            self.kind = "unix"
+            self.path = spec[len("unix:"):]
+            if not self.path:
+                raise ValueError(f"empty unix socket path in {spec!r}")
+        else:
+            body = spec[len("tcp:"):] if spec.startswith("tcp:") else spec
+            host, _, port = body.rpartition(":")
+            self.kind = "tcp"
+            self.host = host or "127.0.0.1"
+            try:
+                self.port = int(port)
+            except ValueError:
+                raise ValueError(
+                    f"bad serve address {spec!r}: expected unix:<path> or "
+                    "tcp:<host>:<port>"
+                ) from None
+
+
+async def start_server(service: SplitService, address: ServeAddress):
+    """Start listening; returns the ``asyncio.AbstractServer``."""
+    handler = lambda r, w: _handle_connection(service, r, w)
+    if address.kind == "unix":
+        return await asyncio.start_unix_server(
+            handler, path=address.path, limit=MAX_LINE
+        )
+    return await asyncio.start_server(
+        handler, host=address.host, port=address.port, limit=MAX_LINE
+    )
+
+
+class ServerThread:
+    """In-process server with its own event loop (bench/tests/embedders).
+
+    ``with ServerThread(service, "tcp:127.0.0.1:0") as srv:`` exposes
+    ``srv.address`` (``(host, port)`` or unix path) while the calling
+    thread stays free to act as a client.
+    """
+
+    def __init__(self, service: SplitService, spec: str = "tcp:127.0.0.1:0"):
+        self.service = service
+        self.addr = ServeAddress(spec)
+        self.loop = asyncio.new_event_loop()
+        self._server = None
+        self._started = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="serve-loop", daemon=True
+        )
+
+    def _run(self) -> None:
+        asyncio.set_event_loop(self.loop)
+
+        async def boot():
+            self._server = await start_server(self.service, self.addr)
+            self._started.set()
+
+        self.loop.run_until_complete(boot())
+        self.loop.run_forever()
+        leftovers = asyncio.all_tasks(self.loop)
+        for task in leftovers:
+            task.cancel()
+        if leftovers:
+            self.loop.run_until_complete(
+                asyncio.gather(*leftovers, return_exceptions=True)
+            )
+        self.loop.run_until_complete(self.loop.shutdown_asyncgens())
+        self.loop.close()
+
+    def start(self) -> "ServerThread":
+        self._thread.start()
+        if not self._started.wait(timeout=30):
+            raise RuntimeError("serve loop failed to start")
+        return self
+
+    @property
+    def address(self):
+        if self.addr.kind == "unix":
+            return self.addr.path
+        return self._server.sockets[0].getsockname()[:2]
+
+    def stop(self) -> None:
+        def _shutdown():
+            if self._server is not None:
+                self._server.close()
+            self.loop.stop()
+
+        self.loop.call_soon_threadsafe(_shutdown)
+        self._thread.join(timeout=10)
+
+    def __enter__(self) -> "ServerThread":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+def serve_forever(service: SplitService, spec: str) -> None:
+    """Blocking accept loop for the CLI ``serve`` subcommand."""
+
+    async def main():
+        server = await start_server(service, ServeAddress(spec))
+        async with server:
+            await server.serve_forever()
+
+    asyncio.run(main())

@@ -1,0 +1,404 @@
+"""The split service: warm state + handlers behind an admission gate.
+
+Long-running counterpart of the one-shot CLI paths. Three resident
+tiers do the work the one-shot paths rebuild per invocation:
+
+- ``MeshSteps`` (parallel/mesh.py): jit'd ``shard_map`` steps compiled
+  once at warm-up, reused for every dispatch — no per-request re-trace.
+- ``_FileState`` LRU: flat views + contig dictionaries + lazy record
+  starts per file, bounded by ``ServeConfig.flat_cache`` bytes.
+- The shared ``.sbi`` ``CacheStore`` (sbi/store.shared_store): repeat
+  plan requests resolve entirely from the sidecar index — zero
+  ``load.split_resolutions``.
+
+Scan-class requests are cut into window rows and answered through the
+:class:`~spark_bam_tpu.serve.batcher.Batcher`; plan-class requests run
+on a small worker pool against the index tier. Admission, deadlines and
+shedding are described in docs/serving.md.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import OrderedDict
+from concurrent.futures import Future, ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FutureTimeout
+
+import numpy as np
+
+from spark_bam_tpu import obs
+from spark_bam_tpu.bam.header import read_header
+from spark_bam_tpu.bgzf.flat import flatten_file
+from spark_bam_tpu.core.config import Config
+from spark_bam_tpu.core.faults import LatencyTracker
+from spark_bam_tpu.parallel.mesh import make_mesh, mesh_steps
+from spark_bam_tpu.serve.admission import CLASS_OF, AdmissionGate
+from spark_bam_tpu.serve.batcher import Batcher, RowTask
+from spark_bam_tpu.serve.config import MAX_CONTIGS, ServeConfig
+from spark_bam_tpu.serve.protocol import error_response, ok_response
+from spark_bam_tpu.tpu.checker import PAD
+from spark_bam_tpu.tpu.stream_check import pad_contig_lengths
+
+#: Retry-After fallback before the latency tracker has enough samples.
+_RETRY_AFTER_DEFAULT_MS = 50.0
+
+
+class ServiceError(Exception):
+    """Handler failure with a stable wire ``error`` type (docs/serving.md)."""
+
+    def __init__(self, error: str, message: str, **extra):
+        self.error = error
+        self.extra = extra
+        super().__init__(message)
+
+
+class _FileState:
+    """Warm per-file tier: flat view, contig dictionary, lazy starts."""
+
+    def __init__(self, path: str, config: Config):
+        self.path = str(path)
+        st = os.stat(self.path)
+        self.stamp = (st.st_size, st.st_mtime_ns)
+        header = read_header(self.path)
+        lens_list = header.contig_lengths.lengths_list()
+        if len(lens_list) > MAX_CONTIGS:
+            raise ServiceError(
+                "Unsupported",
+                f"{self.path}: {len(lens_list)} contigs exceeds the serve "
+                f"step's fixed dictionary ({MAX_CONTIGS}); use the one-shot "
+                "CLI path",
+            )
+        self.lengths = pad_contig_lengths(
+            np.asarray(lens_list, dtype=np.int32), cmax=MAX_CONTIGS
+        )
+        self.nc = len(lens_list)
+        self.header_end = header.uncompressed_size
+        self.flat = flatten_file(self.path)
+        self.nbytes = int(self.flat.data.nbytes)
+        self._starts: "np.ndarray | None" = None
+        self._starts_lock = threading.Lock()
+
+    def fresh(self) -> bool:
+        try:
+            st = os.stat(self.path)
+        except OSError:
+            return False
+        return (st.st_size, st.st_mtime_ns) == self.stamp
+
+    def starts(self, config: Config) -> np.ndarray:
+        """Exact whole-file record starts (cache-aware; the escape /
+        plan-exactness fallback). Computed once, kept warm."""
+        with self._starts_lock:
+            if self._starts is None:
+                from spark_bam_tpu.load.tpu_load import record_starts
+
+                self._starts = np.asarray(
+                    record_starts(self.path, config).starts, dtype=np.int64
+                )
+            return self._starts
+
+
+class SplitService:
+    """Handlers + warm tiers; see module docstring. Thread-safe."""
+
+    def __init__(self, config: Config = Config(), mesh=None):
+        self.config = config
+        self.serve_cfg: ServeConfig = config.serve_config
+        self.policy = config.fault_policy
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.steps = mesh_steps(self.mesh)
+        self.batcher = Batcher(
+            self.steps,
+            width=self.serve_cfg.window + PAD,
+            batch_rows=self.serve_cfg.batch_rows,
+            tick_ms=self.serve_cfg.tick_ms,
+            reads_to_check=config.reads_to_check,
+            flags_impl=config.flags_impl,
+            funnel=config.funnel_enabled(),
+        )
+        self.gate = AdmissionGate({
+            "plan": self.serve_cfg.plan_queue,
+            "scan": self.serve_cfg.scan_queue,
+        })
+        self.pool = ThreadPoolExecutor(
+            max_workers=self.serve_cfg.workers, thread_name_prefix="serve-worker"
+        )
+        # Split resolution fans out beneath a plan handler; a separate pool
+        # keeps that nesting from deadlocking the request workers.
+        self.resolve_pool = ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix="serve-resolve"
+        )
+        self.latency = LatencyTracker()
+        self._files: "OrderedDict[str, _FileState]" = OrderedDict()
+        self._files_lock = threading.Lock()
+        self.served = 0
+        self._closed = False
+
+    # ------------------------------------------------------------ lifecycle
+    def close(self) -> None:
+        self._closed = True
+        self.batcher.close()
+        self.pool.shutdown(wait=False, cancel_futures=True)
+        self.resolve_pool.shutdown(wait=False, cancel_futures=True)
+
+    # ------------------------------------------------------------ admission
+    def retry_after_ms(self) -> float:
+        med = self.latency.median()
+        return med if med is not None else _RETRY_AFTER_DEFAULT_MS
+
+    def submit(self, req: dict) -> "Future[dict]":
+        """Admit ``req`` and return a future resolving to the full response
+        dict. Raises :class:`Overloaded` synchronously when the request
+        class is at its inflight limit; every other failure becomes a typed
+        error *response* on the future."""
+        fut: "Future[dict]" = Future()
+        op = req.get("op")
+        if op == "ping":
+            fut.set_result(ok_response(req, pong=True,
+                                       devices=int(self.mesh.devices.size)))
+            return fut
+        if op == "stats":
+            fut.set_result(ok_response(req, **self.stats()))
+            return fut
+        klass = CLASS_OF[op]
+        if self._closed:
+            raise RuntimeError("service is closed")
+        self.gate.admit(klass, self.retry_after_ms())  # may raise Overloaded
+        obs.count("serve.requests")
+        deadline_ms = req.get("deadline_ms")
+        if deadline_ms is not None:
+            deadline_ts = time.monotonic() + float(deadline_ms) / 1000.0
+        elif self.policy.deadline is not None:
+            deadline_ts = time.monotonic() + self.policy.deadline
+        else:
+            deadline_ts = None
+        t0 = time.monotonic()
+        self.pool.submit(self._run, op, req, fut, klass, deadline_ts, t0)
+        return fut
+
+    def _run(self, op, req, fut, klass, deadline_ts, t0) -> None:
+        handler = getattr(self, f"_handle_{op}")
+        try:
+            with obs.span("serve.request", op=op):
+                if deadline_ts is not None and time.monotonic() > deadline_ts:
+                    obs.count("serve.shed")
+                    raise ServiceError(
+                        "DeadlineExceeded",
+                        f"{op} deadline expired before service started",
+                    )
+                resp = ok_response(req, **handler(req, deadline_ts))
+        except ServiceError as exc:
+            resp = error_response(req, exc.error, str(exc), **exc.extra)
+        except TimeoutError as exc:
+            obs.count("serve.shed")
+            resp = error_response(req, "DeadlineExceeded", str(exc))
+        except FileNotFoundError as exc:
+            resp = error_response(req, "NotFound", str(exc))
+        except Exception as exc:
+            resp = error_response(
+                req, "Internal", f"{type(exc).__name__}: {exc}"
+            )
+        finally:
+            self.gate.release(klass)
+        ms = (time.monotonic() - t0) * 1000.0
+        self.latency.record(ms)
+        obs.observe("serve.latency_ms", ms)
+        self.served += 1
+        fut.set_result(resp)
+
+    # ------------------------------------------------------------ warm tier
+    def file_state(self, path) -> _FileState:
+        path = str(path)
+        with self._files_lock:
+            fs = self._files.get(path)
+            if fs is not None and fs.fresh():
+                self._files.move_to_end(path)
+                return fs
+            if fs is not None:
+                del self._files[path]
+        fs = _FileState(path, self.config)
+        with self._files_lock:
+            self._files[path] = fs
+            self._files.move_to_end(path)
+            total = sum(f.nbytes for f in self._files.values())
+            while total > self.serve_cfg.flat_cache and len(self._files) > 1:
+                _, evicted = self._files.popitem(last=False)
+                total -= evicted.nbytes
+        return fs
+
+    # ------------------------------------------------------------- handlers
+    def _handle_plan(self, req: dict, deadline_ts) -> dict:
+        from spark_bam_tpu.load.api import split_starts
+
+        path = req["path"]
+        size = req.get("split_size")
+        splits = split_starts(
+            path, split_size=size, config=self.config, pool=self.resolve_pool
+        )
+        return {
+            "path": str(path),
+            "splits": [
+                {
+                    "start": s.start,
+                    "end": s.end,
+                    "pos": None if p is None else [p.block_pos, p.offset],
+                    "vpos": None if p is None else p.to_htsjdk(),
+                }
+                for s, p in splits
+            ],
+        }
+
+    def _handle_record_starts(self, req: dict, deadline_ts) -> dict:
+        fs = self.file_state(req["path"])
+        starts = fs.starts(self.config)
+        limit = int(req.get("limit", 0))
+        blocks, offs = fs.flat.pos_of_flat_many(starts[:limit] if limit else
+                                                starts[:0])
+        return {
+            "path": fs.path,
+            "count": int(len(starts)),
+            "vpos": [
+                (int(b) << 16) | int(o) for b, o in zip(blocks, offs)
+            ],
+        }
+
+    def _handle_count(self, req: dict, deadline_ts) -> dict:
+        fs = self.file_state(req["path"])
+        lo, hi = self._flat_range(fs, req)
+        tasks = self._scan_rows(fs, lo, hi, deadline_ts)
+        count, escaped = self._gather(tasks, deadline_ts)
+        exact_fallback = False
+        if escaped:
+            count = self._exact_count(fs, lo, hi)
+            exact_fallback = True
+        return {
+            "path": fs.path,
+            "count": int(count),
+            "escaped": int(escaped),
+            "exact_fallback": exact_fallback,
+        }
+
+    def _handle_fleet(self, req: dict, deadline_ts) -> dict:
+        paths = req["paths"]
+        if not isinstance(paths, list) or not paths:
+            raise ServiceError("ProtocolError", "fleet needs a non-empty 'paths' list")
+        # Submit every file's rows before waiting on any: rows from the
+        # whole fleet coalesce into shared batcher ticks.
+        per_path = []
+        for p in paths:
+            fs = self.file_state(p)
+            lo, hi = fs.header_end, fs.flat.size
+            per_path.append((fs, lo, hi, self._scan_rows(fs, lo, hi, deadline_ts)))
+        counts = {}
+        total = 0
+        for fs, lo, hi, tasks in per_path:
+            count, escaped = self._gather(tasks, deadline_ts)
+            if escaped:
+                count = self._exact_count(fs, lo, hi)
+            counts[fs.path] = int(count)
+            total += int(count)
+        return {"paths": counts, "total": total}
+
+    # ------------------------------------------------------------- scanning
+    def _flat_range(self, fs: _FileState, req: dict) -> "tuple[int, int]":
+        """Flat [lo, hi) for a request: whole file, or the blocks whose
+        compressed starts land in the request's compressed [start, end)."""
+        start, end = req.get("start"), req.get("end")
+        if start is None and end is None:
+            return fs.header_end, fs.flat.size
+        bs, bf = fs.flat.block_starts, fs.flat.block_flat
+        lo = fs.header_end
+        hi = fs.flat.size
+        if start is not None:
+            i = int(np.searchsorted(bs, int(start), side="left"))
+            lo = max(fs.header_end, int(bf[i]) if i < len(bf) else fs.flat.size)
+        if end is not None:
+            i = int(np.searchsorted(bs, int(end), side="left"))
+            hi = int(bf[i]) if i < len(bf) else fs.flat.size
+        return lo, max(lo, hi)
+
+    def _scan_rows(self, fs: _FileState, lo: int, hi: int,
+                   deadline_ts) -> "list[RowTask]":
+        """Cut [lo, hi) into batcher rows with ``batch_windows``'s exact
+        tiling (same step/ownership arithmetic ⇒ byte-identical verdicts
+        vs the one-shot path)."""
+        window = self.serve_cfg.window
+        halo = self.serve_cfg.halo
+        step = max(window - halo, 1)
+        n_total = fs.flat.size
+        buf = fs.flat.data
+        tasks: "list[RowTask]" = []
+        if lo >= hi:
+            return tasks
+        for s in range(0, n_total, step):
+            e = min(s + window, n_total)
+            own_end = e if e == n_total else min(s + step, n_total)
+            if own_end <= lo:
+                if e == n_total:
+                    break
+                continue
+            if s >= hi:
+                break
+            row_lo = max(lo, s) - s
+            row_own = min(hi, own_end) - s
+            if row_lo >= row_own:
+                if e == n_total:
+                    break
+                continue
+            t = RowTask(
+                window=buf[s:e],
+                n=e - s,
+                at_eof=(e == n_total),
+                lo=row_lo,
+                own=row_own,
+                lengths=fs.lengths,
+                nc=fs.nc,
+                deadline_ts=deadline_ts,
+            )
+            self.batcher.submit(t)
+            tasks.append(t)
+            if e == n_total:
+                break
+        return tasks
+
+    def _gather(self, tasks: "list[RowTask]",
+                deadline_ts) -> "tuple[int, int]":
+        count = escaped = 0
+        for t in tasks:
+            left = None
+            if deadline_ts is not None:
+                left = max(deadline_ts - time.monotonic(), 0.001)
+            try:
+                c, esc = t.future.result(timeout=left)
+            except FutureTimeout:
+                # concurrent.futures.TimeoutError is NOT the builtin
+                # TimeoutError before 3.11; normalize so the deadline
+                # maps to DeadlineExceeded, not Internal.
+                raise TimeoutError(
+                    "deadline expired waiting for device verdict"
+                ) from None
+            count += c
+            escaped += esc
+        return count, escaped
+
+    def _exact_count(self, fs: _FileState, lo: int, hi: int) -> int:
+        starts = fs.starts(self.config)
+        return int(np.searchsorted(starts, hi, side="left")
+                   - np.searchsorted(starts, lo, side="left"))
+
+    # ---------------------------------------------------------------- stats
+    def stats(self) -> dict:
+        return {
+            "served": int(self.served),
+            "inflight": self.gate.inflight(),
+            "limits": dict(self.gate.limits),
+            "files_resident": len(self._files),
+            "batch_sizes": {
+                str(k): int(v)
+                for k, v in sorted(self.batcher.batch_sizes.items())
+            },
+            "batch_rows": int(self.batcher.batch_rows),
+            "devices": int(self.mesh.devices.size),
+        }

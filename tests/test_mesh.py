@@ -72,3 +72,87 @@ def test_sharded_check_full_file(bam2):
         np.testing.assert_array_equal(own, want)
         n_true += own.sum()
     assert n_true == 2500
+
+
+@pytest.fixture(scope="module")
+def plan_bam(tmp_path_factory):
+    """Self-contained BAM for the shard-plan tests: the reference
+    fixtures are absent on some hosts, and plan arithmetic only needs a
+    structurally valid file."""
+    from spark_bam_tpu.benchmarks.synth import synthetic_fixture
+
+    return str(synthetic_fixture(tmp_path_factory.mktemp("mesh_plan")))
+
+
+def _shard_plan(bam, hosts, window=64 << 10, halo=8 << 10):
+    from spark_bam_tpu.parallel.stream_mesh import host_shard_plan
+
+    return host_shard_plan(
+        bam, num_hosts=hosts, devices_per_host=8,
+        window_uncompressed=window, halo=halo,
+    )
+
+
+def test_host_shard_plan_uneven_tail(plan_bam):
+    """Host counts that do NOT divide the group count: the tail host gets
+    the short remainder, yet the owned ranges still partition the file
+    exactly and per-host flat bytes still sum to the whole."""
+    whole = _shard_plan(plan_bam, 1)[0]
+    n_groups, total = whole["groups"][1], whole["uncompressed"]
+    assert n_groups > 3  # the small windows must yield a real partition
+
+    for hosts in (3, 5, 7):
+        plan = _shard_plan(plan_bam, hosts)
+        assert [p["host"] for p in plan] == list(range(hosts))
+        # Contiguous, end-exclusive, covering every group exactly once.
+        assert plan[0]["groups"][0] == 0
+        for prev, nxt in zip(plan, plan[1:]):
+            assert prev["groups"][1] == nxt["groups"][0]
+        assert plan[-1]["groups"][1] == n_groups
+        assert sum(p["uncompressed"] for p in plan) == total
+        # The tail is allowed to be short, never long.
+        per = plan[0]["groups"][1] - plan[0]["groups"][0]
+        tail = plan[-1]["groups"][1] - plan[-1]["groups"][0]
+        assert tail <= per
+
+
+def test_host_shard_plan_more_hosts_than_groups(plan_bam):
+    """Hosts beyond the group count get well-formed EMPTY assignments
+    (the scheduler must see 'this process reads nothing', not a crash or
+    an overlapping range)."""
+    from spark_bam_tpu.core.channel import path_size
+
+    whole = _shard_plan(plan_bam, 1)[0]
+    n_groups, total = whole["groups"][1], whole["uncompressed"]
+    hosts = n_groups + 3
+    plan = _shard_plan(plan_bam, hosts)
+    assert len(plan) == hosts
+
+    size = path_size(plan_bam)
+    seen_empty = 0
+    for p in plan:
+        g0, g1 = p["groups"]
+        assert 0 <= g0 <= g1 <= n_groups
+        if g0 == g1:
+            seen_empty += 1
+            assert p["compressed_range"] == (0, 0)
+            assert p["uncompressed"] == 0
+        else:
+            lo, hi = p["compressed_range"]
+            assert 0 <= lo < hi <= size
+    assert seen_empty >= 3
+    assert sum(p["uncompressed"] for p in plan) == total
+    # Every group is still owned exactly once despite the empty tails.
+    owned = [g for p in plan for g in range(*p["groups"])]
+    assert owned == list(range(n_groups))
+
+
+def test_host_shard_plan_single_group_file(plan_bam):
+    """Degenerate tiling: a window larger than the file collapses the
+    plan to one group — host 0 owns everything, every other host idles."""
+    plan = _shard_plan(plan_bam, 4, window=1 << 30, halo=1 << 16)
+    assert plan[0]["groups"] == (0, 1)
+    assert plan[0]["uncompressed"] > 0
+    for p in plan[1:]:
+        assert p["groups"][0] == p["groups"][1]
+        assert p["uncompressed"] == 0

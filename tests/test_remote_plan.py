@@ -414,3 +414,105 @@ def test_straggler_p99_within_2x_no_straggler():
     assert p99_straggle <= max(2 * p99_clean, 10 * latency), (
         f"p99 {p99_straggle:.3f}s vs clean {p99_clean:.3f}s"
     )
+
+
+# ----------------------------------------------------- per-bucket GET quota
+
+def test_remote_config_bucket_quota_knob():
+    assert RemoteConfig().bucket_quota == 0  # off by default
+    assert RemoteConfig.parse("bucket=4").bucket_quota == 4
+    assert RemoteConfig.parse("bucket_quota=2").bucket_quota == 2
+    with pytest.raises(ValueError):
+        RemoteConfig.parse("bucket=-1")
+
+
+def test_bucket_quota_caps_hot_bucket_and_isolates_cold_one():
+    """Two stores (= two buckets) share the fleet pool with bucket=2:
+    the hot bucket's 8 concurrent GETs serialize into ≤ 2 in flight,
+    while the other bucket's GETs flow beside them un-queued."""
+    from spark_bam_tpu.core.remote_plan import (
+        bucket_inflight_stats,
+        reset_bucket_stats,
+    )
+
+    reset_bucket_stats()
+    latency = 0.12
+    seg = 16 << 10
+    data = DATA[: 1 << 18]
+    cfg = RemoteConfig.parse("mode=plan,gap=0,request=16KB,hedge=off,bucket=2")
+    a_ranges = [(i * (2 * seg), i * (2 * seg) + seg) for i in range(8)]
+    b_ranges = a_ranges[:4]
+    with FakeObjectStore(data, key="a.bin", latency_s=latency) as sa, \
+         FakeObjectStore(data, key="b.bin", latency_s=latency) as sb:
+        cha = PlannedChannel(
+            HttpRangeChannel(sa.url_base + "/a.bin"), plan=a_ranges, config=cfg
+        )
+        chb = PlannedChannel(
+            HttpRangeChannel(sb.url_base + "/b.bin"), plan=b_ranges, config=cfg
+        )
+        results: dict = {}
+
+        def read_all(name, ch, ranges):
+            t0 = time.perf_counter()
+            blobs = [None] * len(ranges)
+            ts = [
+                threading.Thread(
+                    target=lambda i=i, r=r: blobs.__setitem__(
+                        i, bytes(ch.read_at(r[0], r[1] - r[0]))
+                    )
+                )
+                for i, r in enumerate(ranges)
+            ]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            results[name] = (time.perf_counter() - t0, blobs)
+
+        ta = threading.Thread(target=read_all, args=("a", cha, a_ranges))
+        tb = threading.Thread(target=read_all, args=("b", chb, b_ranges))
+        ta.start(); tb.start(); ta.join(); tb.join()
+        cha.close(); chb.close()
+        a_bucket, b_bucket = sa.url_base, sb.url_base
+
+    a_elapsed, a_blobs = results["a"]
+    b_elapsed, b_blobs = results["b"]
+    # Byte-identical under the quota.
+    assert all(a_blobs[i] == data[r[0]: r[1]] for i, r in enumerate(a_ranges))
+    assert all(b_blobs[i] == data[r[0]: r[1]] for i, r in enumerate(b_ranges))
+    stats = bucket_inflight_stats()
+    assert stats[a_bucket]["high"] <= 2, stats
+    assert stats[b_bucket]["high"] <= 2, stats
+    assert stats[a_bucket]["cur"] == stats[b_bucket]["cur"] == 0, stats
+    # The hot bucket queued on ITS OWN semaphore: the cold bucket's 4 GETs
+    # (2 quota ticks) finished well before the hot bucket's 8 (4 ticks).
+    assert b_elapsed < a_elapsed, (b_elapsed, a_elapsed)
+
+
+def test_bucket_quota_off_tracks_but_does_not_cap():
+    from spark_bam_tpu.core.remote_plan import (
+        bucket_inflight_stats,
+        reset_bucket_stats,
+    )
+
+    reset_bucket_stats()
+    data = DATA[: 1 << 17]
+    cfg = RemoteConfig.parse("mode=plan,gap=0,request=16KB,hedge=off")
+    ranges = [(i * (32 << 10), i * (32 << 10) + (16 << 10)) for i in range(4)]
+    with FakeObjectStore(data, key="o.bin", latency_s=0.05) as store:
+        ch = PlannedChannel(
+            HttpRangeChannel(store.url_base + "/o.bin"), plan=ranges, config=cfg
+        )
+        ts = [
+            threading.Thread(target=lambda r=r: ch.read_at(r[0], r[1] - r[0]))
+            for r in ranges
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        ch.close()
+        bucket = store.url_base
+    stats = bucket_inflight_stats()
+    assert stats[bucket]["high"] >= 2  # uncapped concurrency observed
+    assert stats[bucket]["cur"] == 0
