@@ -1246,6 +1246,153 @@ def _child_serve(clients: int = 8, per_client: int = 3, seq_shots: int = 3):
     })
 
 
+def _child_export(shots: int = 3, serve_queries: int = 12):
+    """Columnar export leg (CPU backend, docs/analytics.md).
+
+    Two measurements, both equal-bytes gated:
+
+    - **sink throughput** — rows/sec and bytes/sec through the native
+      container vs Arrow IPC vs Parquet sinks on the same dataset
+      (arrow/parquet reported only when pyarrow is importable — the
+      sinks are the optional ``[arrow]`` extra);
+    - **serve ``batch`` A/B** — region queries against a warm daemon
+      (in-process :class:`ServerThread`) vs fresh one-shot ``export``
+      processes for the same region. The served frames must concatenate
+      byte-identical to the one-shot file — the outlet-equivalence
+      contract — so the speedup is pure residency, not a different
+      answer.
+
+    Own child for the same reason as ``--child-serve``: the daemon's
+    mesh wants 8 virtual CPU devices forced before jax init."""
+    _emit_stage("start")
+    from spark_bam_tpu.core.platform import force_cpu_devices
+
+    force_cpu_devices(8)
+    enable_compile_cache()
+    import jax
+
+    _emit_stage("backend_ok:" + jax.devices()[0].platform)
+
+    import shutil
+
+    from spark_bam_tpu.bam.bai import index_bam
+    from spark_bam_tpu.benchmarks.synth import synthetic_fixture
+    from spark_bam_tpu.core.config import Config as C
+    from spark_bam_tpu.load.api import export
+    from spark_bam_tpu.serve import ServeClient, ServerThread, SplitService
+
+    path = str(synthetic_fixture(reads=20_000))
+    index_bam(path)
+    loci = "chr1:100k-900k"
+    tmp = tempfile.mkdtemp(prefix="sbt_export_leg_")
+    out: dict = {}
+    try:
+        # --- sink throughput ---------------------------------------------
+        for fmt in ("native", "arrow", "parquet"):
+            dst = os.path.join(tmp, f"reads.{fmt}")
+            try:
+                t0 = time.perf_counter()
+                s = export(path, dst, fmt=fmt)
+                wall = time.perf_counter() - t0
+            except Exception as e:  # pyarrow absent, or a sink failure
+                if fmt == "native":
+                    raise
+                out[f"export_{fmt}_error"] = f"{type(e).__name__}: {e}"
+                continue
+            out[f"export_{fmt}_rows_per_s"] = round(s["rows"] / wall)
+            out[f"export_{fmt}_Bps"] = round(s["bytes"] / wall)
+            out[f"export_{fmt}_bytes"] = s["bytes"]
+        out["export_rows"] = 20_000
+        _emit_stage("sinks_done")
+
+        # --- serve batch A/B ---------------------------------------------
+        region_file = os.path.join(tmp, "region.sbcr")
+        export(path, region_file, loci=loci, fmt="native")
+        with open(region_file, "rb") as f:
+            region_bytes = f.read()
+
+        service = SplitService(C(serve="window=64KB,halo=8KB,workers=2"))
+        try:
+            srv = ServerThread(service).start()
+            try:
+                with ServeClient(srv.address) as c:
+                    c.request("batch", path=path, intervals=loci)  # warm-up
+                    _emit_stage("serve_warm")
+                    equal = True
+                    t0 = time.perf_counter()
+                    for _ in range(serve_queries):
+                        r = c.request("batch", path=path, intervals=loci)
+                        equal = equal and (
+                            b"".join(r["_binary"]) == region_bytes
+                        )
+                    serve_wall = time.perf_counter() - t0
+            finally:
+                srv.stop()
+        finally:
+            service.close()
+        _emit_stage("serve_batch_done")
+
+        # One-shot side: fresh process per region query — import, jax
+        # init, header/split resolution all paid every time.
+        code = (
+            "import sys\n"
+            "from spark_bam_tpu.core.platform import "
+            "enable_compile_cache, force_cpu_devices\n"
+            "force_cpu_devices(8)\n"
+            "enable_compile_cache()\n"
+            "from spark_bam_tpu.cli.main import main\n"
+            "sys.exit(main(['export', '-i', sys.argv[1], '-o', sys.argv[2],"
+            " sys.argv[3]]))\n"
+        )
+        t0 = time.perf_counter()
+        for i in range(shots):
+            shot = os.path.join(tmp, f"shot{i}.sbcr")
+            r = subprocess.run(
+                [sys.executable, "-c", code, loci, shot, path],
+                capture_output=True, text=True, timeout=300,
+                cwd=str(Path(__file__).resolve().parent),
+            )
+            if r.returncode != 0:
+                tail = "; ".join(_drop_benign(
+                    (r.stdout + r.stderr).strip().splitlines()
+                )[-3:])[-300:]
+                raise RuntimeError(f"one-shot export failed: {tail}")
+            with open(shot, "rb") as f:
+                equal = equal and (f.read() == region_bytes)
+        seq_wall = time.perf_counter() - t0
+        _emit_stage("oneshot_done")
+
+        batch_rps = serve_queries / serve_wall
+        seq_rps = shots / seq_wall
+        out.update({
+            "serve_batch_rps": round(batch_rps, 1),
+            "serve_batch_oneshot_rps": round(seq_rps, 3),
+            "serve_batch_speedup": round(batch_rps / max(seq_rps, 1e-9), 1),
+            "serve_batch_bytes_equal": equal,
+            "serve_batch_region_bytes": len(region_bytes),
+        })
+        if not equal:
+            raise AssertionError("serve batch bytes diverged from file sink")
+        _emit_result("export", out)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def export_leg():
+    """Parent wrapper for the columnar export leg (own child: virtual
+    device mesh). Budget env-tunable; 0 skips."""
+    budget = int(os.environ.get("SB_BENCH_EXPORT_CHILD_S", "420"))
+    if budget <= 0:
+        return {}
+    results, stages, err = _run_child(["--child-export"], budget)
+    out = results.get("export")
+    if out is None:
+        raise RuntimeError(
+            f"export child produced no result: {err or 'stages=' + str(stages)}"
+        )
+    return out
+
+
 def _run_cli_smoke(backend: str):
     """check-bam with backend=tpu must be byte-identical to the golden —
     proves the device engine is CLI-reachable (VERDICT r3 weak #5)."""
@@ -1994,6 +2141,9 @@ def main():
     if len(sys.argv) > 1 and sys.argv[1] == "--child-serve":
         _child_serve()
         return
+    if len(sys.argv) > 1 and sys.argv[1] == "--child-export":
+        _child_export()
+        return
 
     record = {
         "metric": "check_positions_per_sec",
@@ -2386,6 +2536,13 @@ def _main_measure(record, warnings, errors):
         record.update(serve_leg())
     except Exception as e:
         warnings.append(f"serve leg: {type(e).__name__}: {e}")
+    # Columnar export leg: sink throughput (native/arrow/parquet) + the
+    # serve `batch` region-query A/B vs one-shot export processes (own
+    # child process; equal-bytes gated — docs/analytics.md).
+    try:
+        record.update(export_leg())
+    except Exception as e:
+        warnings.append(f"export leg: {type(e).__name__}: {e}")
     # Host-zlib vs two-phase device inflate on identical windows
     # (in-process backend). setdefault: the inflate child's TPU-measured
     # first-class fields win when they landed; this leg guarantees the

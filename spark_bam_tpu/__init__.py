@@ -33,6 +33,7 @@ __all__ = [
     "load_bam_intervals",
     "load_splits_and_reads",
     "load_reads_and_positions",
+    "export",
     "count_reads_tpu",
     "load_reads_columnar",
     "record_starts_streaming",
@@ -49,7 +50,7 @@ _LAZY = {
         name: "spark_bam_tpu.load.api"
         for name in (
             "load_bam", "load_reads", "load_sam", "load_bam_intervals",
-            "load_splits_and_reads", "load_reads_and_positions",
+            "load_splits_and_reads", "load_reads_and_positions", "export",
         )
     },
     **{

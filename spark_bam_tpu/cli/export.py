@@ -1,0 +1,42 @@
+"""``spark-bam-tpu export``: columnar analytics export (docs/analytics.md).
+
+    spark-bam-tpu export [-i LOCI] [--format native|arrow|parquet]
+                         [--columns flag,pos,...] [--columnar SPEC]
+                         [-F FASTA] -o OUT PATH
+
+One line of summary per run: rows, batches, bytes, wall time, and the
+fault-tolerance postscript when partitions retried or were quarantined.
+"""
+
+from __future__ import annotations
+
+from spark_bam_tpu.core.config import Config, format_bytes
+
+
+def run(
+    path,
+    p,
+    config: Config,
+    out: str,
+    fmt: str = "native",
+    loci=None,
+    columns=None,
+    reference=None,
+) -> None:
+    from spark_bam_tpu.load.api import export
+
+    summary = export(
+        path, out, loci=loci, fmt=fmt, columns=columns, config=config,
+        reference=reference,
+    )
+    cols = ",".join(summary["columns"])
+    p.echo(
+        f"exported {summary['rows']} rows in {summary['batches']} batches "
+        f"({format_bytes(summary['bytes'])}, {summary['format']}) to "
+        f"{summary['path']} in {summary['seconds']:.2f}s [{cols}]"
+    )
+    if summary["lost_records"] or summary["quarantined"]:
+        p.echo(
+            f"\tdegraded: {summary['lost_records']} records lost, "
+            f"{summary['quarantined']} partitions quarantined"
+        )

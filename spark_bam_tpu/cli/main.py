@@ -8,6 +8,8 @@
     spark-bam-tpu compare-splits [-m SIZE] BAMS-FILE
     spark-bam-tpu count-reads [-m SIZE] [-n N] [-s] PATH
     spark-bam-tpu time-load [-m SIZE] PATH
+    spark-bam-tpu export [-i LOCI] [--format F] [--columns C] -o OUT PATH
+        (beyond the 10: columnar analytics export, docs/analytics.md)
     spark-bam-tpu index [-m SIZE] [--record-starts] PATH   (beyond the 10:
         ahead-of-time .sbi split-index cache builder, docs/caching.md)
     spark-bam-tpu index-blocks PATH
@@ -91,6 +93,15 @@ def _add_funnel(sub):
              "(default) funnels verdict paths and keeps the exact "
              "single-pass kernel for full flag-mask output "
              "(SPARK_BAM_FUNNEL env var works too; docs/design.md)",
+    )
+
+
+def _add_columnar(sub):
+    sub.add_argument(
+        "--columnar", default=None, metavar="SPEC",
+        help="columnar-plane knobs, e.g. 'rows=8192,codec=zlib,level=6,"
+             "columns=flag+pos+name' (SPARK_BAM_COLUMNAR env var works "
+             "too; docs/analytics.md)",
     )
 
 
@@ -192,6 +203,39 @@ def build_parser() -> argparse.ArgumentParser:
     _add_common(sub)
     sub.add_argument("path")
 
+    # Columnar analytics export: record batches to a native container /
+    # Arrow IPC / Parquet file (docs/analytics.md).
+    sub = sp.add_parser("export")
+    _add_metrics(sub)
+    _add_faults(sub)
+    _add_cache(sub)
+    _add_limits(sub)
+    _add_remote(sub)
+    _add_columnar(sub)
+    sub.add_argument("-m", "--max-split-size", default=None,
+                     help="split size (byte shorthand like 2MB ok)")
+    sub.add_argument(
+        "-i", "--intervals", default=None, metavar="LOCI",
+        help="genomic loci to restrict to, e.g. 'chr1:5k-10k,chr2' "
+             "(decimal k/m suffixes; whole contig when no range)",
+    )
+    sub.add_argument(
+        "--format", default="native", choices=("native", "arrow", "parquet"),
+        help="output format (arrow/parquet need the pyarrow extra; "
+             "default native)",
+    )
+    sub.add_argument(
+        "--columns", default=None, metavar="COLS",
+        help="comma-separated column projection (default: all columns)",
+    )
+    sub.add_argument("-F", "--reference", default=None,
+                     help="FASTA for reference-based (RR=true) CRAM decode")
+    sub.add_argument("-w", "--warn", action="store_true",
+                     help="root log level WARN")
+    sub.add_argument("-o", "--out", dest="export_out", required=True,
+                     help="output file path")
+    sub.add_argument("path")
+
     sub = sp.add_parser("index-blocks")
     _add_metrics(sub)
     sub.add_argument("-o", "--out", default=None)
@@ -267,6 +311,7 @@ def build_parser() -> argparse.ArgumentParser:
     _add_limits(sub)
     _add_remote(sub)
     _add_funnel(sub)
+    _add_columnar(sub)
     sub.add_argument(
         "--serve", default=None, metavar="SPEC",
         help="serving knobs, e.g. 'batch=16,tick=2,plan_queue=64,"
@@ -345,6 +390,11 @@ def main(argv=None) -> int:
         if getattr(args, "funnel", None) is not None:
             config = config.replace(funnel=args.funnel)
         config.funnel_enabled()  # fail early on a bad SPARK_BAM_FUNNEL
+        if getattr(args, "columnar", None) is not None:
+            from spark_bam_tpu.columnar import ColumnarConfig
+
+            ColumnarConfig.parse(args.columnar)  # fail before any work starts
+            config = config.replace(columnar=args.columnar)
         if getattr(args, "serve", None) is not None:
             from spark_bam_tpu.serve import ServeConfig
 
@@ -442,6 +492,27 @@ def main(argv=None) -> int:
                 config, args.spark_bam_first, args.num_iterations,
                 reference=args.reference, sharded=args.sharded,
                 resident=args.resident,
+            )
+        elif cmd == "export":
+            from spark_bam_tpu.cli import export as export_cmd
+            from spark_bam_tpu.load.intervals import BadLociError, LociSet
+
+            loci = getattr(args, "intervals", None)
+            if loci:
+                try:
+                    LociSet.parse(loci)  # fail before any work starts
+                except BadLociError as e:
+                    raise UsageError(str(e)) from e
+            if args.columns:
+                from spark_bam_tpu.columnar import normalize_columns
+
+                try:
+                    normalize_columns(args.columns)
+                except ValueError as e:
+                    raise UsageError(str(e)) from e
+            export_cmd.run(
+                args.path, p, config, args.export_out, fmt=args.format,
+                loci=loci, columns=args.columns, reference=args.reference,
             )
         elif cmd == "index-blocks":
             from spark_bam_tpu.bgzf.index_blocks import index_blocks

@@ -1,0 +1,67 @@
+"""Columnar analytics plane: self-describing record batches from the parse.
+
+The TPU parser already produces per-field planes (tpu/parser.py
+``ReadBatch``); this package gives them a stable schema and three outlets
+(docs/analytics.md):
+
+- **file sink** — Arrow IPC / Parquet via the optional ``pyarrow`` extra,
+  or the zero-dependency native container (``native.py``, mirroring the
+  ``.sbi`` framing discipline), written streamingly with atomic
+  tmp+replace;
+- **API sink** — ``Dataset.to_batches()`` / ``load.api.export()``, routed
+  through the fault-tolerant executor;
+- **serve sink** — the daemon's ``batch`` op streams the same container
+  frames length-prefixed over the wire (serve/service.py), byte-identical
+  to the file sink for the same query.
+
+Schema note: ``bin`` is deliberately NOT a column — it is derivable
+(``reg2bin(pos, end)``) and BAM files may carry stale values, so exporting
+it would break the BAM↔CRAM byte-equality contract (the CRAM reader
+recomputes it).
+"""
+
+from spark_bam_tpu.columnar.config import ColumnarConfig
+from spark_bam_tpu.columnar.native import (
+    ColumnarFormatError,
+    NativeReader,
+    batch_frame,
+    container_head,
+    container_meta,
+    end_frame,
+    read_container,
+)
+from spark_bam_tpu.columnar.schema import (
+    COLUMNS,
+    SCHEMA_VERSION,
+    BatchBuilder,
+    RecordBatch,
+    VarColumn,
+    batches_from_records,
+    concat_batches,
+    iter_rows,
+    normalize_columns,
+    project,
+    slice_batch,
+)
+
+__all__ = [
+    "COLUMNS",
+    "SCHEMA_VERSION",
+    "BatchBuilder",
+    "ColumnarConfig",
+    "ColumnarFormatError",
+    "NativeReader",
+    "RecordBatch",
+    "VarColumn",
+    "batch_frame",
+    "batches_from_records",
+    "concat_batches",
+    "container_head",
+    "container_meta",
+    "end_frame",
+    "iter_rows",
+    "normalize_columns",
+    "project",
+    "read_container",
+    "slice_batch",
+]

@@ -1,0 +1,70 @@
+"""Columnar-plane knobs: the ``Config.columnar`` string spec.
+
+Same compact-spec pattern as ``faults``/``serve``/``remote`` so the
+frozen Config stays hashable and the ``SPARK_BAM_COLUMNAR`` env var and
+``--columnar`` CLI flag work through the existing plumbing:
+
+    rows=8192,codec=zlib,level=6,columns=flag+pos+name
+
+``rows`` is the record-batch row target (frame segmentation — identical
+between the file sink and the serve ``batch`` op so their bytes match),
+``codec`` compresses the per-column buffers of the native container
+("none" | "zlib"), ``columns`` is a ``+``-separated default projection.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+from spark_bam_tpu.columnar.schema import normalize_columns
+
+_CODECS = ("none", "zlib")
+
+
+@dataclass(frozen=True)
+class ColumnarConfig:
+    batch_rows: int = 8192
+    codec: str = "none"
+    level: int = 6
+    columns: "tuple[str, ...] | None" = None
+
+    @staticmethod
+    @functools.lru_cache(maxsize=64)
+    def parse(spec: str) -> "ColumnarConfig":
+        """Parse a ``rows=...,codec=...,level=...,columns=a+b`` spec
+        ("" ⇒ defaults). Raises ``ValueError`` on unknown keys/values —
+        the CLI validates before any work starts, like every other knob."""
+        kw: dict = {}
+        for part in (spec or "").split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if "=" not in part:
+                raise ValueError(
+                    f"Bad columnar spec {spec!r}: {part!r} is not key=value"
+                )
+            key, value = part.split("=", 1)
+            key, value = key.strip(), value.strip()
+            if key in ("rows", "batch_rows"):
+                rows = int(value)
+                if rows <= 0:
+                    raise ValueError(f"columnar rows must be positive: {value}")
+                kw["batch_rows"] = rows
+            elif key == "codec":
+                if value not in _CODECS:
+                    raise ValueError(
+                        f"Bad columnar codec {value!r}: expected "
+                        f"{' | '.join(_CODECS)}"
+                    )
+                kw["codec"] = value
+            elif key == "level":
+                level = int(value)
+                if not 0 <= level <= 9:
+                    raise ValueError(f"columnar level must be 0..9: {value}")
+                kw["level"] = level
+            elif key == "columns":
+                kw["columns"] = normalize_columns(value)
+            else:
+                raise ValueError(f"Unknown columnar key: {key!r}")
+        return ColumnarConfig(**kw)

@@ -1,0 +1,120 @@
+"""The export driver: dataset → sink, through the fault-tolerant executor.
+
+Partitions build their record batches in parallel under the dataset's
+``FaultPolicy`` (retries/hedging/quarantine — chaos semantics apply to
+export jobs exactly as to loads); the driver re-segments the resulting
+batch stream to the configured row count and writes it to the sink.
+Partition windows bound memory: at most ~2× the worker count of
+partitions are in flight, so a WGS-scale export never materializes the
+whole file of records on the host.
+
+Frame segmentation is partition-independent (schema.Rebatcher), which is
+what makes the output bytes a pure function of (query, config) — the
+serve daemon's ``batch`` op produces the identical stream.
+"""
+
+from __future__ import annotations
+
+import time
+
+from spark_bam_tpu import obs
+from spark_bam_tpu.columnar.config import ColumnarConfig
+from spark_bam_tpu.columnar.native import container_meta
+from spark_bam_tpu.columnar.schema import (
+    Rebatcher,
+    batches_from_records,
+    normalize_columns,
+)
+from spark_bam_tpu.columnar.sink import open_sink
+from spark_bam_tpu.parallel.executor import JobReport, run_partitions
+
+
+def _merge_reports(reports: "list[JobReport]") -> JobReport:
+    merged = JobReport(partitions=[])
+    for rep in reports:
+        merged.partitions.extend(rep.partitions)
+        merged.lost_records += rep.lost_records
+        merged.lost_blocks += rep.lost_blocks
+    return merged
+
+
+def _partition_batch_stream(ds, batch_rows: int, columns, reports: list):
+    """Record batches from every partition, windowed through the executor.
+
+    Each window runs ``run_partitions`` over a slice of the partition
+    list; quarantined partitions yield nothing (their loss is visible in
+    the merged JobReport), matching ``Dataset.collect`` semantics."""
+    compute = ds.compute
+
+    def build(p):
+        return list(batches_from_records(compute(p), batch_rows, columns))
+
+    window = max(2 * ds.parallel.num_workers, 4)
+    for lo in range(0, len(ds.partitions), window):
+        chunk = ds.partitions[lo: lo + window]
+        t0 = time.monotonic()
+        results, report = run_partitions(build, chunk, ds.parallel, ds.policy)
+        obs.observe("columnar.build_ms", (time.monotonic() - t0) * 1000.0)
+        reports.append(report)
+        for part in results:
+            if part is not None:
+                yield from part
+
+
+def export_dataset(
+    ds,
+    out,
+    fmt: str = "native",
+    columns=None,
+    ccfg: ColumnarConfig = ColumnarConfig(),
+    contigs=None,
+) -> dict:
+    """Export ``ds``'s records to ``out`` in ``fmt``; returns a summary
+    dict (rows/batches/bytes/format/path + loss accounting)."""
+    columns = normalize_columns(columns if columns is not None else ccfg.columns)
+    meta = container_meta(
+        columns, codec=ccfg.codec, level=ccfg.level, contigs=contigs
+    )
+    reports: "list[JobReport]" = []
+    rebatcher = Rebatcher(ccfg.batch_rows)
+    sink = open_sink(str(out), fmt, meta)
+    t0 = time.monotonic()
+    try:
+        with obs.span("columnar.export", fmt=fmt,
+                      partitions=len(ds.partitions)):
+            for batch in _partition_batch_stream(
+                ds, ccfg.batch_rows, columns, reports
+            ):
+                for frame in rebatcher.feed(batch):
+                    te = time.monotonic()
+                    sink.write(frame)
+                    obs.observe(
+                        "columnar.encode_ms", (time.monotonic() - te) * 1000.0
+                    )
+            for frame in rebatcher.flush():
+                te = time.monotonic()
+                sink.write(frame)
+                obs.observe(
+                    "columnar.encode_ms", (time.monotonic() - te) * 1000.0
+                )
+        sink.close()
+    except BaseException:
+        sink.abort()
+        raise
+    report = _merge_reports(reports)
+    ds.last_report = report
+    obs.count("columnar.rows", sink.rows)
+    obs.count("columnar.bytes_out", sink.bytes_out)
+    elapsed = time.monotonic() - t0
+    return {
+        "path": str(out),
+        "format": fmt,
+        "columns": list(columns),
+        "rows": int(sink.rows),
+        "batches": int(sink.batches),
+        "bytes": int(sink.bytes_out),
+        "seconds": elapsed,
+        "lost_records": int(report.lost_records),
+        "quarantined": len(report.quarantined),
+        "retries": int(report.retries),
+    }

@@ -1,0 +1,97 @@
+"""ReadBatch → RecordBatch: the parser-plane producer.
+
+The device parse (tpu/parser.py) already holds every fixed field as an
+int32 plane and the flat buffer the variable-length payloads live in;
+this module gathers them into schema batches without ever materializing
+``BamRecord`` objects. The renderings (cigar string, seq letters, raw
+qual/tags bytes) are defined to match ``BamRecord.decode`` exactly, so
+a batch built here is byte-identical to one built by the iterator-path
+:class:`~spark_bam_tpu.columnar.schema.BatchBuilder` over the same rows
+— the serve daemon's byte-equality contract rests on this.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import numpy as np
+
+from spark_bam_tpu.bam.record import CIGAR_OPS, SEQ_CODES
+from spark_bam_tpu.columnar.schema import (
+    FIXED_COLUMNS,
+    RecordBatch,
+    VarColumn,
+    normalize_columns,
+)
+
+_SEQ_LUT = np.frombuffer(SEQ_CODES.encode("ascii"), dtype=np.uint8)
+
+
+def _var_piece(name: str, batch, i: int) -> bytes:
+    """One row's rendering of a variable-length column, straight from the
+    flat buffer (offsets per the BAM record layout, bam/record.py)."""
+    cols = batch.columns
+    buf = batch.buf
+    start = int(batch.starts[i])
+    name_off = int(cols["name_offset"][i])
+    l_name = int(cols["l_read_name"][i])
+    n_cigar = int(cols["n_cigar"][i])
+    l_seq = int(cols["l_seq"][i])
+    cig_off = name_off + l_name
+    seq_off = cig_off + 4 * n_cigar
+    qual_off = seq_off + (l_seq + 1) // 2
+    if name == "name":
+        return bytes(buf[name_off: name_off + l_name - 1])
+    if name == "cigar":
+        if n_cigar == 0:
+            return b"*"
+        ops = np.frombuffer(
+            bytes(buf[cig_off: cig_off + 4 * n_cigar]), dtype="<u4"
+        )
+        return "".join(
+            f"{int(v) >> 4}{CIGAR_OPS[int(v) & 0xF]}" for v in ops
+        ).encode("latin-1")
+    if name == "seq":
+        if l_seq == 0:
+            return b""
+        packed = np.frombuffer(
+            bytes(buf[seq_off: seq_off + (l_seq + 1) // 2]), dtype=np.uint8
+        )
+        nibbles = np.empty(2 * len(packed), dtype=np.uint8)
+        nibbles[0::2] = packed >> 4
+        nibbles[1::2] = packed & 0xF
+        return _SEQ_LUT[nibbles[:l_seq]].tobytes()
+    if name == "qual":
+        return bytes(buf[qual_off: qual_off + l_seq])
+    # tags: everything after qual through the record's declared extent
+    end = start + 4 + int(cols["block_size"][i])
+    return bytes(buf[qual_off + l_seq: end])
+
+
+def read_batch_to_record_batches(
+    batch, batch_rows: int, columns=None
+) -> Iterator[RecordBatch]:
+    """Schema batches of ``batch``'s valid rows, ``batch_rows`` per frame
+    (last partial), in file order."""
+    columns = normalize_columns(columns)
+    idx = np.flatnonzero(np.asarray(batch.columns["valid"]))
+    batch_rows = max(int(batch_rows), 1)
+    for lo in range(0, len(idx), batch_rows):
+        rows = idx[lo: lo + batch_rows]
+        cols: "dict[str, np.ndarray | VarColumn]" = {}
+        for name in columns:
+            if name in FIXED_COLUMNS:
+                cols[name] = np.ascontiguousarray(
+                    np.asarray(batch.columns[name])[rows], dtype=np.int32
+                )
+            else:
+                values = bytearray()
+                offsets = np.empty(len(rows) + 1, dtype=np.int64)
+                offsets[0] = 0
+                for k, i in enumerate(rows):
+                    values.extend(_var_piece(name, batch, int(i)))
+                    offsets[k + 1] = len(values)
+                cols[name] = VarColumn(
+                    offsets, np.frombuffer(bytes(values), dtype=np.uint8)
+                )
+        yield RecordBatch(cols, len(rows))

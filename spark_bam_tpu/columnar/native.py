@@ -1,0 +1,275 @@
+"""The native columnar container: the zero-dependency file format.
+
+Mirrors the ``.sbi`` sidecar discipline (sbi/format.py): magic + version,
+tagged CRC-framed sections, unknown-tag skip on read, typed structural
+errors. Layout:
+
+    magic   4s   b"SBCR"
+    version u16  (1)
+    flags   u16  (0, reserved)
+    frame*  — each frame is
+        tag         u8    (1 schema, 2 batch, 3 end; others skipped)
+        payload_len u64
+        payload     bytes
+        crc32       u32   over tag+payload_len+payload
+
+The schema frame's payload is deterministic JSON (sorted keys, no
+whitespace) holding ``schema_version``/``columns``/``codec``/``level``/
+``contigs`` — nothing run-specific (no paths, no timestamps), so the
+same query produces the same bytes whether the producer is the file
+sink or the serve daemon. A batch frame holds ``rows u32, ncols u16``
+then per column (schema order) a kind byte (0 fixed / 1 var) and its
+buffer(s); each buffer is ``raw_len u64, enc_len u64, bytes`` where
+``enc_len == raw_len`` means stored raw (codec "none") and anything
+else is zlib. The end frame carries ``total_rows u64, n_batches u32``
+so a reader detects truncation in O(1), like ``_Reader.count``.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import zlib
+from typing import Iterator
+
+import numpy as np
+
+from spark_bam_tpu.columnar.schema import (
+    COLUMNS,
+    SCHEMA_VERSION,
+    RecordBatch,
+    VarColumn,
+)
+from spark_bam_tpu.core.guard import StructurallyInvalid
+
+MAGIC = b"SBCR"
+VERSION = 1
+
+TAG_SCHEMA = 1
+TAG_BATCH = 2
+TAG_END = 3
+
+_HEAD = struct.Struct("<4sHH")
+_FRAME = struct.Struct("<BQ")
+_CRC = struct.Struct("<I")
+_BUF = struct.Struct("<QQ")
+_BATCH = struct.Struct("<IH")
+_END = struct.Struct("<QI")
+
+
+class ColumnarFormatError(StructurallyInvalid):
+    """Structurally invalid container (bad magic/CRC/framing/lengths)."""
+
+
+def container_meta(columns, codec: str = "none", level: int = 6,
+                   contigs=None) -> dict:
+    """The schema-frame payload. Deterministic by construction: fixed key
+    set, canonical column order, no environment-dependent values."""
+    return {
+        "schema_version": SCHEMA_VERSION,
+        "columns": list(columns),
+        "codec": codec,
+        "level": int(level),
+        "contigs": [[str(n), int(l)] for n, l in (contigs or [])],
+    }
+
+
+def _frame(tag: int, payload: bytes) -> bytes:
+    head = _FRAME.pack(tag, len(payload))
+    return head + payload + _CRC.pack(zlib.crc32(head + payload) & 0xFFFFFFFF)
+
+
+def _encode_buffer(raw: bytes, codec: str, level: int) -> bytes:
+    if codec == "zlib":
+        enc = zlib.compress(raw, level)
+        if len(enc) < len(raw):
+            return _BUF.pack(len(raw), len(enc)) + enc
+    return _BUF.pack(len(raw), len(raw)) + raw
+
+
+def container_head(meta: dict) -> bytes:
+    """Magic + version + the schema frame — the first chunk of every
+    container, file or wire."""
+    payload = json.dumps(meta, separators=(",", ":"), sort_keys=True).encode()
+    return _HEAD.pack(MAGIC, VERSION, 0) + _frame(TAG_SCHEMA, payload)
+
+
+def batch_frame(batch: RecordBatch, meta: dict) -> bytes:
+    codec, level = meta["codec"], meta["level"]
+    parts = [_BATCH.pack(batch.num_rows, len(meta["columns"]))]
+    for name in meta["columns"]:
+        col = batch.columns[name]
+        if isinstance(col, VarColumn):
+            parts.append(b"\x01")
+            parts.append(_encode_buffer(
+                np.ascontiguousarray(col.offsets, dtype=np.int64).tobytes(),
+                codec, level,
+            ))
+            parts.append(_encode_buffer(
+                np.ascontiguousarray(col.values, dtype=np.uint8).tobytes(),
+                codec, level,
+            ))
+        else:
+            parts.append(b"\x00")
+            parts.append(_encode_buffer(
+                np.ascontiguousarray(col, dtype=np.int32).tobytes(),
+                codec, level,
+            ))
+    return _frame(TAG_BATCH, b"".join(parts))
+
+
+def end_frame(total_rows: int, n_batches: int) -> bytes:
+    return _frame(TAG_END, _END.pack(total_rows, n_batches))
+
+
+# ------------------------------------------------------------------- reading
+def _take(buf: memoryview, p: int, n: int, what: str) -> "tuple[memoryview, int]":
+    if p + n > len(buf):
+        raise ColumnarFormatError(
+            f"truncated container: {what} needs {n} bytes at {p}, "
+            f"have {len(buf) - p}"
+        )
+    return buf[p: p + n], p + n
+
+
+def _decode_buffer(payload: memoryview, p: int) -> "tuple[bytes, int]":
+    head, p = _take(payload, p, _BUF.size, "buffer header")
+    raw_len, enc_len = _BUF.unpack(head)
+    data, p = _take(payload, p, enc_len, "buffer body")
+    if enc_len == raw_len:
+        return bytes(data), p
+    raw = zlib.decompress(bytes(data))
+    if len(raw) != raw_len:
+        raise ColumnarFormatError(
+            f"buffer inflated to {len(raw)} bytes, header declared {raw_len}"
+        )
+    return raw, p
+
+
+def _decode_batch(payload: memoryview, columns) -> RecordBatch:
+    head, p = _take(payload, 0, _BATCH.size, "batch header")
+    rows, ncols = _BATCH.unpack(head)
+    if ncols != len(columns):
+        raise ColumnarFormatError(
+            f"batch has {ncols} columns, schema declares {len(columns)}"
+        )
+    cols: "dict[str, np.ndarray | VarColumn]" = {}
+    for name in columns:
+        kind, p = _take(payload, p, 1, "column kind")
+        if kind[0] == 0:
+            raw, p = _decode_buffer(payload, p)
+            arr = np.frombuffer(raw, dtype=np.int32)
+            if len(arr) != rows:
+                raise ColumnarFormatError(
+                    f"column {name!r}: {len(arr)} values for {rows} rows"
+                )
+            cols[name] = arr
+        elif kind[0] == 1:
+            raw_off, p = _decode_buffer(payload, p)
+            raw_val, p = _decode_buffer(payload, p)
+            offsets = np.frombuffer(raw_off, dtype=np.int64)
+            values = np.frombuffer(raw_val, dtype=np.uint8)
+            if len(offsets) != rows + 1:
+                raise ColumnarFormatError(
+                    f"column {name!r}: {len(offsets)} offsets for {rows} rows"
+                )
+            if rows and (int(offsets[-1]) != len(values) or int(offsets[0]) != 0
+                         or (np.diff(offsets) < 0).any()):
+                raise ColumnarFormatError(
+                    f"column {name!r}: offsets inconsistent with "
+                    f"{len(values)} value bytes"
+                )
+            cols[name] = VarColumn(offsets, values)
+        else:
+            raise ColumnarFormatError(
+                f"column {name!r}: unknown kind byte {kind[0]}"
+            )
+    return RecordBatch(cols, rows)
+
+
+class NativeReader:
+    """Validating reader over a container's bytes or file path.
+
+    ``meta`` is decoded eagerly (so schema errors surface at open);
+    batches stream via :meth:`iter_batches`. Unknown frame tags are
+    skipped (CRC still checked) — the forward-compatibility contract the
+    ``.sbi`` reader set.
+    """
+
+    def __init__(self, src):
+        if isinstance(src, (bytes, bytearray, memoryview)):
+            self._data = memoryview(src)
+        else:
+            with open(src, "rb") as f:
+                self._data = memoryview(f.read())
+        head, p = _take(self._data, 0, _HEAD.size, "container header")
+        magic, version, _flags = _HEAD.unpack(head)
+        if magic != MAGIC:
+            raise ColumnarFormatError(
+                f"bad magic {bytes(magic)!r}: not a columnar container"
+            )
+        if version != VERSION:
+            raise ColumnarFormatError(f"unsupported container version {version}")
+        tag, payload, p = self._frame_at(p)
+        if tag != TAG_SCHEMA:
+            raise ColumnarFormatError(
+                f"first frame has tag {tag}, expected schema ({TAG_SCHEMA})"
+            )
+        try:
+            self.meta = json.loads(bytes(payload))
+        except Exception as exc:
+            raise ColumnarFormatError(f"schema frame is not JSON: {exc}") from exc
+        if self.meta.get("schema_version") != SCHEMA_VERSION:
+            raise ColumnarFormatError(
+                f"unsupported schema_version {self.meta.get('schema_version')}"
+            )
+        cols = self.meta.get("columns")
+        if (not isinstance(cols, list) or not cols
+                or any(c not in COLUMNS for c in cols)):
+            raise ColumnarFormatError(f"schema declares bad columns: {cols!r}")
+        self.columns = tuple(cols)
+        self._body_at = p
+
+    def _frame_at(self, p: int) -> "tuple[int, memoryview, int]":
+        head, q = _take(self._data, p, _FRAME.size, "frame header")
+        tag, length = _FRAME.unpack(head)
+        payload, q = _take(self._data, q, length, f"frame tag={tag} payload")
+        crc_raw, q = _take(self._data, q, _CRC.size, "frame crc")
+        want = zlib.crc32(self._data[p: p + _FRAME.size + length]) & 0xFFFFFFFF
+        if _CRC.unpack(crc_raw)[0] != want:
+            raise ColumnarFormatError(f"frame tag={tag} at {p}: CRC mismatch")
+        return tag, payload, q
+
+    def iter_batches(self) -> Iterator[RecordBatch]:
+        p = self._body_at
+        total = 0
+        n_batches = 0
+        saw_end = False
+        while p < len(self._data):
+            tag, payload, p = self._frame_at(p)
+            if tag == TAG_BATCH:
+                if saw_end:
+                    raise ColumnarFormatError("batch frame after end frame")
+                batch = _decode_batch(payload, self.columns)
+                total += batch.num_rows
+                n_batches += 1
+                yield batch
+            elif tag == TAG_END:
+                if len(payload) != _END.size:
+                    raise ColumnarFormatError("end frame has wrong size")
+                want_rows, want_batches = _END.unpack(bytes(payload))
+                if want_rows != total or want_batches != n_batches:
+                    raise ColumnarFormatError(
+                        f"end frame declares {want_rows} rows / "
+                        f"{want_batches} batches, read {total} / {n_batches}"
+                    )
+                saw_end = True
+            # unknown tags: CRC validated by _frame_at, content skipped
+        if not saw_end:
+            raise ColumnarFormatError("container has no end frame (truncated?)")
+
+
+def read_container(src) -> "tuple[dict, list[RecordBatch]]":
+    """Convenience: (meta, all batches) of a container path or bytes."""
+    reader = NativeReader(src)
+    return reader.meta, list(reader.iter_batches())

@@ -1,0 +1,207 @@
+"""File sinks: native container, Arrow IPC, Parquet.
+
+All three write streamingly — one record batch at a time, O(batch) host
+memory — into a same-directory temp file that is atomically renamed
+into place on close (the ``.sbi`` store's tmp+replace discipline), so a
+crashed export never leaves a half-written output at the target path.
+
+Arrow and Parquet need the optional ``pyarrow`` extra
+(``pip install spark-bam-tpu[arrow]``); the native container has zero
+dependencies and is the default. Conversion to Arrow is zero-copy: the
+schema's large-offset layout is exactly ``large_utf8``/``large_binary``.
+"""
+
+from __future__ import annotations
+
+import os
+
+from spark_bam_tpu.columnar.native import (
+    batch_frame,
+    container_head,
+    end_frame,
+)
+from spark_bam_tpu.columnar.schema import (
+    VAR_STR_COLUMNS,
+    RecordBatch,
+    VarColumn,
+)
+
+FORMATS = ("native", "arrow", "parquet")
+
+
+class ColumnarUnavailable(RuntimeError):
+    """Requested an Arrow/Parquet sink without pyarrow installed."""
+
+
+def _pyarrow():
+    try:
+        import pyarrow
+    except ImportError as exc:
+        raise ColumnarUnavailable(
+            "pyarrow is not installed: arrow/parquet sinks need the "
+            "optional extra (pip install spark-bam-tpu[arrow]); the "
+            "'native' format has no dependencies"
+        ) from exc
+    return pyarrow
+
+
+class _AtomicFile:
+    """Same-directory temp file, ``os.replace``d into place on commit."""
+
+    def __init__(self, out_path: str):
+        self.out_path = str(out_path)
+        self.tmp_path = f"{self.out_path}.tmp.{os.getpid()}"
+        self.f = open(self.tmp_path, "wb")
+
+    def commit(self) -> None:
+        self.f.flush()
+        os.fsync(self.f.fileno())
+        self.f.close()
+        os.replace(self.tmp_path, self.out_path)
+
+    def abort(self) -> None:
+        try:
+            self.f.close()
+        finally:
+            try:
+                os.unlink(self.tmp_path)
+            except OSError:
+                pass
+
+
+class NativeSink:
+    """Streaming writer of the native container (native.py frames)."""
+
+    def __init__(self, out_path: str, meta: dict):
+        self.meta = meta
+        self._file = _AtomicFile(out_path)
+        head = container_head(meta)
+        self._file.f.write(head)
+        self.rows = 0
+        self.batches = 0
+        self.bytes_out = len(head)
+
+    def write(self, batch: RecordBatch) -> None:
+        frame = batch_frame(batch, self.meta)
+        self._file.f.write(frame)
+        self.rows += batch.num_rows
+        self.batches += 1
+        self.bytes_out += len(frame)
+
+    def close(self) -> None:
+        tail = end_frame(self.rows, self.batches)
+        self._file.f.write(tail)
+        self.bytes_out += len(tail)
+        self._file.commit()
+
+    def abort(self) -> None:
+        self._file.abort()
+
+
+def to_arrow_batch(batch: RecordBatch):
+    """Zero-copy RecordBatch → ``pyarrow.RecordBatch``."""
+    pa = _pyarrow()
+    arrays = []
+    fields = []
+    for name, col in batch.columns.items():
+        if isinstance(col, VarColumn):
+            typ = pa.large_utf8() if name in VAR_STR_COLUMNS else pa.large_binary()
+            arrays.append(pa.Array.from_buffers(
+                typ, batch.num_rows,
+                [None, pa.py_buffer(col.offsets), pa.py_buffer(col.values)],
+            ))
+            fields.append(pa.field(name, typ))
+        else:
+            arrays.append(pa.array(col, type=pa.int32()))
+            fields.append(pa.field(name, pa.int32()))
+    return pa.record_batch(arrays, schema=pa.schema(fields))
+
+
+class ArrowSink:
+    """Arrow IPC file (Feather v2 container) via RecordBatchFileWriter."""
+
+    def __init__(self, out_path: str, meta: dict):
+        self.pa = _pyarrow()
+        self.meta = meta
+        self._file = _AtomicFile(out_path)
+        self._writer = None
+        self.rows = 0
+        self.batches = 0
+        self.bytes_out = 0
+
+    def write(self, batch: RecordBatch) -> None:
+        ab = to_arrow_batch(batch)
+        if self._writer is None:
+            self._writer = self.pa.ipc.new_file(self._file.f, ab.schema)
+        self._writer.write_batch(ab)
+        self.rows += batch.num_rows
+        self.batches += 1
+
+    def close(self) -> None:
+        if self._writer is None:
+            # Zero batches: still a valid (empty) IPC file with the schema.
+            from spark_bam_tpu.columnar.schema import BatchBuilder
+
+            empty = BatchBuilder(self.meta["columns"]).build()
+            self._writer = self.pa.ipc.new_file(
+                self._file.f, to_arrow_batch(empty).schema
+            )
+        self._writer.close()
+        self.bytes_out = self._file.f.tell()
+        self._file.commit()
+
+    def abort(self) -> None:
+        self._file.abort()
+
+
+class ParquetSink:
+    """Parquet via ``pyarrow.parquet.ParquetWriter``, one row group per
+    record batch."""
+
+    def __init__(self, out_path: str, meta: dict):
+        self.pa = _pyarrow()
+        import pyarrow.parquet as pq
+
+        self.pq = pq
+        self.meta = meta
+        self._file = _AtomicFile(out_path)
+        self._writer = None
+        self.rows = 0
+        self.batches = 0
+        self.bytes_out = 0
+
+    def write(self, batch: RecordBatch) -> None:
+        ab = to_arrow_batch(batch)
+        if self._writer is None:
+            self._writer = self.pq.ParquetWriter(self._file.f, ab.schema)
+        self._writer.write_table(self.pa.Table.from_batches([ab]))
+        self.rows += batch.num_rows
+        self.batches += 1
+
+    def close(self) -> None:
+        if self._writer is None:
+            from spark_bam_tpu.columnar.schema import BatchBuilder
+
+            empty = BatchBuilder(self.meta["columns"]).build()
+            ab = to_arrow_batch(empty)
+            self._writer = self.pq.ParquetWriter(self._file.f, ab.schema)
+            self._writer.write_table(self.pa.Table.from_batches([ab]))
+        self._writer.close()
+        self.bytes_out = self._file.f.tell()
+        self._file.commit()
+
+    def abort(self) -> None:
+        self._file.abort()
+
+
+def open_sink(out_path: str, fmt: str, meta: dict):
+    """Format-dispatched sink; ``fmt`` is one of :data:`FORMATS`."""
+    if fmt == "native":
+        return NativeSink(out_path, meta)
+    if fmt == "arrow":
+        return ArrowSink(out_path, meta)
+    if fmt == "parquet":
+        return ParquetSink(out_path, meta)
+    raise ValueError(
+        f"unknown export format {fmt!r}: expected {' | '.join(FORMATS)}"
+    )

@@ -132,6 +132,13 @@ class Config:
     # (cached). Governs the long-running split/record service's batching,
     # admission limits, and resident-cache budgets.
     serve: str = ""
+    # --- columnar analytics plane (columnar/; docs/analytics.md) ---
+    # Compact ColumnarConfig spec ("rows=8192,codec=zlib,level=6,
+    # columns=flag+pos+name"; "" = defaults). Same string-spec pattern;
+    # ``columnar_config`` parses it (cached). Governs record-batch row
+    # counts, native-container compression, and the default projection
+    # for the export sinks and the serve ``batch`` op.
+    columnar: str = ""
     # --- candidate funnel (tpu/checker.py; docs/design.md) ---
     # Two-stage checker hot path: cheap fixed-block prefilter over every
     # position, full 19-flag pass only on survivors. "auto" (default)
@@ -204,6 +211,13 @@ class Config:
         from spark_bam_tpu.serve.config import ServeConfig
 
         return ServeConfig.parse(self.serve)
+
+    @property
+    def columnar_config(self):
+        """The parsed ``ColumnarConfig`` for this config's ``columnar`` spec."""
+        from spark_bam_tpu.columnar.config import ColumnarConfig
+
+        return ColumnarConfig.parse(self.columnar)
 
     def funnel_enabled(self, full_masks: bool = False) -> bool:
         """Whether a projection should run the two-stage candidate funnel.

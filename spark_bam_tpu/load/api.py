@@ -709,6 +709,78 @@ def load_reads(
     raise ValueError(f"Can't tell format of path: {s}")
 
 
+# ---------------------------------------------------------------- columnar
+def export(
+    path,
+    out,
+    loci: "LociSet | str | None" = None,
+    fmt: str = "native",
+    columns=None,
+    split_size=None,
+    config: Config = Config(),
+    parallel: ParallelConfig = ParallelConfig(),
+    reference=None,
+    flags_required: int = 0,
+    flags_forbidden: int = 0,
+) -> dict:
+    """Export a BAM/CRAM/SAM's records as columnar record batches
+    (docs/analytics.md): ``fmt`` is ``native`` (zero-dependency container,
+    columnar/native.py), ``arrow`` (IPC file) or ``parquet`` (the latter
+    two need the ``pyarrow`` extra). ``loci`` restricts to overlapping
+    records via the indexed interval loaders; ``columns`` projects the
+    schema. Partition work runs through the fault-tolerant executor, so
+    retries/quarantine apply and the returned summary carries the loss
+    accounting. Output bytes are a pure function of (query, columnar
+    config): the serve daemon's ``batch`` op streams the identical native
+    frames for the same query."""
+    from spark_bam_tpu.columnar.export import export_dataset
+
+    s = str(path)
+    if s.endswith(".cram"):
+        from spark_bam_tpu.cram import CramReader
+
+        with CramReader(path) as r:
+            contig_lengths = r.bam_header.contig_lengths
+        ds = (
+            load_cram_intervals(path, loci, split_size, config, parallel,
+                                reference=reference)
+            if loci
+            else load_cram(path, split_size, config, parallel,
+                           reference=reference)
+        )
+    elif s.endswith(".sam"):
+        contig_lengths = _scan_sam_header(path)
+        ds = (
+            _load_sam_intervals(path, loci, split_size, config, parallel)
+            if loci
+            else load_sam(path, split_size, config, parallel)
+        )
+    else:
+        contig_lengths = with_retries(
+            lambda: read_header(path), config.fault_policy, "read_header"
+        ).contig_lengths
+        ds = (
+            load_bam_intervals(path, loci, split_size, config, parallel)
+            if loci
+            else load_bam(path, split_size, config, parallel)
+        )
+    if flags_required or flags_forbidden:
+        # Pure flag predicate — same semantics as the device filter's
+        # flag half (_apply_filter): unmapped reads pass unless a flag
+        # bit excludes them.
+        ds = ds.filter(
+            lambda rec: (rec.flag & flags_required) == flags_required
+            and (rec.flag & flags_forbidden) == 0
+        )
+    contigs = [
+        (name, length) for _, (name, length) in sorted(contig_lengths.items())
+    ]
+    return export_dataset(
+        ds, out, fmt=fmt, columns=columns, ccfg=config.columnar_config,
+        contigs=contigs,
+    )
+
+
 # --------------------------------------------------------------- intervals
 def interval_chunks(
     path, loci: LociSet, header: BamHeader, config: Config = Config()

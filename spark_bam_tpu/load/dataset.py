@@ -91,6 +91,17 @@ class Dataset(Generic[P, T]):
 
         return self._execute(first)
 
+    def to_batches(self, batch_rows: int = 8192, columns=None):
+        """Lazy columnar record batches of this dataset's records
+        (docs/analytics.md). Items may be bare ``BamRecord``s or tuples
+        whose last element is one (the ``(Pos, rec)`` load shapes).
+        Sequential by construction — batch boundaries are a pure function
+        of the row stream; for a parallel, fault-tolerant export use
+        ``load.api.export``."""
+        from spark_bam_tpu.columnar.schema import batches_from_records
+
+        return batches_from_records(iter(self), batch_rows, columns=columns)
+
     def __iter__(self) -> Iterator[T]:
         for p in self.partitions:
             yield from self.compute(p)

@@ -2,13 +2,47 @@
 
 Parses ``chr1:100-200,chr2,chr3:5k-10k`` style strings (the reference uses
 hammerlab LociSet for ``loadBamIntervals``, load/.../CanLoadBam.scala:59-138).
+
+Genomic coordinates get their own suffix table: ``k``/``m``/``g`` are
+decimal (1e3/1e6/1e9) — ``chr1:5k-10k`` means positions 5 000–10 000,
+not the 5 120–10 240 the *byte*-size shorthand (core/config.parse_bytes)
+would produce. Malformed ranges (no ``-``, ``lo > hi``, negative or
+non-integral coordinates) raise :class:`BadLociError`.
 """
 
 from __future__ import annotations
 
+import re
 from dataclasses import dataclass, field
 
-from spark_bam_tpu.core.config import parse_bytes
+
+class BadLociError(ValueError):
+    """Malformed loci string (bad coordinate, bad range, lo > hi)."""
+
+
+_LOCUS_RE = re.compile(r"^(\d+(?:\.\d+)?)([kKmMgG]?)$")
+
+#: Decimal multipliers — genomic positions are base counts, not bytes.
+_LOCUS_FACTORS = {"": 1, "k": 1_000, "m": 1_000_000, "g": 1_000_000_000}
+
+
+def parse_locus(s: str) -> int:
+    """One genomic coordinate: ``100``, ``5k``, ``1.5m``. Decimal suffixes;
+    the value must come out a non-negative integer."""
+    m = _LOCUS_RE.match(str(s).strip())
+    if not m:
+        raise BadLociError(
+            f"bad genomic coordinate {s!r}: expected an integer with an "
+            "optional decimal k/m/g suffix (e.g. 100, 5k, 1.5m)"
+        )
+    value, unit = m.groups()
+    n = float(value) * _LOCUS_FACTORS[unit.lower()]
+    if n != int(n):
+        raise BadLociError(
+            f"bad genomic coordinate {s!r}: {value}{unit} is not a whole "
+            "number of positions"
+        )
+    return int(n)
 
 
 @dataclass
@@ -25,8 +59,17 @@ class LociSet:
                 continue
             if ":" in part:
                 name, rng = part.split(":", 1)
-                lo, hi = rng.split("-", 1)
-                out.setdefault(name, []).append((parse_bytes(lo), parse_bytes(hi)))
+                if "-" not in rng:
+                    raise BadLociError(
+                        f"bad range {part!r}: expected contig:lo-hi"
+                    )
+                lo_s, hi_s = rng.split("-", 1)
+                lo, hi = parse_locus(lo_s), parse_locus(hi_s)
+                if lo > hi:
+                    raise BadLociError(
+                        f"bad range {part!r}: start {lo} is past end {hi}"
+                    )
+                out.setdefault(name, []).append((lo, hi))
             else:
                 out.setdefault(part, [])
         if contig_lengths is not None:

@@ -20,6 +20,7 @@ CLASS_OF = {
     "record_starts": "plan",
     "count": "scan",
     "fleet": "scan",
+    "batch": "scan",
 }
 
 

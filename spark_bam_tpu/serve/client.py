@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import json
 import socket
+import struct
 
 from spark_bam_tpu.serve.server import MAX_LINE, ServeAddress
 
@@ -46,7 +47,11 @@ class ServeClient:
         self._next_id = 0
 
     def request(self, op: str, **fields) -> dict:
-        """Send one request and block for its response payload."""
+        """Send one request and block for its response payload. Responses
+        announcing ``binary_frames`` (the ``batch`` op) have that many
+        u64-length-prefixed frames read off the socket and attached as a
+        list of bytes under ``"_binary"`` — concatenated they are a
+        native columnar container (columnar/native.py)."""
         self._next_id += 1
         req = {"op": op, "id": self._next_id, **fields}
         self._sock.sendall((json.dumps(req) + "\n").encode())
@@ -56,7 +61,25 @@ class ServeClient:
         resp = json.loads(line)
         if not resp.get("ok"):
             raise ServeClientError(resp)
+        n_frames = int(resp.get("binary_frames") or 0)
+        if n_frames:
+            frames = []
+            for _ in range(n_frames):
+                (length,) = struct.unpack("<Q", self._read_exact(8))
+                frames.append(self._read_exact(length))
+            resp["_binary"] = frames
         return resp
+
+    def _read_exact(self, n: int) -> bytes:
+        out = bytearray()
+        while len(out) < n:
+            piece = self._rfile.read(n - len(out))
+            if not piece:
+                raise ConnectionError(
+                    "server closed the connection mid-frame"
+                )
+            out.extend(piece)
+        return bytes(out)
 
     def close(self) -> None:
         try:

@@ -7,6 +7,14 @@ echoed back verbatim, so clients may pipeline. Responses are either
     {"id": ..., "ok": true, ...payload}
     {"id": ..., "ok": false, "error": "<Type>", "message": "...", ...}
 
+The ``batch`` op additionally streams binary record-batch frames after
+its JSON line: the payload's ``binary_frames`` counts the frames that
+follow, each written as a little-endian u64 length prefix + that many
+bytes. Concatenated, the frames are exactly a native columnar container
+(columnar/native.py) — byte-identical to the file sink's output for the
+same query. Handlers stage the chunks on the in-process response under
+the ``"_binary"`` key; the server pops it before JSON encoding.
+
 Error types are stable strings (``Overloaded``, ``DeadlineExceeded``,
 ``ProtocolError``, ``NotFound``, ``Unsupported``, ``Internal``) —
 docs/serving.md tabulates them.
@@ -17,7 +25,7 @@ from __future__ import annotations
 import json
 
 #: ops answered by the service; anything else is a ProtocolError.
-OPS = ("ping", "stats", "plan", "record_starts", "count", "fleet")
+OPS = ("ping", "stats", "plan", "record_starts", "count", "fleet", "batch")
 
 
 class ProtocolError(ValueError):

@@ -9,6 +9,7 @@ responses carry the client's ``id`` and may complete out of order.
 from __future__ import annotations
 
 import asyncio
+import struct
 import threading
 
 from spark_bam_tpu import obs
@@ -30,8 +31,17 @@ async def _handle_connection(service: SplitService, reader, writer) -> None:
     wlock = asyncio.Lock()
 
     async def write(resp: dict) -> None:
+        # Binary record-batch frames (the batch op) ride after the JSON
+        # line, each with a u64 length prefix; the JSON's binary_frames
+        # field tells the client how many to read (serve/protocol.py).
+        chunks = resp.pop("_binary", None)
+        data = encode(resp)
+        if chunks:
+            data = b"".join(
+                [data, *(struct.pack("<Q", len(c)) + bytes(c) for c in chunks)]
+            )
         async with wlock:
-            writer.write(encode(resp))
+            writer.write(data)
             await writer.drain()
 
     async def one(req: dict) -> None:

@@ -37,7 +37,7 @@ from spark_bam_tpu.parallel.mesh import make_mesh, mesh_steps
 from spark_bam_tpu.serve.admission import CLASS_OF, AdmissionGate
 from spark_bam_tpu.serve.batcher import Batcher, RowTask
 from spark_bam_tpu.serve.config import MAX_CONTIGS, ServeConfig
-from spark_bam_tpu.serve.protocol import error_response, ok_response
+from spark_bam_tpu.serve.protocol import encode, error_response, ok_response
 from spark_bam_tpu.tpu.checker import PAD
 from spark_bam_tpu.tpu.stream_check import pad_contig_lengths
 
@@ -62,6 +62,11 @@ class _FileState:
         st = os.stat(self.path)
         self.stamp = (st.st_size, st.st_mtime_ns)
         header = read_header(self.path)
+        self.header = header
+        self.contigs = [
+            (name, length)
+            for _, (name, length) in sorted(header.contig_lengths.items())
+        ]
         lens_list = header.contig_lengths.lengths_list()
         if len(lens_list) > MAX_CONTIGS:
             raise ServiceError(
@@ -79,6 +84,8 @@ class _FileState:
         self.nbytes = int(self.flat.data.nbytes)
         self._starts: "np.ndarray | None" = None
         self._starts_lock = threading.Lock()
+        self._read_batch = None
+        self._read_batch_lock = threading.Lock()
 
     def fresh(self) -> bool:
         try:
@@ -98,6 +105,21 @@ class _FileState:
                     record_starts(self.path, config).starts, dtype=np.int64
                 )
             return self._starts
+
+    def read_batch(self, config: Config):
+        """Warm parsed ``ReadBatch`` over the flat view (the ``batch``
+        op's third resident tier: repeat region queries re-filter the
+        cached planes — zero re-parse, zero split resolutions)."""
+        with self._read_batch_lock:
+            if self._read_batch is None:
+                from spark_bam_tpu.tpu.parser import parse_flat_records
+
+                starts = self.starts(config)
+                with obs.span("serve.parse", records=len(starts)):
+                    self._read_batch = parse_flat_records(
+                        self.flat.data, starts
+                    )
+            return self._read_batch
 
 
 class SplitService:
@@ -134,6 +156,10 @@ class SplitService:
         self._files: "OrderedDict[str, _FileState]" = OrderedDict()
         self._files_lock = threading.Lock()
         self.served = 0
+        # op → [requests, rows, bytes, ms] — the per-op throughput ledger
+        # ``stats`` reports (docs/serving.md "Observability").
+        self._op_stats: "dict[str, list]" = {}
+        self._op_lock = threading.Lock()
         self._closed = False
 
     # ------------------------------------------------------------ lifecycle
@@ -205,8 +231,31 @@ class SplitService:
         ms = (time.monotonic() - t0) * 1000.0
         self.latency.record(ms)
         obs.observe("serve.latency_ms", ms)
+        self._note_op(op, ms, resp)
         self.served += 1
         fut.set_result(resp)
+
+    def _note_op(self, op: str, ms: float, resp: dict) -> None:
+        """Per-op request/row/byte accounting. Rows come from whichever
+        cardinality the op reports (``rows``/``count``/``total``); bytes
+        are the encoded JSON line plus any binary frames."""
+        rows = 0
+        if resp.get("ok"):
+            for key in ("rows", "count", "total"):
+                if isinstance(resp.get(key), int):
+                    rows = resp[key]
+                    break
+        chunks = resp.get("_binary") or ()
+        nbytes = sum(len(c) for c in chunks)
+        nbytes += len(encode(
+            {k: v for k, v in resp.items() if k != "_binary"}
+        ))
+        with self._op_lock:
+            acc = self._op_stats.setdefault(op, [0, 0, 0, 0.0])
+            acc[0] += 1
+            acc[1] += rows
+            acc[2] += nbytes
+            acc[3] += ms
 
     # ------------------------------------------------------------ warm tier
     def file_state(self, path) -> _FileState:
@@ -301,6 +350,76 @@ class SplitService:
             total += int(count)
         return {"paths": counts, "total": total}
 
+    def _handle_batch(self, req: dict, deadline_ts) -> dict:
+        """Columnar record batches for a (possibly interval/flag-filtered)
+        file, staged as native-container frames (columnar/native.py) for
+        the server to stream length-prefixed. Reuses the warm flat view
+        and parsed planes, so a repeat region query does zero split
+        resolutions and zero re-parses; the frame stream is byte-identical
+        to ``load.api.export(fmt="native")`` for the same query
+        (docs/analytics.md)."""
+        from spark_bam_tpu.columnar.from_parser import (
+            read_batch_to_record_batches,
+        )
+        from spark_bam_tpu.columnar.native import (
+            batch_frame,
+            container_head,
+            container_meta,
+            end_frame,
+        )
+        from spark_bam_tpu.columnar.schema import normalize_columns
+        from spark_bam_tpu.load.tpu_load import _apply_filter
+        from spark_bam_tpu.tpu.parser import ReadBatch
+
+        fs = self.file_state(req["path"])
+        ccfg = self.config.columnar_config
+        try:
+            columns = normalize_columns(req.get("columns") or ccfg.columns)
+        except ValueError as exc:
+            raise ServiceError("ProtocolError", str(exc)) from exc
+        batch_rows = int(req.get("batch_rows") or ccfg.batch_rows)
+        if batch_rows <= 0:
+            raise ServiceError("ProtocolError", "batch_rows must be positive")
+        loci = req.get("intervals") or None
+        flags_required = int(req.get("flags_required") or 0)
+        flags_forbidden = int(req.get("flags_forbidden") or 0)
+        warm = fs.read_batch(self.config)
+        if deadline_ts is not None and time.monotonic() > deadline_ts:
+            obs.count("serve.shed")
+            raise ServiceError(
+                "DeadlineExceeded", "batch deadline expired during parse"
+            )
+        # _apply_filter narrows ``valid`` in place: work on a copy so the
+        # warm tier keeps the unfiltered mask for the next request.
+        batch = ReadBatch(dict(warm.columns), warm.starts, buf=warm.buf)
+        batch.columns["valid"] = np.array(warm.columns["valid"], copy=True)
+        if loci or flags_required or flags_forbidden:
+            _apply_filter(
+                batch, fs.header, loci, flags_required, flags_forbidden
+            )
+        meta = container_meta(
+            columns, codec=ccfg.codec, level=ccfg.level, contigs=fs.contigs
+        )
+        chunks = [container_head(meta)]
+        rows = 0
+        with obs.span("serve.batch_encode", path=fs.path):
+            for rb in read_batch_to_record_batches(batch, batch_rows, columns):
+                chunks.append(batch_frame(rb, meta))
+                rows += rb.num_rows
+        chunks.append(end_frame(rows, len(chunks) - 1))
+        nbytes = sum(len(c) for c in chunks)
+        obs.count("columnar.rows", rows)
+        obs.count("columnar.bytes_out", nbytes)
+        return {
+            "path": fs.path,
+            "rows": int(rows),
+            "columns": list(columns),
+            "batch_rows": int(batch_rows),
+            "binary_frames": len(chunks),
+            "binary_bytes": int(nbytes),
+            "_binary": chunks,
+        }
+
     # ------------------------------------------------------------- scanning
     def _flat_range(self, fs: _FileState, req: dict) -> "tuple[int, int]":
         """Flat [lo, hi) for a request: whole file, or the blocks whose
@@ -390,6 +509,18 @@ class SplitService:
 
     # ---------------------------------------------------------------- stats
     def stats(self) -> dict:
+        with self._op_lock:
+            ops = {
+                op: {
+                    "requests": int(n),
+                    "rows": int(rows),
+                    "bytes": int(nbytes),
+                    "ms": round(ms, 3),
+                    "rows_per_s": round(rows / (ms / 1000.0), 1) if ms else 0.0,
+                    "bytes_per_s": round(nbytes / (ms / 1000.0), 1) if ms else 0.0,
+                }
+                for op, (n, rows, nbytes, ms) in sorted(self._op_stats.items())
+            }
         return {
             "served": int(self.served),
             "inflight": self.gate.inflight(),
@@ -401,4 +532,5 @@ class SplitService:
             },
             "batch_rows": int(self.batcher.batch_rows),
             "devices": int(self.mesh.devices.size),
+            "ops": ops,
         }

@@ -1,0 +1,403 @@
+"""Columnar analytics plane: record batches, sinks, serve ``batch`` op.
+
+The contract under test (docs/analytics.md): the native container written
+by ``load.api.export`` is a pure function of (query, columnar config) —
+the iterator path, the TPU-plane path, the CRAM bridge, and the serve
+daemon must all render byte-identical output for the same query. None of
+these tests need pyarrow; the Arrow/Parquet sink tests importorskip it.
+"""
+
+import struct
+import sys
+import zlib
+
+import pytest
+
+from spark_bam_tpu.bam.bai import index_bam
+from spark_bam_tpu.bam.header import read_header
+from spark_bam_tpu.benchmarks.synth import synthetic_fixture
+from spark_bam_tpu.columnar import (
+    COLUMNS,
+    BatchBuilder,
+    ColumnarConfig,
+    ColumnarFormatError,
+    NativeReader,
+    batches_from_records,
+    concat_batches,
+    iter_rows,
+    normalize_columns,
+    read_container,
+    slice_batch,
+)
+from spark_bam_tpu.core.config import Config
+from spark_bam_tpu.cram import CramWriter
+from spark_bam_tpu.load.api import export, load_bam
+
+pytestmark = pytest.mark.analytics
+
+LOCI = "chr1:5k-40k"
+
+
+@pytest.fixture(scope="module")
+def bam_path(tmp_path_factory):
+    p = str(synthetic_fixture(tmp_path_factory.mktemp("columnar_fixture")))
+    index_bam(p)
+    return p
+
+
+@pytest.fixture(scope="module")
+def cram_path(bam_path, tmp_path_factory):
+    header = read_header(bam_path)
+    recs = list(load_bam(bam_path))
+    out = tmp_path_factory.mktemp("columnar_cram") / "fixture.cram"
+    with CramWriter(out, header.contig_lengths, header.text) as w:
+        w.write_all(recs)
+    return str(out)
+
+
+def _rows(path_or_bytes):
+    _, batches = read_container(path_or_bytes)
+    out = []
+    for b in batches:
+        out.extend(iter_rows(b))
+    return out
+
+
+def _record_row(rec, columns=COLUMNS):
+    full = {
+        "flag": rec.flag, "ref_id": rec.ref_id, "pos": rec.pos,
+        "mapq": rec.mapq, "next_ref_id": rec.next_ref_id,
+        "next_pos": rec.next_pos, "tlen": rec.tlen,
+        "name": rec.read_name, "cigar": rec.cigar_string(), "seq": rec.seq,
+        "qual": bytes(rec.qual), "tags": bytes(rec.tags),
+    }
+    return {k: full[k] for k in columns}
+
+
+# ------------------------------------------------------------ schema
+
+
+def test_normalize_columns_accepts_strings_and_orders():
+    assert normalize_columns("pos,flag") == ("flag", "pos")
+    assert normalize_columns("seq+qual") == ("seq", "qual")
+    assert normalize_columns(None) == COLUMNS
+    assert normalize_columns(["tags", "name"]) == ("name", "tags")
+    with pytest.raises(ValueError):
+        normalize_columns("bin")  # deliberately not a column
+    with pytest.raises(ValueError):
+        normalize_columns("nope")
+
+
+def test_bin_is_not_in_schema():
+    # bin is derivable (reg2bin) and may be stale in BAMs; exporting it
+    # would break BAM<->CRAM byte equality.
+    assert "bin" not in COLUMNS
+
+
+def test_batch_builder_slice_concat_roundtrip(bam_path):
+    recs = list(load_bam(bam_path))[:100]
+    batches = list(batches_from_records(recs, batch_rows=32))
+    assert [b.num_rows for b in batches] == [32, 32, 32, 4]
+    whole = concat_batches(batches)
+    assert whole.num_rows == 100
+    again = [iter_rows(slice_batch(whole, i, i + 1)) for i in range(100)]
+    flat = [r for rows in again for r in rows]
+    assert flat == [_record_row(r) for r in recs]
+
+
+def test_columnar_config_parse():
+    cfg = ColumnarConfig.parse("rows=1024,codec=zlib,level=3,columns=flag+pos")
+    assert cfg.batch_rows == 1024
+    assert cfg.codec == "zlib"
+    assert cfg.level == 3
+    assert cfg.columns == ("flag", "pos")
+    assert ColumnarConfig.parse("") == ColumnarConfig()
+    for bad in ("rows=0", "codec=lz4", "level=11", "nope=1", "columns=bin"):
+        with pytest.raises(ValueError):
+            ColumnarConfig.parse(bad)
+
+
+# ------------------------------------------------------------ file sink
+
+
+def test_export_roundtrip_matches_iterator(bam_path, tmp_path):
+    out = tmp_path / "whole.sbcr"
+    summary = export(bam_path, str(out), fmt="native")
+    recs = list(load_bam(bam_path))
+    assert summary["rows"] == len(recs)
+    assert summary["lost_records"] == 0
+    assert _rows(str(out)) == [_record_row(r) for r in recs]
+
+
+def test_export_interval_matches_iterator(bam_path, tmp_path):
+    out = tmp_path / "iv.sbcr"
+    export(bam_path, str(out), loci=LOCI, fmt="native")
+    from spark_bam_tpu.load.api import load_bam_intervals
+
+    want = [_record_row(r) for r in load_bam_intervals(bam_path, LOCI)]
+    assert want  # fixture must cover the region
+    assert _rows(str(out)) == want
+
+
+def test_export_is_deterministic_and_partition_independent(bam_path, tmp_path):
+    a = tmp_path / "a.sbcr"
+    b = tmp_path / "b.sbcr"
+    export(bam_path, str(a), fmt="native")
+    # Different split size => different partitioning; the Rebatcher must
+    # make frame segmentation partition-independent.
+    export(bam_path, str(b), fmt="native", split_size=64 << 10)
+    assert a.read_bytes() == b.read_bytes()
+
+
+def test_export_zlib_codec_roundtrips(bam_path, tmp_path):
+    out = tmp_path / "z.sbcr"
+    export(bam_path, str(out), fmt="native",
+           config=Config(columnar="codec=zlib,level=6"))
+    plain = tmp_path / "p.sbcr"
+    export(bam_path, str(plain), fmt="native")
+    assert out.stat().st_size < plain.stat().st_size
+    assert _rows(str(out)) == _rows(str(plain))
+
+
+def test_export_atomic_no_partial_file_on_failure(bam_path, tmp_path):
+    out = tmp_path / "never.sbcr"
+    with pytest.raises(ValueError):
+        export(bam_path, str(out), fmt="sideways")
+    assert not out.exists()
+    assert not list(tmp_path.iterdir())
+
+
+# ------------------------------------------------------------ CRAM bridge
+
+
+def test_cram_export_byte_equal_to_bam(bam_path, cram_path, tmp_path):
+    b = tmp_path / "bam.sbcr"
+    c = tmp_path / "cram.sbcr"
+    export(bam_path, str(b), fmt="native")
+    export(cram_path, str(c), fmt="native")
+    assert b.read_bytes() == c.read_bytes()
+
+
+def test_cram_interval_export_byte_equal_to_bam(bam_path, cram_path, tmp_path):
+    b = tmp_path / "bam_iv.sbcr"
+    c = tmp_path / "cram_iv.sbcr"
+    export(bam_path, str(b), loci=LOCI, fmt="native")
+    export(cram_path, str(c), loci=LOCI, fmt="native")
+    assert b.read_bytes() == c.read_bytes()
+
+
+# ------------------------------------------------------------ projection
+
+
+@pytest.mark.parametrize("cols", [
+    "flag,pos", "name", "seq+qual", "flag,ref_id,pos,name,cigar,tags",
+])
+@pytest.mark.parametrize("kind", ["bam", "cram"])
+def test_projection_equals_sliced_full_export(
+    bam_path, cram_path, tmp_path, cols, kind,
+):
+    # Property: exporting a column subset yields exactly the full export's
+    # rows restricted to those columns — fixture-agnostic.
+    src = bam_path if kind == "bam" else cram_path
+    full = tmp_path / f"{kind}_full.sbcr"
+    sub = tmp_path / f"{kind}_sub.sbcr"
+    export(src, str(full), fmt="native")
+    export(src, str(sub), fmt="native", columns=cols)
+    want_cols = normalize_columns(cols)
+    meta, _ = read_container(str(sub))
+    assert tuple(meta["columns"]) == want_cols
+    want = [{k: row[k] for k in want_cols} for row in _rows(str(full))]
+    assert _rows(str(sub)) == want
+
+
+# ------------------------------------------------------------ serve sink
+
+
+def test_serve_batch_byte_identical_to_file_sink(bam_path, tmp_path):
+    from spark_bam_tpu.serve import SplitService
+
+    whole = tmp_path / "whole.sbcr"
+    iv = tmp_path / "iv.sbcr"
+    export(bam_path, str(whole), fmt="native")
+    export(bam_path, str(iv), loci=LOCI, fmt="native")
+
+    svc = SplitService(Config(serve="window=64KB,halo=8KB,workers=2"))
+    try:
+        r1 = svc.submit({"op": "batch", "path": bam_path}).result(120)
+        assert r1["ok"] and r1["binary_frames"] == len(r1["_binary"])
+        assert b"".join(r1["_binary"]) == whole.read_bytes()
+
+        r2 = svc.submit(
+            {"op": "batch", "path": bam_path, "intervals": LOCI}
+        ).result(120)
+        assert b"".join(r2["_binary"]) == iv.read_bytes()
+        assert r2["rows"] < r1["rows"]
+
+        stats = svc.submit({"op": "stats"}).result(120)
+        ops = stats["ops"]
+        assert ops["batch"]["requests"] == 2
+        assert ops["batch"]["rows"] == r1["rows"] + r2["rows"]
+        assert ops["batch"]["rows_per_s"] > 0
+    finally:
+        svc.close()
+
+
+@pytest.mark.serve
+def test_serve_batch_over_the_wire(bam_path, tmp_path):
+    from spark_bam_tpu.serve import ServeClient, ServerThread, SplitService
+
+    iv = tmp_path / "iv.sbcr"
+    export(bam_path, str(iv), loci=LOCI, fmt="native")
+
+    svc = SplitService(Config(serve="window=64KB,halo=8KB,workers=2"))
+    try:
+        with ServerThread(svc) as srv:
+            with ServeClient(srv.address) as client:
+                resp = client.request(
+                    "batch", path=bam_path, intervals=LOCI,
+                    columns="flag,pos,name",
+                )
+                assert resp["columns"] == ["flag", "pos", "name"]
+                sub = tmp_path / "sub.sbcr"
+                export(bam_path, str(sub), loci=LOCI,
+                       columns="flag,pos,name")
+                assert b"".join(resp["_binary"]) == sub.read_bytes()
+                # Full-width query over the same socket: still byte-equal.
+                resp2 = client.request("batch", path=bam_path,
+                                       intervals=LOCI)
+                assert b"".join(resp2["_binary"]) == iv.read_bytes()
+    finally:
+        svc.close()
+
+
+def test_serve_batch_rejects_bad_columns(bam_path):
+    from spark_bam_tpu.serve import SplitService
+
+    svc = SplitService(Config(serve="window=64KB,halo=8KB,workers=2"))
+    try:
+        resp = svc.submit(
+            {"op": "batch", "path": bam_path, "columns": "bin"}
+        ).result(120)
+        assert not resp["ok"]
+        assert resp["error"] == "ProtocolError"
+    finally:
+        svc.close()
+
+
+# ------------------------------------------------------------ native format
+
+
+def test_native_reader_rejects_corruption(bam_path, tmp_path):
+    out = tmp_path / "x.sbcr"
+    export(bam_path, str(out), fmt="native")
+    blob = bytearray(out.read_bytes())
+
+    with pytest.raises(ColumnarFormatError):
+        NativeReader(bytes(blob[:4]))  # truncated head
+    with pytest.raises(ColumnarFormatError):
+        NativeReader(b"NOPE" + bytes(blob[4:]))  # bad magic
+
+    flipped = bytearray(blob)
+    flipped[len(flipped) // 2] ^= 0xFF  # corrupt a batch payload byte
+    with pytest.raises(ColumnarFormatError):
+        list(NativeReader(bytes(flipped)).iter_batches())
+
+    with pytest.raises(ColumnarFormatError):
+        # drop the end frame: reader must notice the missing terminator
+        end_len = struct.calcsize("<BQ") + struct.calcsize("<QI") + 4
+        list(NativeReader(bytes(blob[:-end_len])).iter_batches())
+
+
+def test_native_reader_skips_unknown_frames(bam_path, tmp_path):
+    out = tmp_path / "x.sbcr"
+    export(bam_path, str(out), fmt="native")
+    blob = out.read_bytes()
+    # Splice an unknown (but CRC-valid) frame after the schema frame;
+    # readers must skip it for forward compatibility.
+    head_len = struct.calcsize("<4sHH")
+    fhdr = struct.unpack_from("<BQ", blob, head_len)
+    schema_end = head_len + struct.calcsize("<BQ") + fhdr[1] + 4
+    payload = struct.pack("<BQ", 200, 5) + b"hello"
+    frame = payload + struct.pack("<I", zlib.crc32(payload))
+    spliced = blob[:schema_end] + frame + blob[schema_end:]
+    assert _rows(spliced) == _rows(blob)
+
+
+# ------------------------------------------------------------ pyarrow gating
+
+
+def test_native_path_works_without_pyarrow(bam_path, tmp_path, monkeypatch):
+    from spark_bam_tpu.columnar.sink import ColumnarUnavailable
+
+    for mod in [m for m in sys.modules if m.split(".")[0] == "pyarrow"]:
+        monkeypatch.delitem(sys.modules, mod)
+    monkeypatch.setitem(sys.modules, "pyarrow", None)
+
+    out = tmp_path / "no_arrow.sbcr"
+    summary = export(bam_path, str(out), fmt="native")
+    assert summary["rows"] > 0 and out.exists()
+
+    with pytest.raises(ColumnarUnavailable):
+        export(bam_path, str(tmp_path / "x.arrow"), fmt="arrow")
+    with pytest.raises(ColumnarUnavailable):
+        export(bam_path, str(tmp_path / "x.parquet"), fmt="parquet")
+    assert not (tmp_path / "x.arrow").exists()
+
+
+# ------------------------------------------------------------ arrow sinks
+
+
+def test_arrow_ipc_sink(bam_path, tmp_path):
+    pa = pytest.importorskip("pyarrow")
+    out = tmp_path / "x.arrow"
+    summary = export(bam_path, str(out), fmt="arrow")
+    table = pa.ipc.open_file(str(out)).read_all()
+    assert table.num_rows == summary["rows"]
+    assert table.column_names == list(COLUMNS)
+    recs = list(load_bam(bam_path))
+    assert table.column("name")[0].as_py() == recs[0].read_name
+    assert table.column("pos")[-1].as_py() == recs[-1].pos
+    assert table.column("qual")[0].as_py() == bytes(recs[0].qual)
+
+
+def test_parquet_sink(bam_path, tmp_path):
+    pytest.importorskip("pyarrow")
+    import pyarrow.parquet as pq
+
+    out = tmp_path / "x.parquet"
+    summary = export(bam_path, str(out), fmt="parquet",
+                     columns="flag,pos,name")
+    table = pq.read_table(str(out))
+    assert table.num_rows == summary["rows"]
+    assert table.column_names == ["flag", "pos", "name"]
+    want = [r.pos for r in load_bam(bam_path)]
+    assert table.column("pos").to_pylist() == want
+
+
+# ------------------------------------------------------------ dataset API
+
+
+def test_dataset_to_batches_streams(bam_path):
+    ds = load_bam(bam_path)
+    batches = list(ds.to_batches(batch_rows=512, columns="flag,pos"))
+    assert all(b.column_names == ("flag", "pos") for b in batches)
+    assert all(b.num_rows <= 512 for b in batches)
+    total = sum(b.num_rows for b in batches)
+    assert total == len(list(load_bam(bam_path)))
+
+
+def test_empty_selection_writes_valid_container(bam_path, tmp_path):
+    out = tmp_path / "empty.sbcr"
+    summary = export(bam_path, str(out), fmt="native",
+                     flags_required=0x4)  # fixture has no unmapped reads
+    assert summary["rows"] == 0
+    meta, batches = read_container(str(out))
+    assert batches == [] or sum(b.num_rows for b in batches) == 0
+    assert tuple(meta["columns"]) == COLUMNS
+
+
+def test_batch_builder_empty_build():
+    b = BatchBuilder(COLUMNS)
+    batch = b.build()
+    assert batch.num_rows == 0
+    assert list(iter_rows(batch)) == []

@@ -1,0 +1,72 @@
+"""Loci parsing: genomic decimal suffixes and typed range validation.
+
+Genomic coordinates are base counts: ``5k`` is 5 000, not the 5 120 the
+byte-size parser would give. Malformed loci raise :class:`BadLociError`
+so the CLI can turn them into usage errors instead of stack traces.
+"""
+
+import pytest
+
+from spark_bam_tpu.load.intervals import BadLociError, LociSet, parse_locus
+
+
+def test_parse_locus_decimal_suffixes():
+    assert parse_locus("100") == 100
+    assert parse_locus("0") == 0
+    assert parse_locus("5k") == 5_000
+    assert parse_locus("5K") == 5_000
+    assert parse_locus("1.5m") == 1_500_000
+    assert parse_locus("2g") == 2_000_000_000
+    assert parse_locus(" 12k ") == 12_000
+    assert parse_locus("0.5k") == 500
+
+
+@pytest.mark.parametrize("bad", [
+    "", "-5", "5kb", "1..5k", "k", "5.25", "0.0005k", "1e6", "chr1", "5 k",
+])
+def test_parse_locus_rejects_malformed(bad):
+    with pytest.raises(BadLociError):
+        parse_locus(bad)
+
+
+def test_loci_set_parses_suffixed_ranges():
+    loci = LociSet.parse("chr1:5k-40k,chr2:1.5m-2m,chrM")
+    assert loci.intervals["chr1"] == [(5_000, 40_000)]
+    assert loci.intervals["chr2"] == [(1_500_000, 2_000_000)]
+    assert loci.intervals["chrM"] == []  # whole contig
+    assert loci.overlaps("chr1", 39_999, 40_500)
+    assert not loci.overlaps("chr1", 40_000, 40_500)
+    assert loci.overlaps("chrM", 0, 1)
+
+
+def test_loci_set_rejects_inverted_range():
+    with pytest.raises(BadLociError):
+        LociSet.parse("chr1:40k-5k")
+
+
+def test_loci_set_rejects_rangeless_colon():
+    with pytest.raises(BadLociError):
+        LociSet.parse("chr1:12345")
+
+
+@pytest.mark.parametrize("bad", ["chr1:a-b", "chr1:5kb-10kb", "chr1:-5-10"])
+def test_loci_set_rejects_garbage_coordinates(bad):
+    with pytest.raises(BadLociError):
+        LociSet.parse(bad)
+
+
+def test_loci_set_whole_contig_expansion_unchanged():
+    # ContigLengths shape: idx -> (name, length)
+    lengths = {0: ("chr1", 1000), 1: ("chr2", 2000)}
+    loci = LociSet.parse("chr2", lengths)
+    assert loci.intervals["chr2"] == [(0, 2000)]
+    # Unknown contigs stay whole-contig (empty list => match-all)
+    loci2 = LociSet.parse("chrUn", lengths)
+    assert loci2.intervals["chrUn"] == []
+
+
+def test_bad_loci_error_is_value_error():
+    # Callers that caught ValueError before the typed error keep working.
+    assert issubclass(BadLociError, ValueError)
+    with pytest.raises(ValueError):
+        LociSet.parse("chr1:9-1")
