@@ -1105,8 +1105,11 @@ def _child_serve(clients: int = 8, per_client: int = 3, seq_shots: int = 3):
     processes — each pays the import/trace/flatten cost the daemon
     amortizes. Equal-count gated on BOTH sides; also reports the
     batch-size distribution the coalescer actually achieved, client-side
-    p50/p99, and the warm-plan ``load.split_resolutions`` counter (must
-    be zero — the shared index tier claim, docs/serving.md)."""
+    p50/p99, and the warm-plan resolution delta from the WORKER'S OWN
+    ``stats`` counter (must be zero — the shared index tier claim,
+    docs/serving.md). Per-worker, not the process-global obs registry:
+    behind a fabric router the repeat plan may land on any worker, and
+    only the serving worker's counter proves ITS tier was warm."""
     _emit_stage("start")
     from spark_bam_tpu.core.platform import force_cpu_devices
 
@@ -1147,14 +1150,14 @@ def _child_serve(clients: int = 8, per_client: int = 3, seq_shots: int = 3):
                 _emit_stage("serve_warm")
 
                 # Repeat plan against the warm index: the auditable
-                # zero-resolution claim (docs/caching.md).
-                obs.shutdown()
-                reg = obs.configure()
+                # zero-resolution claim (docs/caching.md), measured as
+                # the delta of THIS worker's stats counter so the claim
+                # survives a router spilling other traffic elsewhere.
                 with ServeClient(addr) as c:
+                    before = c.request("stats")["split_resolutions"] or 0
                     c.request("plan", path=path, split_size=256 << 10)
-                warm_plan_res = _obs_stages(reg)["counters"].get(
-                    "load.split_resolutions", 0
-                )
+                    after = c.request("stats")["split_resolutions"] or 0
+                warm_plan_res = after - before
 
                 lat_ms: list = []
                 counts: list = []
@@ -1243,6 +1246,243 @@ def _child_serve(clients: int = 8, per_client: int = 3, seq_shots: int = 3):
         "serve_reqs": total,
         "serve_reads": expected,
         "serve_warm_plan_split_resolutions": warm_plan_res,
+    })
+
+
+def _child_fabric(clients: int = 16, per_client: int = 4):
+    """Fabric leg (docs/fabric.md): three serve workers behind the
+    router vs ONE worker, plus the control-plane proofs.
+
+    No jax in THIS process — the workers are real ``fabric.worker``
+    subprocesses (the same binary operators run) sharing a warm cache
+    dir; the router runs in-process on the serve accept loop. Phases:
+
+    1. **baseline** — one worker, ``clients`` concurrent connections ×
+       ``per_client`` requests → single-daemon RPS, plus the per-worker
+       warm-plan zero-resolution check and the ``batch`` frame
+       reference every later phase gates against byte-for-byte;
+    2. **fabric** — 3 workers behind the router, same load → fabric
+       RPS (equal-count + equal-bytes gated);
+    3. **SLO** — seeded latency injection (broadcast ``tune`` of the
+       batcher tick far above the fabric ceiling) pushes client p99
+       over ``slo_p99_ms``; the per-worker autoscaler must pull it
+       back under the SLO within the run (windowed client p99
+       before/after, plus the ``autoscale_moves`` counter);
+    4. **failover** — SIGKILL the rendezvous-affinity worker mid-load:
+       zero lost requests (every client call must answer — the load
+       loop re-raises), equal counts, byte-identical frames, and a
+       nonzero ``failovers`` counter.
+    """
+    _emit_stage("start")
+    import shutil
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    from spark_bam_tpu.benchmarks.synth import synthetic_fixture
+    from spark_bam_tpu.core.config import Config as C
+    from spark_bam_tpu.fabric import Router, WorkerPool, rendezvous_weight
+    from spark_bam_tpu.serve import ServeClient, ServerThread
+
+    path = str(synthetic_fixture())
+    tmp = tempfile.mkdtemp(prefix="sbt_fabric_leg_")
+    # Small windows/batches: one whole-file count spans several rows, so
+    # concurrent clients genuinely contend for dispatch slots.
+    spec = "window=64KB,halo=8KB,batch=8,tick=2"
+    wdev = 2                        # virtual CPU devices per worker
+    # Workers read Config.from_env: shared .sbi cache dir + readwrite
+    # mode, so the repeat plan is the zero-resolution warm-tier proof.
+    wenv = dict(os.environ, SPARK_BAM_CACHE_DIR=tmp,
+                SPARK_BAM_CACHE="readwrite")
+    lock = threading.Lock()
+
+    def warm(addr):
+        """Plan + count + batch on one worker; returns (count, frames,
+        repeat-plan resolution delta read from the worker's OWN stats —
+        the per-worker warm-tier proof, not the global obs registry)."""
+        with ServeClient(addr) as c:
+            c.request("plan", path=path, split_size=256 << 10)
+            n = c.request("count", path=path)["count"]
+            frames = c.request("batch", path=path)["_binary"]
+            before = c.request("stats")["split_resolutions"] or 0
+            c.request("plan", path=path, split_size=256 << 10)
+            after = c.request("stats")["split_resolutions"] or 0
+        return n, frames, after - before
+
+    def hammer(addr, expected, ref, nclients, per, on_done=None):
+        """Closed-loop load: ``nclients`` connections × ``per`` requests
+        (every 8th a ``batch``, the rest whole-file counts). Returns
+        (wall_s, sorted latency ms, batch_equal); any wrong count or
+        failed request raises — zero loss is a gate, not a metric."""
+        lat: list = []
+        equal = [True]
+
+        def one(ci):
+            with ServeClient(addr) as c:
+                for k in range(per):
+                    t0 = time.perf_counter()
+                    if (ci * per + k) % 8 == 0:
+                        r = c.request("batch", path=path)
+                        ok = b"".join(r["_binary"]) == ref
+                        with lock:
+                            equal[0] = equal[0] and ok
+                    else:
+                        n = c.request("count", path=path)["count"]
+                        if n != expected:
+                            raise AssertionError(
+                                f"count diverged: {n} != {expected}"
+                            )
+                    dt = (time.perf_counter() - t0) * 1e3
+                    with lock:
+                        lat.append(dt)
+                    if on_done is not None:
+                        on_done()
+
+        t0 = time.perf_counter()
+        with ThreadPoolExecutor(nclients) as ex:
+            for f in [ex.submit(one, i) for i in range(nclients)]:
+                f.result()      # re-raises: a lost request fails the leg
+        return time.perf_counter() - t0, sorted(lat), equal[0]
+
+    def p99(lat):
+        return lat[min(len(lat) - 1, int(len(lat) * 0.99))]
+
+    total = clients * per_client
+    try:
+        # --- phase 1: single-daemon baseline -----------------------------
+        with WorkerPool(workers=1, devices=wdev, serve=spec, env=wenv,
+                        stderr=subprocess.DEVNULL) as pool1:
+            addr1 = pool1.addresses[0]
+            expected, ref_frames, warm_res = warm(addr1)
+            ref = b"".join(ref_frames)
+            _emit_stage("fabric_baseline_warm")
+            wall1, lat1, eq1 = hammer(
+                addr1, expected, ref, clients, per_client
+            )
+        rps1 = total / wall1
+        _emit_stage(f"fabric_baseline:{rps1:.1f}rps")
+
+        # SLO derived from the measured single-daemon tail: above normal
+        # p99 by a margin, far below the injected latency — "over SLO"
+        # is unambiguously the injection, "under SLO" is recovery.
+        slo = min(1500.0, max(150.0, 2.0 * p99(lat1)))
+        inj_tick = max(300.0, 2.0 * slo)
+        # Ceilings pinned to the initial knob values: in-band up-moves
+        # are no-ops, so the throughput A/B runs with untouched knobs
+        # and recovery clamps the injected tick straight back.
+        fspec = (
+            f"workers=3,slo={slo:.0f},autoscale=250,probe=250,spill=4,"
+            "batch_floor=2,batch_ceil=8,tick_ceil=2,"
+            "scanq_floor=8,scanq_ceil=64,planq_floor=8,planq_ceil=64"
+        )
+
+        # --- phases 2-4: the fabric --------------------------------------
+        with WorkerPool(workers=3, devices=wdev, serve=spec, env=wenv,
+                        stderr=subprocess.DEVNULL) as pool3:
+            # Sequential warm-up: worker 0 compiles the serve step into
+            # the persistent cache, the others disk-hit it; every warm
+            # tier is hot before any routed traffic, so affinity AND
+            # spillover targets serve from warm state.
+            for a in pool3.addresses:
+                n, frames, res = warm(a)
+                if n != expected or b"".join(frames) != ref:
+                    raise AssertionError("worker warm-up diverged")
+                warm_res = max(warm_res, res)
+            _emit_stage("fabric_pool_warm")
+
+            router = Router(
+                pool3.addresses, config=C(fabric=fspec), pool=pool3
+            )
+            rsrv = ServerThread(router).start()
+            try:
+                raddr = rsrv.address
+                wall3, lat3, eq3 = hammer(
+                    raddr, expected, ref, clients, per_client
+                )
+                rps3 = total / wall3
+                _emit_stage(f"fabric_routed:{rps3:.1f}rps")
+
+                # --- phase 3: latency injection + autoscaler recovery ----
+                with ServeClient(raddr) as c:
+                    c.request("tune", tick_ms=inj_tick)
+                windows = []
+                deadline = time.monotonic() + 60.0
+                while time.monotonic() < deadline:
+                    _w, wlat, _e = hammer(raddr, expected, ref, 8, 2)
+                    windows.append(p99(wlat))
+                    if len(windows) >= 2 and windows[-1] < slo:
+                        break
+                p99_before, p99_after = windows[0], windows[-1]
+                with ServeClient(raddr) as c:
+                    moves = int(
+                        c.request("stats")["counters"]
+                        .get("autoscale_moves", 0)
+                    )
+                    # Operator restore: workers the windows never
+                    # touched hold position (control-loop hysteresis);
+                    # reset every knob for the failover phase.
+                    c.request("tune", tick_ms=2.0, batch_rows=8,
+                              scan_queue=64, plan_queue=64)
+                _emit_stage(
+                    f"fabric_slo:{p99_before:.0f}->{p99_after:.0f}ms"
+                    f"/{moves}moves"
+                )
+
+                # --- phase 4: SIGKILL the affinity worker mid-load -------
+                doomed = max(
+                    range(3),
+                    key=lambda i: rendezvous_weight(f"w{i}", path),
+                )
+                done = [0]
+                kill_at = max(2, total // 4)
+
+                def maybe_kill():
+                    with lock:
+                        done[0] += 1
+                        hit = done[0] == kill_at
+                    if hit:
+                        pool3.kill(doomed, hard=True)
+
+                wallk, latk, eqk = hammer(
+                    raddr, expected, ref, clients, per_client,
+                    on_done=maybe_kill,
+                )
+                with ServeClient(raddr) as c:
+                    stk = c.request("stats")
+            finally:
+                rsrv.stop()
+        _emit_stage("fabric_failover_done")
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    failovers = int(stk["counters"].get("failovers", 0))
+    healthy_after = sum(1 for w in stk["workers"].values() if w["healthy"])
+    if not (eq1 and eq3 and eqk):
+        raise AssertionError("fabric batch frames diverged from single daemon")
+    _emit_result("fabric", {
+        "fabric_workers": 3,
+        "fabric_clients": clients,
+        "fabric_reqs": total,
+        "fabric_reads": expected,
+        "fabric_single_rps": round(rps1, 1),
+        "fabric_rps": round(rps3, 1),
+        "fabric_speedup": round(rps3 / max(rps1, 1e-9), 2),
+        "fabric_single_p99_ms": round(p99(lat1), 1),
+        "fabric_p99_ms": round(p99(lat3), 1),
+        "fabric_batch_equal": True,
+        "fabric_warm_plan_split_resolutions": int(warm_res),
+        "fabric_slo_p99_ms": round(slo, 1),
+        "fabric_injected_tick_ms": round(inj_tick, 1),
+        "fabric_p99_before_ms": round(p99_before, 1),
+        "fabric_p99_after_ms": round(p99_after, 1),
+        "fabric_slo_recovered": bool(p99_before > slo > p99_after),
+        "fabric_autoscale_moves": moves,
+        "fabric_killed_worker": f"w{doomed}",
+        "fabric_failovers": failovers,
+        "fabric_lost": 0,   # the load loop re-raises; reaching here proves it
+        "fabric_kill_rps": round(total / wallk, 1),
+        "fabric_kill_p99_ms": round(p99(latk), 1),
+        "fabric_healthy_after_kill": healthy_after,
+        "fabric_spilled": int(stk["counters"].get("spilled", 0)),
     })
 
 
@@ -2116,6 +2356,23 @@ def serve_leg():
     return out
 
 
+def fabric_leg():
+    """Parent wrapper for the fabric leg (own child: subprocess workers
+    + the asyncio router, no jax in the child itself — but isolated so
+    a wedged worker cannot take the driver down). Budget env-tunable;
+    0 skips the leg."""
+    budget = int(os.environ.get("SB_BENCH_FABRIC_CHILD_S", "420"))
+    if budget <= 0:
+        return {}
+    results, stages, err = _run_child(["--child-fabric"], budget)
+    out = results.get("fabric")
+    if out is None:
+        raise RuntimeError(
+            f"fabric child produced no result: {err or 'stages=' + str(stages)}"
+        )
+    return out
+
+
 def main():
     if len(sys.argv) > 1 and sys.argv[1] == "--child-all":
         _child_device_all(
@@ -2143,6 +2400,9 @@ def main():
         return
     if len(sys.argv) > 1 and sys.argv[1] == "--child-export":
         _child_export()
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == "--child-fabric":
+        _child_fabric()
         return
 
     record = {
@@ -2543,6 +2803,13 @@ def _main_measure(record, warnings, errors):
         record.update(export_leg())
     except Exception as e:
         warnings.append(f"export leg: {type(e).__name__}: {e}")
+    # Fabric leg: 3 subprocess workers behind the router vs one daemon,
+    # plus SLO-autoscaler recovery and SIGKILL failover (own child
+    # process; equal-count/equal-bytes gated — docs/fabric.md).
+    try:
+        record.update(fabric_leg())
+    except Exception as e:
+        warnings.append(f"fabric leg: {type(e).__name__}: {e}")
     # Host-zlib vs two-phase device inflate on identical windows
     # (in-process backend). setdefault: the inflate child's TPU-measured
     # first-class fields win when they landed; this leg guarantees the

@@ -326,6 +326,42 @@ def build_parser() -> argparse.ArgumentParser:
     sub.add_argument("-w", "--warn", action="store_true",
                      help="root log level WARN")
 
+    # Serve fabric control plane: launch (or attach to) N serve workers
+    # and front them with the affinity router + health prober + SLO
+    # autoscaler (docs/fabric.md). Same wire protocol as `serve`.
+    sub = sp.add_parser("fabric")
+    _add_metrics(sub)
+    _add_faults(sub)
+    sub.add_argument(
+        "--fabric", default=None, metavar="SPEC",
+        help="fabric knobs, e.g. 'workers=3,slo=200,probe=500,spill=8,"
+             "batch_ceil=32' (SPARK_BAM_FABRIC env var works too; "
+             "docs/fabric.md)",
+    )
+    sub.add_argument(
+        "--serve", default=None, metavar="SPEC",
+        help="per-worker serving knobs, forwarded to every launched "
+             "worker (docs/serving.md)",
+    )
+    sub.add_argument(
+        "--listen", default="tcp:127.0.0.1:8765", metavar="ADDR",
+        help="router address: unix:<path> or tcp:<host>:<port> "
+             "(default tcp:127.0.0.1:8765)",
+    )
+    sub.add_argument(
+        "--attach", action="append", default=None, metavar="ADDR",
+        help="attach to an already-running worker instead of launching "
+             "(repeatable — point one at every host's `multihost --serve` "
+             "address for the multi-host fabric)",
+    )
+    sub.add_argument(
+        "--worker-devices", type=int, default=0, metavar="N",
+        help="virtual CPU devices per LAUNCHED worker (dev boxes; "
+             "0 = each worker's real local devices)",
+    )
+    sub.add_argument("-w", "--warn", action="store_true",
+                     help="root log level WARN")
+
     # Render a --metrics-out JSONL trace as the reference stats format.
     sub = sp.add_parser("metrics-report")
     sub.add_argument("-o", "--out", default=None, help="write output to file")
@@ -400,6 +436,11 @@ def main(argv=None) -> int:
 
             ServeConfig.parse(args.serve)  # fail before any work starts
             config = config.replace(serve=args.serve)
+        if getattr(args, "fabric", None) is not None:
+            from spark_bam_tpu.fabric import FabricConfig
+
+            FabricConfig.parse(args.fabric)  # fail before any work starts
+            config = config.replace(fabric=args.fabric)
         if getattr(args, "listen", None) is not None:
             from spark_bam_tpu.serve import ServeAddress
 
@@ -586,6 +627,41 @@ def main(argv=None) -> int:
                 pass
             finally:
                 service.close()
+        elif cmd == "fabric":
+            import signal as _signal
+
+            from spark_bam_tpu.fabric import Router, WorkerPool
+            from spark_bam_tpu.serve import serve_forever
+
+            fcfg = config.fabric_config
+            pool = WorkerPool(
+                workers=fcfg.workers, devices=args.worker_devices,
+                serve=config.serve, columnar=config.columnar,
+                attach=args.attach,
+            )
+            addresses = pool.start()
+            router = Router(addresses, config=config, pool=pool)
+            print(
+                f"fabric: routing on {args.listen} over "
+                f"{len(addresses)} workers "
+                f"({'attached' if args.attach else 'launched'}: "
+                f"{', '.join(addresses)}) — Ctrl-C to stop",
+                file=sys.stderr,
+            )
+
+            def _graceful(signum, frame):
+                # Drain: stop routing new work; workers get SIGTERM in
+                # the finally and finish their in-flight ticks unshed.
+                router.draining = True
+                raise KeyboardInterrupt
+
+            _signal.signal(_signal.SIGTERM, _graceful)
+            try:
+                serve_forever(router, args.listen)
+            except KeyboardInterrupt:
+                pass
+            finally:
+                pool.terminate()
         elif cmd == "metrics-report":
             from spark_bam_tpu.cli import metrics_report
 

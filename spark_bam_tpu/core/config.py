@@ -139,6 +139,13 @@ class Config:
     # counts, native-container compression, and the default projection
     # for the export sinks and the serve ``batch`` op.
     columnar: str = ""
+    # --- serve fabric control plane (fabric/; docs/fabric.md) ---
+    # Compact FabricConfig spec ("workers=3,slo=200,probe=500,spill=8";
+    # "" = defaults). Same string-spec pattern; ``fabric_config`` parses
+    # it (cached). Governs the router's worker pool, affinity spillover,
+    # health probe/eject pacing, and the SLO autoscaler's target and
+    # actuation floors/ceilings.
+    fabric: str = ""
     # --- candidate funnel (tpu/checker.py; docs/design.md) ---
     # Two-stage checker hot path: cheap fixed-block prefilter over every
     # position, full 19-flag pass only on survivors. "auto" (default)
@@ -218,6 +225,13 @@ class Config:
         from spark_bam_tpu.columnar.config import ColumnarConfig
 
         return ColumnarConfig.parse(self.columnar)
+
+    @property
+    def fabric_config(self):
+        """The parsed ``FabricConfig`` for this config's ``fabric`` spec."""
+        from spark_bam_tpu.fabric.config import FabricConfig
+
+        return FabricConfig.parse(self.fabric)
 
     def funnel_enabled(self, full_masks: bool = False) -> bool:
         """Whether a projection should run the two-stage candidate funnel.

@@ -1,0 +1,37 @@
+"""Serve fabric: the control plane above the single-host serve daemon.
+
+A router with file-path affinity fronts N serve workers (one per host
+over ``jax.distributed``, or N local processes), each running its own
+accept loop, compiled ``MeshSteps``, flat-view LRU and ``.sbi`` warm
+tier. Health probes eject dead workers with exponential re-probe; a
+per-worker SLO control loop retunes ``batch_rows``/``tick_ms`` and the
+admission caps from the same ``stats`` percentiles operators read; a
+worker dying mid-request fails idempotent ops over to another worker
+exactly once, byte-identically. See docs/fabric.md.
+"""
+
+from spark_bam_tpu.fabric.autoscaler import autoscale_worker, decide
+from spark_bam_tpu.fabric.config import FabricConfig
+from spark_bam_tpu.fabric.health import monitor_worker
+from spark_bam_tpu.fabric.router import (
+    IDEMPOTENT_OPS,
+    Router,
+    WorkerLink,
+    WorkerLost,
+    rendezvous_weight,
+)
+from spark_bam_tpu.fabric.worker import WorkerPool, serve_worker
+
+__all__ = [
+    "FabricConfig",
+    "IDEMPOTENT_OPS",
+    "Router",
+    "WorkerLink",
+    "WorkerLost",
+    "WorkerPool",
+    "autoscale_worker",
+    "decide",
+    "monitor_worker",
+    "rendezvous_weight",
+    "serve_worker",
+]

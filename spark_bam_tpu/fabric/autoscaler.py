@@ -1,0 +1,101 @@
+"""SLO autoscaler: per-worker control loop holding a target p99.
+
+Every ``autoscale_ms`` the loop reads the worker's ``stats`` op — the
+SAME per-op p50/p99 ledger operators read, not a private side channel —
+and compares the overall ``latency_p99_ms`` against
+``FabricConfig.slo_p99_ms``:
+
+- p99 ABOVE the SLO → step every knob toward its floor (halve
+  ``batch_rows`` and ``tick_ms``, halve the scan/plan admission caps):
+  smaller ticks finish sooner, lower caps shed earlier so queue wait
+  stops compounding the tail.
+- p99 under HALF the SLO → step gently toward the ceilings (+25%):
+  reclaim batching throughput when latency headroom is back.
+- otherwise, or when no new requests were served since the last look
+  (no fresh samples), hold — hysteresis against flapping on stale tails.
+
+Decisions are pure (:func:`decide` — unit-testable); actuation is one
+``tune`` op per move (counted ``autoscale_moves``). Floors/ceilings live
+in :class:`~spark_bam_tpu.fabric.config.FabricConfig`; the worker
+applies whatever it is told (serve/service.py ``tune``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+
+def _down(value, floor):
+    return max(floor, min(value, floor) if value <= floor else value / 2)
+
+
+def _up(value, ceil):
+    return min(ceil, max(value + 1, value * 1.25))
+
+
+def decide(stats: dict, fcfg) -> "dict | None":
+    """The tune fields (if any) for one worker given its ``stats`` payload.
+
+    Returns None to hold. Values are already clamped to the config's
+    floors/ceilings; ints stay ints (batch_rows/caps), tick stays float.
+    """
+    p99 = stats.get("latency_p99_ms")
+    if p99 is None:
+        return None
+    batch = int(stats.get("batch_rows") or 1)
+    tick = float(stats.get("tick_ms") or 0.0)
+    limits = stats.get("limits") or {}
+    scanq = int(limits.get("scan") or fcfg.scanq_ceil)
+    planq = int(limits.get("plan") or fcfg.planq_ceil)
+    move: dict = {}
+    if p99 > fcfg.slo_p99_ms:
+        new_batch = int(_down(min(batch, fcfg.batch_ceil), fcfg.batch_floor))
+        new_tick = float(_down(min(tick, fcfg.tick_ceil), fcfg.tick_floor))
+        new_scanq = int(_down(min(scanq, fcfg.scanq_ceil), fcfg.scanq_floor))
+        new_planq = int(_down(min(planq, fcfg.planq_ceil), fcfg.planq_floor))
+    elif p99 < 0.5 * fcfg.slo_p99_ms:
+        new_batch = int(_up(batch, fcfg.batch_ceil))
+        new_tick = min(float(_up(tick, fcfg.tick_ceil)), fcfg.tick_ceil)
+        new_scanq = int(_up(scanq, fcfg.scanq_ceil))
+        new_planq = int(_up(planq, fcfg.planq_ceil))
+    else:
+        return None
+    if new_batch != batch:
+        move["batch_rows"] = new_batch
+    if abs(new_tick - tick) > 1e-9:
+        move["tick_ms"] = round(new_tick, 3)
+    if new_scanq != scanq:
+        move["scan_queue"] = new_scanq
+    if new_planq != planq:
+        move["plan_queue"] = new_planq
+    return move or None
+
+
+async def autoscale_worker(link, fcfg, count) -> None:
+    """Control loop for one worker link; ``count`` is the router's
+    counter hook (``autoscale_moves``)."""
+    prev_served = None
+    while True:
+        await asyncio.sleep(fcfg.autoscale_ms / 1000.0)
+        if not link.healthy or link.draining:
+            continue
+        try:
+            stats = await link.request({"op": "stats"})
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            continue
+        served = stats.get("served")
+        if prev_served is not None and served == prev_served:
+            continue                 # no fresh samples → hold
+        prev_served = served
+        move = decide(stats, fcfg)
+        if not move:
+            continue
+        try:
+            await link.request({"op": "tune", **move})
+            count("autoscale_moves")
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            continue

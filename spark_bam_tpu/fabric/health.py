@@ -1,0 +1,59 @@
+"""Per-worker health: ping probes, ejection, exponential re-probe.
+
+One :func:`monitor_worker` task per link runs forever on the router's
+loop. Healthy workers get a ``ping`` every ``probe_ms``; a probe that
+times out (``probe_timeout_ms``) or errors ejects the worker — placement
+stops immediately, pending requests on the link fail over. Ejected
+workers are re-probed on a doubling backoff (``eject_ms`` →
+``eject_max_ms``); the first successful reconnect+ping reinstates them.
+
+Connection-level death (reader EOF on a kill) does NOT wait for a probe:
+the link marks itself unhealthy the moment the socket dies
+(``WorkerLink._fail``), so failover latency is bounded by TCP teardown,
+not the probe period. The monitor's job is then just reinstatement.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+
+async def _ping(link, timeout_s: float) -> None:
+    await asyncio.wait_for(link.request({"op": "ping"}), timeout=timeout_s)
+
+
+async def monitor_worker(link, fcfg, count) -> None:
+    """Probe loop for one worker link; ``count`` is the router's counter
+    hook (``ejected`` / ``reinstated``)."""
+    backoff_ms = fcfg.eject_ms
+    timeout_s = fcfg.probe_timeout_ms / 1000.0
+    while True:
+        if link.healthy:
+            await asyncio.sleep(fcfg.probe_ms / 1000.0)
+            if not link.healthy:
+                # Died between probes (connection-level ejection).
+                count("ejected")
+                backoff_ms = fcfg.eject_ms
+                continue
+            try:
+                await _ping(link, timeout_s)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                link.healthy = False
+                link._teardown()
+                count("ejected")
+                backoff_ms = fcfg.eject_ms
+        else:
+            await asyncio.sleep(backoff_ms / 1000.0)
+            try:
+                await link.connect()
+                await _ping(link, timeout_s)
+                backoff_ms = fcfg.eject_ms
+                count("reinstated")
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                link.healthy = False
+                link._teardown()
+                backoff_ms = min(backoff_ms * 2, fcfg.eject_max_ms)

@@ -1,0 +1,430 @@
+"""Fabric router: affinity placement, spillover, failover, admin fan-out.
+
+The front end of the serve fabric (docs/fabric.md). It speaks the SAME
+newline-JSON (+ ``batch`` frame) protocol as a single worker, so clients
+cannot tell a router from a daemon — and it reuses the serve accept loop
+unchanged (``server._handle_connection`` duck-types on ``submit``).
+
+Placement: requests carrying a ``path`` go to the worker that wins a
+rendezvous (highest-random-weight) hash over ``(worker id, path)`` —
+repeat queries for a file land on the worker whose flat-view LRU and
+``.sbi`` store are already warm. When the affinity target already has
+``FabricConfig.spill`` requests in flight, the request spills to the
+least-loaded healthy worker instead (counted ``fabric.spilled``).
+Path-less ops (``fleet``) always go least-loaded.
+
+Failover: a worker dying mid-request fails every request pending on its
+link with :class:`WorkerLost`; idempotent ops (``plan`` /
+``record_starts`` / ``count`` / ``batch``) are re-dispatched to another
+worker exactly ONCE per request, everything else surfaces a typed
+``WorkerLost`` error. The router buffers a worker's complete response
+(JSON + all binary frames) before relaying it, so a mid-stream death
+never leaks partial frames to the client — the failover answer is
+byte-identical to a healthy worker's.
+
+Upstream ``Overloaded``/``Draining`` answers spill across the remaining
+workers; only when EVERY healthy worker sheds does the router pace a
+jittered ``FaultPolicy`` retry round, and after the retry budget it
+relays the shed response for the client's own retry loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import struct
+
+from spark_bam_tpu import obs
+from spark_bam_tpu.core.config import Config
+from spark_bam_tpu.core.faults import FaultPolicy
+from spark_bam_tpu.fabric.config import FabricConfig
+from spark_bam_tpu.serve.protocol import error_response, ok_response
+from spark_bam_tpu.serve.server import MAX_LINE, ServeAddress
+
+#: ops safe to re-dispatch after a mid-request worker death: pure reads
+#: whose answers are deterministic for unchanged files.
+IDEMPOTENT_OPS = frozenset({"plan", "record_starts", "count", "batch"})
+
+
+class WorkerLost(ConnectionError):
+    """The worker died (or its link closed) with this request pending."""
+
+
+def rendezvous_weight(wid: str, path: str) -> int:
+    """Stable highest-random-weight score for (worker, path). blake2b,
+    not ``hash()`` — placement must agree across processes and runs."""
+    h = hashlib.blake2b(f"{wid}|{path}".encode(), digest_size=8)
+    return int.from_bytes(h.digest(), "big")
+
+
+class WorkerLink:
+    """One multiplexed upstream connection to a serve worker.
+
+    Requests are re-keyed to router-assigned ids so many client
+    connections share the link; one reader task resolves responses
+    (JSON line + in-order binary frames) back to their futures. A dead
+    connection fails every pending future with :class:`WorkerLost` and
+    marks the link unhealthy immediately — the health monitor owns
+    re-probe and reinstatement.
+    """
+
+    def __init__(self, wid: str, address: str):
+        self.wid = wid
+        self.address = ServeAddress(
+            address if str(address).startswith(("unix:", "tcp:"))
+            else str(address)
+        )
+        self.healthy = False
+        self.draining = False
+        self._reader = None
+        self._writer = None
+        self._reader_task = None
+        self._pending: "dict[int, asyncio.Future]" = {}
+        self._next_id = 0
+        self._conn_lock = asyncio.Lock()
+
+    @property
+    def inflight(self) -> int:
+        return len(self._pending)
+
+    async def connect(self) -> None:
+        async with self._conn_lock:
+            if self._writer is not None:
+                return
+            if self.address.kind == "unix":
+                r, w = await asyncio.open_unix_connection(
+                    self.address.path, limit=MAX_LINE
+                )
+            else:
+                r, w = await asyncio.open_connection(
+                    self.address.host, self.address.port, limit=MAX_LINE
+                )
+            self._reader, self._writer = r, w
+            self._reader_task = asyncio.ensure_future(self._read_loop())
+            self.healthy = True
+
+    async def request(self, req: dict) -> dict:
+        """Send ``req`` upstream and await its COMPLETE response (frames
+        included). Raises :class:`WorkerLost` if the link dies first."""
+        if self._writer is None:
+            try:
+                await self.connect()
+            except (ConnectionError, OSError) as exc:
+                self.healthy = False
+                raise WorkerLost(f"worker {self.wid}: {exc}") from exc
+        self._next_id += 1
+        uid = self._next_id
+        orig_id = req.get("id")
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[uid] = fut
+        try:
+            self._writer.write(
+                (json.dumps({**req, "id": uid}) + "\n").encode()
+            )
+            await self._writer.drain()
+        except (ConnectionError, OSError) as exc:
+            self._pending.pop(uid, None)
+            self._fail(exc)
+            raise WorkerLost(f"worker {self.wid}: {exc}") from exc
+        resp = await fut
+        resp["id"] = orig_id
+        return resp
+
+    async def _read_loop(self) -> None:
+        try:
+            while True:
+                line = await self._reader.readline()
+                if not line:
+                    raise ConnectionError("worker closed the connection")
+                resp = json.loads(line)
+                n = int(resp.get("binary_frames") or 0)
+                if n:
+                    frames = []
+                    for _ in range(n):
+                        hdr = await self._reader.readexactly(8)
+                        (length,) = struct.unpack("<Q", hdr)
+                        frames.append(await self._reader.readexactly(length))
+                    resp["_binary"] = frames
+                fut = self._pending.pop(resp.get("id"), None)
+                if fut is not None and not fut.done():
+                    fut.set_result(resp)
+        except asyncio.CancelledError:
+            raise
+        except Exception as exc:
+            self._fail(exc)
+
+    def _fail(self, exc: BaseException) -> None:
+        """Connection-level death: mark down NOW (placement must stop
+        choosing this link before any probe runs) and fail all pending."""
+        self.healthy = False
+        pending, self._pending = self._pending, {}
+        for fut in pending.values():
+            if not fut.done():
+                fut.set_exception(
+                    WorkerLost(f"worker {self.wid} died: {exc}")
+                )
+        self._teardown()
+
+    def _teardown(self) -> None:
+        w, self._writer = self._writer, None
+        self._reader = None
+        if w is not None:
+            try:
+                w.close()
+            except Exception:
+                pass
+
+    async def close(self) -> None:
+        self.healthy = False
+        if self._reader_task is not None:
+            self._reader_task.cancel()
+            self._reader_task = None
+        self._fail(ConnectionError("link closed"))
+
+
+class Router:
+    """Fabric front end; see the module docstring. Lives on one event
+    loop (the serve accept loop's); ``submit`` returns an awaitable, so
+    it slots into ``server._handle_connection`` where a
+    :class:`~spark_bam_tpu.serve.service.SplitService` otherwise goes.
+    """
+
+    def __init__(self, addresses: "list[str]",
+                 config: "Config | None" = None, pool=None):
+        self.config = config if config is not None else Config()
+        self.fcfg: FabricConfig = self.config.fabric_config
+        self.policy: FaultPolicy = self.config.fault_policy
+        self.links = [
+            WorkerLink(f"w{i}", addr) for i, addr in enumerate(addresses)
+        ]
+        self.pool = pool            # optional WorkerPool (drain → terminate)
+        self.draining = False
+        self.counters: "dict[str, int]" = {}
+        self._tasks: "list[asyncio.Task]" = []
+        self._start_task: "asyncio.Task | None" = None
+
+    # ------------------------------------------------------------ lifecycle
+    async def ensure_started(self) -> None:
+        """Connect links and spawn health/autoscale loops on the RUNNING
+        loop — lazily, because the serve accept loop owns the loop and
+        only enters async context once a request arrives. Concurrent
+        first requests all await the SAME bring-up task: routing before
+        the links connect would misread every worker as unhealthy."""
+        if self._start_task is None:
+            self._start_task = asyncio.ensure_future(self._start())
+        await self._start_task
+
+    async def _start(self) -> None:
+        for link in self.links:
+            try:
+                await link.connect()
+            except Exception:
+                link.healthy = False   # monitor takes it from here
+        from spark_bam_tpu.fabric.autoscaler import autoscale_worker
+        from spark_bam_tpu.fabric.health import monitor_worker
+
+        for link in self.links:
+            self._tasks.append(asyncio.ensure_future(
+                monitor_worker(link, self.fcfg, self._count)
+            ))
+            self._tasks.append(asyncio.ensure_future(
+                autoscale_worker(link, self.fcfg, self._count)
+            ))
+
+    async def aclose(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        if self._tasks:
+            await asyncio.gather(*self._tasks, return_exceptions=True)
+        self._tasks = []
+        for link in self.links:
+            await link.close()
+
+    def _count(self, name: str, n: int = 1) -> None:
+        self.counters[name] = self.counters.get(name, 0) + n
+        obs.count(f"fabric.{name}", n)
+
+    # ------------------------------------------------------------ placement
+    def healthy_links(self, exclude=()) -> "list[WorkerLink]":
+        return [l for l in self.links
+                if l.healthy and not l.draining and l.wid not in exclude]
+
+    def pick(self, path: "str | None",
+             exclude=()) -> "WorkerLink | None":
+        """Affinity target (rendezvous winner) unless saturated, else
+        least-loaded; path-less requests always go least-loaded."""
+        cands = self.healthy_links(exclude)
+        if not cands:
+            return None
+        if path:
+            primary = max(
+                cands, key=lambda l: rendezvous_weight(l.wid, str(path))
+            )
+            if primary.inflight < self.fcfg.spill:
+                return primary
+            spill = min(cands, key=lambda l: l.inflight)
+            if spill is not primary:
+                self._count("spilled")
+            return spill
+        return min(cands, key=lambda l: l.inflight)
+
+    # -------------------------------------------------------------- serving
+    async def submit(self, req: dict) -> dict:
+        """The accept loop's entry point (awaitable counterpart of
+        ``SplitService.submit``)."""
+        await self.ensure_started()
+        op = req.get("op")
+        if op == "ping":
+            return ok_response(
+                req, pong=True, fabric=True,
+                workers=len(self.healthy_links()),
+            )
+        if op == "stats":
+            return await self._stats(req)
+        if op == "drain":
+            return await self._drain(req)
+        if op == "tune":
+            return await self._tune(req)
+        if self.draining:
+            return error_response(
+                req, "Draining", "fabric is draining; route elsewhere",
+            )
+        return await self._route(req)
+
+    async def _route(self, req: dict) -> dict:
+        op = req.get("op")
+        path = req.get("path")
+        idempotent = op in IDEMPOTENT_OPS
+        failed_over = False
+        shed_resp = None
+        for round_no in range(self.policy.max_retries + 1):
+            tried: set = set()
+            while True:
+                link = self.pick(path, exclude=tried)
+                if link is None:
+                    break           # every healthy worker tried this round
+                tried.add(link.wid)
+                try:
+                    resp = await link.request(req)
+                except WorkerLost:
+                    if not idempotent or failed_over:
+                        self._count("lost")
+                        return error_response(
+                            req, "WorkerLost",
+                            f"worker {link.wid} died mid-{op}; "
+                            "op is not re-dispatchable"
+                            if not idempotent else
+                            f"worker {link.wid} died mid-{op} after failover",
+                        )
+                    failed_over = True
+                    self._count("failovers")
+                    continue        # exactly one re-dispatch
+                if (resp.get("ok") is False
+                        and resp.get("error") in ("Overloaded", "Draining")):
+                    shed_resp = resp
+                    continue        # spill to the next-best worker
+                self._count("routed")
+                return resp
+            if shed_resp is None:
+                return error_response(
+                    req, "WorkerLost", "no healthy workers in the fabric",
+                )
+            if round_no >= self.policy.max_retries:
+                break
+            hint_ms = float(shed_resp.get("retry_after_ms") or 0.0)
+            await asyncio.sleep(
+                max(hint_ms / 1000.0, self.policy.backoff_delay(round_no))
+            )
+        self._count("relayed_overload")
+        return shed_resp
+
+    # ------------------------------------------------------------ admin ops
+    def _admin_targets(self, req: dict) -> "list[WorkerLink]":
+        wid = req.get("worker")
+        if wid is None:
+            return list(self.links)
+        links = [l for l in self.links if l.wid == wid]
+        if not links:
+            raise KeyError(f"unknown worker {wid!r}")
+        return links
+
+    async def _forward_admin(self, req: dict,
+                             links: "list[WorkerLink]") -> dict:
+        fwd = {k: v for k, v in req.items() if k != "worker"}
+
+        async def one(link):
+            try:
+                resp = await link.request(dict(fwd))
+                return {k: v for k, v in resp.items() if k != "id"}
+            except Exception as exc:
+                return {"ok": False, "error": "WorkerLost", "message": str(exc)}
+
+        results = await asyncio.gather(*(one(l) for l in links))
+        return {l.wid: r for l, r in zip(links, results)}
+
+    async def _drain(self, req: dict) -> dict:
+        """Router-level graceful drain: stop routing new work, forward
+        ``drain`` so each worker refuses its own new arrivals, report the
+        remaining inflight so the operator can watch it reach zero. A
+        ``worker`` field narrows the drain to one worker (the router just
+        stops placing work there)."""
+        try:
+            links = self._admin_targets(req)
+        except KeyError as exc:
+            return error_response(req, "ProtocolError", str(exc))
+        if req.get("worker") is None:
+            self.draining = True
+        for link in links:
+            link.draining = True
+        self._count("drained", len(links))
+        per_worker = await self._forward_admin({"op": "drain"}, links)
+        return ok_response(
+            req, draining=True,
+            workers={w: r.get("inflight") for w, r in per_worker.items()},
+        )
+
+    async def _tune(self, req: dict) -> dict:
+        """Fan a ``tune`` out to one worker (``worker`` field) or all —
+        the autoscaler uses the per-worker form; operators may broadcast."""
+        try:
+            links = self._admin_targets(req)
+        except KeyError as exc:
+            return error_response(req, "ProtocolError", str(exc))
+        per_worker = await self._forward_admin(req, links)
+        ok = all(r.get("ok") for r in per_worker.values())
+        if not ok:
+            return error_response(
+                req, "Internal", "tune failed on some workers",
+                workers=per_worker,
+            )
+        return ok_response(req, workers=per_worker)
+
+    async def _stats(self, req: dict) -> dict:
+        links = list(self.links)
+
+        async def one(link):
+            if not link.healthy:
+                return None
+            try:
+                resp = await link.request({"op": "stats"})
+            except Exception:
+                return None
+            return {k: v for k, v in resp.items() if k not in ("id", "ok")}
+
+        upstream = await asyncio.gather(*(one(l) for l in links))
+        workers = {
+            l.wid: {
+                "address": l.address.spec,
+                "healthy": bool(l.healthy),
+                "draining": bool(l.draining),
+                "inflight": int(l.inflight),
+                "stats": stats,
+            }
+            for l, stats in zip(links, upstream)
+        }
+        return ok_response(
+            req, fabric=True, draining=bool(self.draining),
+            counters=dict(sorted(self.counters.items())),
+            workers=workers,
+        )

@@ -35,6 +35,15 @@ def make_mesh(devices=None, axis: str = "data") -> Mesh:
     return Mesh(np.array(devices), (axis,))
 
 
+def local_mesh(axis: str = "data") -> Mesh:
+    """Mesh over THIS process's addressable devices — the per-host
+    serving loop's mesh (fabric/worker.py). A step compiled over the
+    global multi-host mesh is collective: every process must enter every
+    dispatch, which deadlocks a worker answering only its own requests.
+    Single-host, this is exactly ``make_mesh()``."""
+    return make_mesh(jax.local_devices(), axis)
+
+
 class MeshSteps:
     """Resident per-mesh step registry: shardings and jit'd ``shard_map``
     steps built ONCE and reused for the mesh's lifetime.

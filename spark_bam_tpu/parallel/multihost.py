@@ -243,6 +243,16 @@ def main(argv=None) -> int:
         "--bam", default=None,
         help="real-data mode: shard this BAM by block ranges and count reads",
     )
+    ap.add_argument(
+        "--serve", default=None, metavar="LISTEN",
+        help="fabric-worker mode: after the jax.distributed bring-up, run "
+             "one serving loop over THIS host's local devices listening on "
+             "LISTEN (tcp:host:port / unix:path) until SIGTERM-drained — "
+             "the per-host half of the serve fabric (docs/fabric.md); "
+             "point the fabric router at every host's announced address",
+    )
+    ap.add_argument("--serve-spec", default="",
+                    help="ServeConfig spec override (fabric-worker mode)")
     ap.add_argument("--row-bytes", type=int, default=8 << 20,
                     help="uncompressed bytes owned per row (--bam mode)")
     ap.add_argument("--halo", type=int, default=4 << 20,
@@ -252,6 +262,14 @@ def main(argv=None) -> int:
                     help="host window-buffer budget per step call "
                          "(--bam mode; bounds host memory per chunk)")
     a = ap.parse_args(argv)
+    if a.serve:
+        from spark_bam_tpu.fabric.worker import serve_worker
+
+        return serve_worker(
+            listen=a.serve, devices=a.local_devices, serve=a.serve_spec,
+            coordinator=a.coordinator, num_processes=a.num_processes,
+            process_id=a.process_id,
+        )
     if a.bam:
         stats = run_worker_bam(
             a.bam, a.coordinator, a.num_processes, a.process_id,

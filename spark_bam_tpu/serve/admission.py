@@ -59,6 +59,19 @@ class AdmissionGate:
             depth = sum(self._inflight.values())
         obs.gauge("serve.queue_depth").set(depth)
 
+    def set_limit(self, klass: str, limit: int) -> int:
+        """Retarget one class's inflight cap (the ``tune`` op / fabric
+        autoscaler actuator). In-flight requests above a lowered cap
+        drain naturally; only new admissions see the new limit."""
+        limit = int(limit)
+        if limit < 1:
+            raise ValueError(f"admission limit must be >= 1: {limit}")
+        with self._lock:
+            if klass not in self.limits:
+                raise KeyError(klass)
+            self.limits[klass] = limit
+        return limit
+
     def release(self, klass: str) -> None:
         with self._lock:
             self._inflight[klass] -= 1

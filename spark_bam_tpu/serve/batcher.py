@@ -58,6 +58,7 @@ class Batcher:
                  funnel: bool = False):
         ndev = steps.mesh.devices.size
         self.steps = steps
+        self.ndev = int(ndev)
         self.width = int(width)                      # window + PAD
         self.batch_rows = -(-int(batch_rows) // ndev) * ndev
         self.tick_s = float(tick_ms) / 1000.0
@@ -83,6 +84,23 @@ class Batcher:
             self._queue.append(task)
             self._cond.notify()
         return task.future
+
+    def set_batch_rows(self, batch_rows: int) -> int:
+        """Retarget rows-per-tick at runtime (the ``tune`` op / fabric
+        autoscaler). Rounded up to a mesh-size multiple as at startup, so
+        the set of dispatch shapes — hence compiled executables — stays
+        small and mesh-aligned. Returns the applied (rounded) value."""
+        rows = -(-max(1, int(batch_rows)) // self.ndev) * self.ndev
+        with self._cond:
+            self.batch_rows = rows
+            self._cond.notify()
+        return rows
+
+    def set_tick_ms(self, tick_ms: float) -> float:
+        """Retarget the gather window (host-side only — no recompile)."""
+        tick_ms = max(0.0, float(tick_ms))
+        self.tick_s = tick_ms / 1000.0
+        return tick_ms
 
     def pause(self) -> None:
         """Hold dispatch (tests use this to force a full-batch coalesce)."""
@@ -153,7 +171,11 @@ class Batcher:
                         t.future.set_exception(exc)
 
     def _dispatch(self, batch: "list[RowTask]") -> None:
-        B, width = self.batch_rows, self.width
+        # Pad to the CURRENT target, or up to the next mesh multiple of the
+        # gathered rows when a ``tune`` shrank batch_rows after this batch
+        # was taken — the dispatch shape must always cover the batch.
+        B = max(self.batch_rows, -(-len(batch) // self.ndev) * self.ndev)
+        width = self.width
         ws = np.zeros((B, width), dtype=np.uint8)
         ns = np.zeros(B, dtype=np.int32)
         eofs = np.zeros(B, dtype=bool)

@@ -4,14 +4,25 @@ One socket, one request at a time; the server supports pipelining but
 this client keeps the common case trivial. Raises
 :class:`ServeClientError` for non-ok responses so callers get typed
 failures instead of dicts to inspect.
+
+``Overloaded`` responses are retried in place: the server's
+``retry_after_ms`` hint (floored by the policy's backoff schedule,
+capped at ``backoff_max``, jittered) paces up to ``max_retries``
+re-sends before the error surfaces — admission shedding reads as
+latency, not failure, exactly like the partition executor's transient
+handling (docs/serving.md "Backpressure"). Pass ``policy=None`` to
+fail fast instead.
 """
 
 from __future__ import annotations
 
 import json
+import random
 import socket
 import struct
+import time
 
+from spark_bam_tpu.core.faults import FaultPolicy
 from spark_bam_tpu.serve.server import MAX_LINE, ServeAddress
 
 
@@ -26,9 +37,12 @@ class ServeClientError(RuntimeError):
 
 
 class ServeClient:
-    def __init__(self, address, timeout: float = 120.0):
+    def __init__(self, address, timeout: float = 120.0,
+                 policy: "FaultPolicy | None" = FaultPolicy()):
         """``address`` is a spec string (``tcp:host:port`` / ``unix:path``),
-        a ``(host, port)`` tuple, or a unix socket path."""
+        a ``(host, port)`` tuple, or a unix socket path. ``policy`` paces
+        Overloaded retries (None = raise immediately)."""
+        self.policy = policy
         if isinstance(address, tuple):
             self._sock = socket.create_connection(address, timeout=timeout)
         else:
@@ -51,7 +65,28 @@ class ServeClient:
         announcing ``binary_frames`` (the ``batch`` op) have that many
         u64-length-prefixed frames read off the socket and attached as a
         list of bytes under ``"_binary"`` — concatenated they are a
-        native columnar container (columnar/native.py)."""
+        native columnar container (columnar/native.py). ``Overloaded``
+        responses honor their Retry-After hint under ``self.policy``."""
+        retries = self.policy.max_retries if self.policy is not None else 0
+        for attempt in range(retries + 1):
+            try:
+                return self._request_once(op, fields)
+            except ServeClientError as exc:
+                if exc.error != "Overloaded" or attempt >= retries:
+                    raise
+                time.sleep(self._overload_delay(exc, attempt))
+        raise AssertionError("unreachable")
+
+    def _overload_delay(self, exc: "ServeClientError", attempt: int) -> float:
+        """Server hint floored by the policy's exponential schedule,
+        capped at ``backoff_max``, jittered — so a fleet of rejected
+        clients doesn't re-arrive in lockstep."""
+        p = self.policy
+        hint_s = float(exc.retry_after_ms or 0.0) / 1000.0
+        d = min(p.backoff_max, max(hint_s, p.backoff_base * (2 ** attempt)))
+        return d * (1 - p.jitter + p.jitter * random.random())
+
+    def _request_once(self, op: str, fields: dict) -> dict:
         self._next_id += 1
         req = {"op": op, "id": self._next_id, **fields}
         self._sock.sendall((json.dumps(req) + "\n").encode())

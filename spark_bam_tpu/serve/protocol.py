@@ -15,9 +15,14 @@ bytes. Concatenated, the frames are exactly a native columnar container
 same query. Handlers stage the chunks on the in-process response under
 the ``"_binary"`` key; the server pops it before JSON encoding.
 
+Admin ops (``drain``, ``tune``) bypass admission like ``ping``/``stats``:
+``drain`` stops new work-op admission (in-flight ticks finish unshed) and
+``tune`` retargets batching/admission knobs at runtime — the fabric
+autoscaler's actuator (docs/fabric.md).
+
 Error types are stable strings (``Overloaded``, ``DeadlineExceeded``,
-``ProtocolError``, ``NotFound``, ``Unsupported``, ``Internal``) —
-docs/serving.md tabulates them.
+``ProtocolError``, ``NotFound``, ``Unsupported``, ``Internal``,
+``Draining``, ``WorkerLost``) — docs/serving.md tabulates them.
 """
 
 from __future__ import annotations
@@ -25,7 +30,8 @@ from __future__ import annotations
 import json
 
 #: ops answered by the service; anything else is a ProtocolError.
-OPS = ("ping", "stats", "plan", "record_starts", "count", "fleet", "batch")
+OPS = ("ping", "stats", "plan", "record_starts", "count", "fleet", "batch",
+       "drain", "tune")
 
 
 class ProtocolError(ValueError):

@@ -9,6 +9,7 @@ responses carry the client's ``id`` and may complete out of order.
 from __future__ import annotations
 
 import asyncio
+import concurrent.futures
 import struct
 import threading
 
@@ -53,7 +54,12 @@ async def _handle_connection(service: SplitService, reader, writer) -> None:
                 retry_after_ms=exc.retry_after_ms,
             ))
             return
-        await write(await asyncio.wrap_future(fut))
+        # SplitService hands back thread-pool futures; the fabric Router
+        # (which reuses this accept loop) hands back asyncio awaitables.
+        if isinstance(fut, concurrent.futures.Future):
+            await write(await asyncio.wrap_future(fut))
+        else:
+            await write(await fut)
 
     pending: "set[asyncio.Task]" = set()
     try:

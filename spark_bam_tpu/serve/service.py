@@ -22,7 +22,7 @@ from __future__ import annotations
 import os
 import threading
 import time
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from concurrent.futures import Future, ThreadPoolExecutor
 from concurrent.futures import TimeoutError as FutureTimeout
 
@@ -43,6 +43,19 @@ from spark_bam_tpu.tpu.stream_check import pad_contig_lengths
 
 #: Retry-After fallback before the latency tracker has enough samples.
 _RETRY_AFTER_DEFAULT_MS = 50.0
+
+#: Per-op latency window behind the ``stats`` percentiles (p50/p99) —
+#: the numbers the fabric autoscaler and operators both read.
+_LATENCY_WINDOW = 512
+
+
+def _percentile(samples, q: float) -> "float | None":
+    """Nearest-rank percentile over a small sample window."""
+    if not samples:
+        return None
+    s = sorted(samples)
+    i = min(len(s) - 1, max(0, int(round(q * (len(s) - 1)))))
+    return round(s[i], 3)
 
 
 class ServiceError(Exception):
@@ -159,8 +172,11 @@ class SplitService:
         # op → [requests, rows, bytes, ms] — the per-op throughput ledger
         # ``stats`` reports (docs/serving.md "Observability").
         self._op_stats: "dict[str, list]" = {}
+        # op → recent latencies (ms) behind the stats p50/p99.
+        self._op_lat: "dict[str, deque]" = {}
         self._op_lock = threading.Lock()
         self._closed = False
+        self.draining = False
 
     # ------------------------------------------------------------ lifecycle
     def close(self) -> None:
@@ -188,9 +204,25 @@ class SplitService:
         if op == "stats":
             fut.set_result(ok_response(req, **self.stats()))
             return fut
+        if op == "drain":
+            fut.set_result(ok_response(req, **self.drain()))
+            return fut
+        if op == "tune":
+            try:
+                fut.set_result(ok_response(req, **self.tune(req)))
+            except (KeyError, TypeError, ValueError) as exc:
+                fut.set_result(error_response(req, "ProtocolError", str(exc)))
+            return fut
         klass = CLASS_OF[op]
         if self._closed:
             raise RuntimeError("service is closed")
+        if self.draining:
+            # Graceful drain: in-flight work finishes unshed, new work is
+            # refused with a typed error the fabric router reroutes on.
+            fut.set_result(error_response(
+                req, "Draining", "service is draining; route elsewhere",
+            ))
+            return fut
         self.gate.admit(klass, self.retry_after_ms())  # may raise Overloaded
         obs.count("serve.requests")
         deadline_ms = req.get("deadline_ms")
@@ -256,6 +288,50 @@ class SplitService:
             acc[1] += rows
             acc[2] += nbytes
             acc[3] += ms
+            lat = self._op_lat.get(op)
+            if lat is None:
+                lat = self._op_lat[op] = deque(maxlen=_LATENCY_WINDOW)
+            lat.append(ms)
+
+    # -------------------------------------------------------------- admin ops
+    def drain(self) -> dict:
+        """Stop admitting work ops; in-flight requests and queued batcher
+        ticks complete unshed. ping/stats/tune keep answering so the
+        control plane can watch inflight drop to zero before detaching."""
+        self.draining = True
+        return {"draining": True, "inflight": self.gate.inflight()}
+
+    def tune(self, req: dict) -> dict:
+        """Runtime retargeting of the batching/admission knobs — the
+        fabric autoscaler's actuator (bounded by ITS floors/ceilings;
+        the service applies whatever it is told). Returns the applied
+        values (batch_rows after mesh rounding)."""
+        applied: dict = {}
+        if req.get("batch_rows") is not None:
+            applied["batch_rows"] = self.batcher.set_batch_rows(
+                int(req["batch_rows"])
+            )
+        if req.get("tick_ms") is not None:
+            applied["tick_ms"] = self.batcher.set_tick_ms(
+                float(req["tick_ms"])
+            )
+        for key, klass in (("plan_queue", "plan"), ("scan_queue", "scan")):
+            if req.get(key) is not None:
+                applied[key] = self.gate.set_limit(klass, int(req[key]))
+        if not applied:
+            raise ValueError(
+                "tune needs at least one of batch_rows/tick_ms/"
+                "plan_queue/scan_queue"
+            )
+        obs.count("serve.tuned")
+        return {"applied": applied, **self._knobs()}
+
+    def _knobs(self) -> dict:
+        return {
+            "batch_rows": int(self.batcher.batch_rows),
+            "tick_ms": round(self.batcher.tick_s * 1000.0, 3),
+            "limits": dict(self.gate.limits),
+        }
 
     # ------------------------------------------------------------ warm tier
     def file_state(self, path) -> _FileState:
@@ -518,19 +594,35 @@ class SplitService:
                     "ms": round(ms, 3),
                     "rows_per_s": round(rows / (ms / 1000.0), 1) if ms else 0.0,
                     "bytes_per_s": round(nbytes / (ms / 1000.0), 1) if ms else 0.0,
+                    "p50_ms": _percentile(self._op_lat.get(op), 0.50),
+                    "p99_ms": _percentile(self._op_lat.get(op), 0.99),
                 }
                 for op, (n, rows, nbytes, ms) in sorted(self._op_stats.items())
             }
+            all_lat = [v for d in self._op_lat.values() for v in d]
+        inflight = self.gate.inflight()
+        # The warm-tier proof read per-WORKER, so the fabric router's
+        # spill-to-cold-worker behavior doesn't poison a global counter
+        # (bench serve/fabric legs assert on this). None when obs is off.
+        reg = obs.registry()
+        resolutions = (
+            int(reg.counter("load.split_resolutions").value)
+            if reg is not None else None
+        )
         return {
             "served": int(self.served),
-            "inflight": self.gate.inflight(),
-            "limits": dict(self.gate.limits),
+            "inflight": inflight,
+            "queue_depth": int(sum(inflight.values())),
+            "draining": bool(self.draining),
             "files_resident": len(self._files),
             "batch_sizes": {
                 str(k): int(v)
                 for k, v in sorted(self.batcher.batch_sizes.items())
             },
-            "batch_rows": int(self.batcher.batch_rows),
             "devices": int(self.mesh.devices.size),
+            "latency_p50_ms": _percentile(all_lat, 0.50),
+            "latency_p99_ms": _percentile(all_lat, 0.99),
+            "split_resolutions": resolutions,
             "ops": ops,
+            **self._knobs(),
         }

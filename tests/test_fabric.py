@@ -1,0 +1,502 @@
+"""Serve-fabric control plane: router placement, failover, autoscaler.
+
+Real ``SplitService`` workers run behind in-process ``ServerThread``
+loops (cheap once the conftest mesh warms the serve step) and the router
+runs behind its own — the production topology minus the subprocess
+boundary, which ``test_worker_pool_subprocess_smoke`` (slow) covers.
+The failover byte-identity test uses a hand-rolled flaky asyncio server
+because a well-behaved worker never dies mid-frame on purpose.
+"""
+
+import asyncio
+import contextlib
+import json
+import struct
+import threading
+import time
+
+import pytest
+
+from spark_bam_tpu.benchmarks.synth import synthetic_fixture
+from spark_bam_tpu.core.config import Config
+from spark_bam_tpu.core.faults import FaultPolicy
+from spark_bam_tpu.fabric import (
+    FabricConfig,
+    IDEMPOTENT_OPS,
+    Router,
+    WorkerPool,
+    decide,
+    rendezvous_weight,
+)
+from spark_bam_tpu.serve import (
+    ServeClient,
+    ServeClientError,
+    ServerThread,
+    SplitService,
+)
+
+pytestmark = pytest.mark.fabric
+
+#: Small windows so the 2500-read fixture spans several rows per count —
+#: routed requests genuinely exercise the batcher.
+SERVE_SPEC = "window=64KB,halo=8KB,batch=8,tick=5,workers=4"
+
+#: Long probe/autoscale periods: control loops stay out of the way
+#: unless a test is specifically about them.
+QUIET_FABRIC = "probe=60000,autoscale=60000"
+
+
+@pytest.fixture(scope="module")
+def bam_path(tmp_path_factory):
+    return str(synthetic_fixture(tmp_path_factory.mktemp("fabric_fixture")))
+
+
+@contextlib.contextmanager
+def _fabric(n=2, fabric_spec=QUIET_FABRIC, serve_spec=SERVE_SPEC):
+    """n real workers + a router, all on in-process accept loops.
+
+    Yields (router_address, router, services, worker_addresses)."""
+    services = [SplitService(Config(serve=serve_spec)) for _ in range(n)]
+    srvs = [ServerThread(s).start() for s in services]
+    addrs = [f"tcp:{h}:{p}" for h, p in (s.address for s in srvs)]
+    router = Router(addrs, config=Config(fabric=fabric_spec))
+    rsrv = ServerThread(router).start()
+    try:
+        yield rsrv.address, router, services, addrs
+    finally:
+        rsrv.stop()
+        for s in srvs:
+            s.stop()
+        for s in services:
+            s.close()
+
+
+# ----------------------------------------------------------------- config
+
+
+def test_fabric_config_parse_aliases():
+    cfg = FabricConfig.parse(
+        "workers=5,slo=250,probe=100,probe_timeout=900,eject=20,"
+        "eject_max=40,autoscale=50,spill=2,batch_floor=2,batch_ceil=32,"
+        "tick_ceil=10,scanq_ceil=128"
+    )
+    assert cfg.workers == 5
+    assert cfg.slo_p99_ms == 250.0
+    assert cfg.probe_ms == 100.0
+    assert cfg.probe_timeout_ms == 900.0
+    assert (cfg.eject_ms, cfg.eject_max_ms) == (20.0, 40.0)
+    assert cfg.autoscale_ms == 50.0
+    assert cfg.spill == 2
+    assert (cfg.batch_floor, cfg.batch_ceil) == (2, 32)
+    assert cfg.tick_ceil == 10.0
+    assert cfg.scanq_ceil == 128
+    assert FabricConfig.parse("") == FabricConfig()
+
+
+def test_fabric_config_rejects_bad_specs():
+    with pytest.raises(ValueError):
+        FabricConfig.parse("workers=0")
+    with pytest.raises(ValueError):
+        FabricConfig.parse("slo=0")
+    with pytest.raises(ValueError):
+        FabricConfig.parse("batch_floor=9,batch_ceil=8")
+    with pytest.raises(ValueError):
+        FabricConfig.parse("eject=100,eject_max=50")
+    with pytest.raises(ValueError):
+        FabricConfig.parse("nope=1")
+    with pytest.raises(ValueError):
+        FabricConfig.parse("spill")
+
+
+def test_config_carries_fabric_spec(monkeypatch):
+    assert Config(fabric="workers=2,slo=99").fabric_config.workers == 2
+    monkeypatch.setenv("SPARK_BAM_FABRIC", "workers=7")
+    assert Config.from_env().fabric_config.workers == 7
+
+
+# -------------------------------------------------------------- placement
+
+
+def test_rendezvous_weight_stable_and_spread():
+    assert rendezvous_weight("w0", "/a.bam") == rendezvous_weight("w0", "/a.bam")
+    assert rendezvous_weight("w0", "/a.bam") != rendezvous_weight("w1", "/a.bam")
+    wids = [f"w{i}" for i in range(4)]
+    winners = {
+        max(wids, key=lambda w: rendezvous_weight(w, f"/f{i}.bam"))
+        for i in range(16)
+    }
+    assert len(winners) > 1  # placement spreads across the pool
+
+
+class _StubLink:
+    def __init__(self, wid, inflight=0):
+        self.wid = wid
+        self.healthy = True
+        self.draining = False
+        self.inflight = inflight
+
+
+def _stub_router(n=3, fabric_spec="spill=2"):
+    router = Router([], config=Config(fabric=fabric_spec))
+    router.links = [_StubLink(f"w{i}") for i in range(n)]
+    return router
+
+
+def test_pick_affinity_spill_and_health():
+    router = _stub_router()
+    path = "/some/file.bam"
+    primary = max(
+        router.links, key=lambda l: rendezvous_weight(l.wid, path)
+    )
+    assert router.pick(path) is primary          # warm affinity
+    primary.inflight = 2                         # == spill threshold
+    others = [l for l in router.links if l is not primary]
+    others[0].inflight = 1
+    assert router.pick(path) is others[1]        # least-loaded spillover
+    assert router.counters.get("spilled") == 1
+    primary.inflight = 0
+    primary.healthy = False                      # ejected → next winner
+    assert router.pick(path) in others
+    assert router.pick(None) in others           # path-less: least-loaded
+    for l in router.links:
+        l.healthy = False
+    assert router.pick(path) is None
+
+
+def test_pick_skips_draining_workers():
+    router = _stub_router()
+    path = "/x.bam"
+    primary = max(router.links, key=lambda l: rendezvous_weight(l.wid, path))
+    primary.draining = True
+    assert router.pick(path) is not primary
+
+
+# ------------------------------------------------------------- autoscaler
+
+
+def test_decide_steps_down_when_over_slo():
+    fcfg = FabricConfig.parse("slo=200")
+    move = decide(
+        {"latency_p99_ms": 500.0, "batch_rows": 16, "tick_ms": 8.0,
+         "limits": {"scan": 64, "plan": 64}, "served": 10},
+        fcfg,
+    )
+    assert move == {"batch_rows": 8, "tick_ms": 4.0,
+                    "scan_queue": 32, "plan_queue": 32}
+
+
+def test_decide_clamps_injected_values_to_ceilings_first():
+    # An operator (or a fault injection) set the tick far above the
+    # fabric ceiling: one move must bring it back inside the envelope,
+    # not halve its way down from the stratosphere.
+    fcfg = FabricConfig.parse("slo=200,tick_ceil=20")
+    move = decide(
+        {"latency_p99_ms": 5000.0, "batch_rows": 8, "tick_ms": 400.0,
+         "limits": {"scan": 64, "plan": 64}},
+        fcfg,
+    )
+    assert move["tick_ms"] <= fcfg.tick_ceil
+
+
+def test_decide_steps_up_with_headroom():
+    fcfg = FabricConfig.parse("slo=200")
+    move = decide(
+        {"latency_p99_ms": 50.0, "batch_rows": 16, "tick_ms": 8.0,
+         "limits": {"scan": 64, "plan": 64}},
+        fcfg,
+    )
+    assert move == {"batch_rows": 20, "tick_ms": 10.0,
+                    "scan_queue": 80, "plan_queue": 80}
+
+
+def test_decide_holds_in_band_at_bounds_or_without_samples():
+    fcfg = FabricConfig.parse("slo=200")
+    in_band = {"latency_p99_ms": 150.0, "batch_rows": 16, "tick_ms": 8.0,
+               "limits": {"scan": 64, "plan": 64}}
+    assert decide(in_band, fcfg) is None
+    assert decide({"latency_p99_ms": None}, fcfg) is None
+    at_floors = {"latency_p99_ms": 500.0, "batch_rows": 1, "tick_ms": 0.0,
+                 "limits": {"scan": 4, "plan": 4}}
+    assert decide(at_floors, fcfg) is None       # nothing left to shed
+    at_ceils = {"latency_p99_ms": 50.0, "batch_rows": 64, "tick_ms": 20.0,
+                "limits": {"scan": 256, "plan": 256}}
+    assert decide(at_ceils, fcfg) is None        # nothing left to reclaim
+
+
+# ------------------------------------------------------------ routed plane
+
+
+def test_router_parity_with_single_worker(bam_path):
+    with _fabric(n=2) as (raddr, router, _services, addrs):
+        with ServeClient(addrs[0]) as c:
+            c.request("plan", path=bam_path, split_size=256 << 10)
+            expected = c.request("count", path=bam_path)["count"]
+            ref = b"".join(c.request("batch", path=bam_path)["_binary"])
+        with ServeClient(addrs[1]) as c:         # warm the other worker too
+            c.request("count", path=bam_path)
+        with ServeClient(raddr) as c:
+            pong = c.request("ping")
+            assert pong["fabric"] is True and pong["workers"] == 2
+            assert c.request("count", path=bam_path)["count"] == expected
+            frames = c.request("batch", path=bam_path)["_binary"]
+            assert b"".join(frames) == ref       # byte-identical through hop
+            stats = c.request("stats")
+        assert stats["fabric"] is True
+        assert set(stats["workers"]) == {"w0", "w1"}
+        for w in stats["workers"].values():
+            assert w["healthy"] is True
+            assert w["stats"]["served"] >= 1
+        assert stats["counters"]["routed"] >= 2
+
+
+def test_router_tune_broadcast_and_targeted(bam_path):
+    with _fabric(n=2) as (raddr, _router, services, _addrs):
+        with ServeClient(raddr) as c:
+            r = c.request("tune", tick_ms=7.0)
+            assert set(r["workers"]) == {"w0", "w1"}
+            for w in r["workers"].values():
+                assert w["applied"]["tick_ms"] == 7.0
+            r = c.request("tune", worker="w1", batch_rows=3)
+            assert set(r["workers"]) == {"w1"}
+            # mesh-rounded upward on the 8-device test mesh
+            assert r["workers"]["w1"]["applied"]["batch_rows"] == 8
+            with pytest.raises(ServeClientError) as exc:
+                c.request("tune", worker="w9", tick_ms=1.0)
+            assert exc.value.error == "ProtocolError"
+        assert services[0].batcher.tick_s == pytest.approx(0.007)
+        assert services[1].batcher.batch_rows == 8
+
+
+def test_router_drain_refuses_new_work_keeps_inflight(bam_path):
+    with _fabric(n=2) as (raddr, router, services, _addrs):
+        with ServeClient(raddr) as c:
+            c.request("plan", path=bam_path, split_size=256 << 10)
+            expected = c.request("count", path=bam_path)["count"]
+        for s in services:
+            s.batcher.pause()
+        got: dict = {}
+
+        def inflight_count():
+            with ServeClient(raddr) as c:
+                got["resp"] = c.request("count", path=bam_path)
+
+        t = threading.Thread(target=inflight_count)
+        t.start()
+        time.sleep(0.3)          # rows are sitting in a paused batcher
+        with ServeClient(raddr) as c:
+            r = c.request("drain")
+            assert r["draining"] is True
+            assert set(r["workers"]) == {"w0", "w1"}
+        with ServeClient(raddr) as c:
+            with pytest.raises(ServeClientError) as exc:
+                c.request("count", path=bam_path)
+            assert exc.value.error == "Draining"
+        for s in services:
+            s.batcher.resume()   # the drain must NOT have shed queued rows
+        t.join(timeout=120)
+        assert got["resp"]["count"] == expected
+        assert router.draining is True
+
+
+# --------------------------------------------------------------- failover
+
+
+class _FlakyWorker:
+    """Speaks just enough protocol to get picked: answers ping/stats,
+    then dies mid-frame on the first routed op — the worst-case worker
+    death for a streaming response."""
+
+    def __init__(self):
+        self.port = None
+        self._loop = None
+        self._stop = None
+        self._started = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def start(self) -> "_FlakyWorker":
+        self._thread.start()
+        assert self._started.wait(10), "flaky worker failed to start"
+        return self
+
+    def stop(self) -> None:
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._stop.set)
+        self._thread.join(timeout=10)
+
+    def _run(self) -> None:
+        asyncio.run(self._main())
+
+    async def _main(self) -> None:
+        server = await asyncio.start_server(self._handle, "127.0.0.1", 0)
+        self.port = server.sockets[0].getsockname()[1]
+        self._loop = asyncio.get_running_loop()
+        self._stop = asyncio.Event()
+        self._started.set()
+        async with server:
+            await self._stop.wait()
+
+    async def _handle(self, reader, writer) -> None:
+        try:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    return
+                req = json.loads(line)
+                rid = req.get("id")
+                if req.get("op") in ("ping", "stats"):
+                    writer.write((json.dumps(
+                        {"id": rid, "ok": True, "pong": True, "served": 0}
+                    ) + "\n").encode())
+                    await writer.drain()
+                    continue
+                # Announce two frames, emit half of one, die. None of
+                # these bytes may ever reach a client.
+                writer.write((json.dumps(
+                    {"id": rid, "ok": True, "binary_frames": 2}
+                ) + "\n").encode())
+                writer.write(struct.pack("<Q", 64) + b"\xde" * 16)
+                await writer.drain()
+                return
+        finally:
+            with contextlib.suppress(Exception):
+                writer.close()
+
+
+def test_failover_mid_batch_is_byte_identical(bam_path):
+    assert "batch" in IDEMPOTENT_OPS
+    flaky = _FlakyWorker().start()
+    service = SplitService(Config(serve=SERVE_SPEC))
+    try:
+        with ServerThread(service) as srv:
+            h, p = srv.address
+            real_addr, flaky_addr = f"tcp:{h}:{p}", f"tcp:127.0.0.1:{flaky.port}"
+            with ServeClient(real_addr) as c:
+                c.request("plan", path=bam_path, split_size=256 << 10)
+                ref = b"".join(c.request("batch", path=bam_path)["_binary"])
+            # Order the pool so the FLAKY worker wins rendezvous for this
+            # path — the routed batch must start there and die mid-frame.
+            flaky_wins_w0 = rendezvous_weight("w0", bam_path) > \
+                rendezvous_weight("w1", bam_path)
+            addrs = ([flaky_addr, real_addr] if flaky_wins_w0
+                     else [real_addr, flaky_addr])
+            router = Router(addrs, config=Config(fabric=QUIET_FABRIC))
+            with ServerThread(router) as rsrv:
+                with ServeClient(rsrv.address) as c:
+                    frames = c.request("batch", path=bam_path)["_binary"]
+                    assert b"".join(frames) == ref
+                    assert c.request("count", path=bam_path)["ok"]
+            assert router.counters["failovers"] >= 1
+            flaky_wid = "w0" if flaky_wins_w0 else "w1"
+            flaky_link = next(
+                l for l in router.links if l.wid == flaky_wid
+            )
+            assert flaky_link.healthy is False   # ejected on the spot
+    finally:
+        service.close()
+        flaky.stop()
+
+
+def test_non_idempotent_op_surfaces_typed_worker_lost(bam_path):
+    assert "fleet" not in IDEMPOTENT_OPS
+    flaky = _FlakyWorker().start()
+    try:
+        router = Router(
+            [f"tcp:127.0.0.1:{flaky.port}"],
+            config=Config(fabric=QUIET_FABRIC),
+        )
+        with ServerThread(router) as rsrv:
+            with ServeClient(rsrv.address) as c:
+                with pytest.raises(ServeClientError) as exc:
+                    c.request("fleet", paths=[bam_path])
+        assert exc.value.error == "WorkerLost"
+        assert router.counters["lost"] == 1
+        assert "failovers" not in router.counters
+    finally:
+        flaky.stop()
+
+
+# ------------------------------------------------- health + autoscale loops
+
+
+def test_monitor_ejects_dead_worker_and_reroutes(bam_path):
+    """Kill one worker's accept loop under a fast-probing router: the
+    monitor must eject it and placement must carry on with the rest."""
+    services = [SplitService(Config(serve=SERVE_SPEC)) for _ in range(2)]
+    srvs = [ServerThread(s).start() for s in services]
+    addrs = [f"tcp:{h}:{p}" for h, p in (s.address for s in srvs)]
+    router = Router(
+        addrs, config=Config(fabric="probe=100,eject=50,autoscale=60000")
+    )
+    rsrv = ServerThread(router).start()
+    try:
+        with ServeClient(rsrv.address) as c:
+            c.request("plan", path=bam_path, split_size=256 << 10)
+            expected = c.request("count", path=bam_path)["count"]
+            srvs[0].stop()           # worker 0 vanishes mid-fabric
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                if not router.links[0].healthy:
+                    break
+                time.sleep(0.05)
+            assert router.links[0].healthy is False
+            for _ in range(3):       # every request lands on the survivor
+                assert c.request("count", path=bam_path)["count"] == expected
+            assert c.request("ping")["workers"] == 1
+    finally:
+        rsrv.stop()
+        for s in srvs[1:]:
+            s.stop()
+        for s in services:
+            s.close()
+
+
+def test_autoscaler_recovers_injected_latency(bam_path):
+    """Seeded latency injection: a tick far above the fabric ceiling is
+    tuned in, traffic flows, and the control loop must bring the knob —
+    and with it the p99 — back inside the envelope."""
+    with _fabric(
+        n=1,
+        fabric_spec="probe=60000,autoscale=150,slo=400,tick_ceil=20",
+    ) as (raddr, router, services, _addrs):
+        svc = services[0]
+        with ServeClient(raddr) as c:
+            c.request("plan", path=bam_path, split_size=256 << 10)
+            expected = c.request("count", path=bam_path)["count"]
+            c.request("tune", tick_ms=900.0)     # the injection
+            assert svc.batcher.tick_s == pytest.approx(0.9)
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                assert c.request("count", path=bam_path)["count"] == expected
+                if svc.batcher.tick_s * 1000.0 <= 20.0:
+                    break
+            assert svc.batcher.tick_s * 1000.0 <= 20.0
+        assert router.counters.get("autoscale_moves", 0) >= 1
+
+
+# ------------------------------------------------------------- worker pool
+
+
+@pytest.mark.slow
+def test_worker_pool_subprocess_smoke(bam_path, tmp_path):
+    """One real fabric.worker subprocess: announce, serve, drain."""
+    import os
+    import subprocess
+
+    env = dict(os.environ, SPARK_BAM_CACHE_DIR=str(tmp_path),
+               SPARK_BAM_CACHE="readwrite")
+    with WorkerPool(workers=1, devices=2, serve="window=64KB,halo=8KB",
+                    env=env, stderr=subprocess.DEVNULL) as pool:
+        addr = pool.addresses[0]
+        with ServeClient(addr) as c:
+            assert c.request("ping")["devices"] == 2
+            c.request("plan", path=bam_path, split_size=256 << 10)
+            n = c.request("count", path=bam_path)["count"]
+            assert n > 0
+            stats = c.request("stats")
+            for key in ("batch_rows", "tick_ms", "draining", "queue_depth",
+                        "split_resolutions", "limits"):
+                assert key in stats
+            assert c.request("drain")["draining"] is True
+            with pytest.raises(ServeClientError) as exc:
+                c.request("count", path=bam_path)
+            assert exc.value.error == "Draining"

@@ -289,3 +289,96 @@ def test_unix_server_roundtrip(service, bam_path, tmp_path):
     with ServerThread(service, f"unix:{tmp_path}/serve.sock") as srv:
         with ServeClient(srv.address) as c:
             assert c.request("count", path=bam_path)["count"] > 0
+
+
+# ----------------------------------------------------- admin ops (fabric)
+
+
+def test_stats_reports_percentiles_and_knobs(service, bam_path):
+    for _ in range(3):
+        assert service.submit(
+            {"op": "count", "path": bam_path}
+        ).result(timeout=120)["ok"]
+    stats = service.stats()
+    assert stats["latency_p50_ms"] is not None
+    assert stats["latency_p99_ms"] >= stats["latency_p50_ms"]
+    per_op = stats["ops"]["count"]
+    assert per_op["p50_ms"] is not None
+    assert per_op["p99_ms"] >= per_op["p50_ms"]
+    assert stats["draining"] is False
+    assert stats["queue_depth"] == 0
+    assert stats["limits"] == {"plan": 64, "scan": 64}
+    assert stats["tick_ms"] == pytest.approx(5.0)
+
+
+def test_tune_op_applies_rounds_and_rejects(service):
+    r = service.submit(
+        {"op": "tune", "batch_rows": 3, "tick_ms": 2.5, "scan_queue": 16}
+    ).result(timeout=10)
+    # batch_rows rounds UP to the 8-device mesh multiple: the dispatch
+    # shape set stays bounded.
+    assert r["applied"]["batch_rows"] == 8
+    assert r["applied"]["tick_ms"] == 2.5
+    assert r["applied"]["scan_queue"] == 16
+    assert service.batcher.batch_rows == 8
+    assert service.gate.limits["scan"] == 16
+    empty = service.submit({"op": "tune"}).result(timeout=10)
+    assert not empty["ok"] and empty["error"] == "ProtocolError"
+    bad = service.submit({"op": "tune", "scan_queue": 0}).result(timeout=10)
+    assert not bad["ok"] and bad["error"] == "ProtocolError"
+
+
+def test_drain_refuses_new_work_keeps_inflight(bam_path):
+    svc = SplitService(Config(serve=SERVE_SPEC))
+    try:
+        warm = svc.submit({"op": "count", "path": bam_path})
+        expected = warm.result(timeout=120)["count"]
+        svc.batcher.pause()
+        held = svc.submit({"op": "count", "path": bam_path})
+        time.sleep(0.1)
+        drained = svc.submit({"op": "drain"}).result(timeout=10)
+        assert drained["draining"] is True
+        assert drained["inflight"]["scan"] == 1
+        refused = svc.submit({"op": "count", "path": bam_path})
+        assert refused.result(timeout=10)["error"] == "Draining"
+        # ping/stats stay answerable on a draining worker.
+        assert svc.submit({"op": "ping"}).result(timeout=10)["pong"]
+        assert svc.submit({"op": "stats"}).result(timeout=10)["draining"]
+        svc.batcher.resume()
+        # The queued request finishes unshed — drain sheds nothing.
+        assert held.result(timeout=120)["count"] == expected
+    finally:
+        svc.close()
+
+
+def test_client_retries_overloaded_until_slot_frees(bam_path):
+    """Satellite regression for the client retry loop: with ``scanq=1``
+    a held slot must surface Overloaded (+hint) to a policy-less client
+    and read as latency, not failure, to a client with a policy."""
+    from spark_bam_tpu.core.faults import FaultPolicy
+
+    svc = SplitService(Config(serve=SERVE_SPEC + ",scanq=1"))
+    try:
+        with ServerThread(svc) as srv:
+            with ServeClient(srv.address) as c:   # warm: compile + small hint
+                expected = c.request("count", path=bam_path)["count"]
+            svc.batcher.pause()
+            held = svc.submit({"op": "count", "path": bam_path})
+            time.sleep(0.1)
+            with ServeClient(srv.address, policy=None) as c:
+                with pytest.raises(ServeClientError) as exc:
+                    c.request("count", path=bam_path)
+            assert exc.value.error == "Overloaded"
+            assert exc.value.retry_after_ms >= 0
+            timer = threading.Timer(0.3, svc.batcher.resume)
+            timer.start()
+            try:
+                pol = FaultPolicy(max_retries=8, backoff_base=0.05,
+                                  backoff_max=0.25, jitter=0.5)
+                with ServeClient(srv.address, policy=pol) as c:
+                    assert c.request("count", path=bam_path)["count"] == expected
+            finally:
+                timer.join()
+            assert held.result(timeout=120)["count"] == expected
+    finally:
+        svc.close()
