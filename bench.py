@@ -1181,6 +1181,33 @@ def _child_serve(clients: int = 8, per_client: int = 3, seq_shots: int = 3):
                 serve_wall = time.perf_counter() - t0
                 with ServeClient(addr) as c:
                     stats = c.request("stats")
+
+                # Telemetry A/B on the SAME warm service: identical burst
+                # with the obs registry off (the no-op fast path) vs on
+                # (clients minting trace carriers, worker spans + tick
+                # attribution live). Overhead must stay ≤2% — the "off by
+                # default costs nothing, on costs almost nothing" claim
+                # (docs/observability.md).
+                def _burst() -> float:
+                    def one(_i):
+                        with ServeClient(addr) as c:
+                            for _ in range(per_client):
+                                c.request("count", path=path)
+
+                    t0 = time.perf_counter()
+                    with ThreadPoolExecutor(clients) as ex:
+                        for f in [ex.submit(one, i)
+                                  for i in range(clients)]:
+                            f.result()
+                    return clients * per_client / (
+                        time.perf_counter() - t0
+                    )
+
+                obs.shutdown()
+                telemetry_rps_off = _burst()
+                obs.configure()
+                telemetry_rps_on = _burst()
+                _emit_stage("serve_telemetry_ab")
             finally:
                 srv.stop()
                 service.close()
@@ -1246,6 +1273,12 @@ def _child_serve(clients: int = 8, per_client: int = 3, seq_shots: int = 3):
         "serve_reqs": total,
         "serve_reads": expected,
         "serve_warm_plan_split_resolutions": warm_plan_res,
+        "serve_telemetry_rps_off": round(telemetry_rps_off, 1),
+        "serve_telemetry_rps_on": round(telemetry_rps_on, 1),
+        "serve_telemetry_overhead_pct": round(
+            (telemetry_rps_off - telemetry_rps_on)
+            / max(telemetry_rps_off, 1e-9) * 100.0, 2
+        ),
     })
 
 
@@ -2295,6 +2328,15 @@ def inflate_ab_leg(path: str, window: int = 4 << 20, max_windows: int = 4):
                 np.asarray(hv.data), np.asarray(dv.data)
             )
     stages = _obs_stages(reg)
+    # First-class host-vs-device attribution on the history row: total ms
+    # per phase across the timed windows, from the inflate attribution
+    # histograms (tpu/inflate.attribute_ms).
+    attribution = {
+        name.split(".", 1)[1]: stages["spans"][name]["total_ms"]
+        for name in ("inflate.host_ms", "inflate.h2d_ms",
+                     "inflate.device_ms")
+        if name in stages.get("spans", {})
+    }
     host_Bps = nbytes / max(host_s, 1e-9)
     dev_Bps = nbytes / max(dev_s, 1e-9)
     ratio = round(dev_Bps / max(host_Bps, 1e-9), 4)
@@ -2308,7 +2350,9 @@ def inflate_ab_leg(path: str, window: int = 4 << 20, max_windows: int = 4):
             "bytes": nbytes,
             "backend": jax.default_backend(),
             "stages": stages,
+            "attribution_ms": attribution,
         },
+        "inflate_attribution_ms": attribution,
         "device_inflate_vs_host": ratio,
         "device_inflate_equal": equal,
     }

@@ -37,8 +37,16 @@ def _add_metrics(sub):
     sub.add_argument(
         "--metrics-out", default=None, metavar="PATH",
         help="enable observability for this run and write the JSONL "
-             "metrics trace here on exit (SPARK_BAM_METRICS_OUT env var "
-             "works too; render with the metrics-report subcommand)",
+             "metrics trace here on exit — a directory or a {pid} "
+             "placeholder gives each fabric worker its own file "
+             "(SPARK_BAM_METRICS_OUT env var works too; render with the "
+             "metrics-report subcommand)",
+    )
+    sub.add_argument(
+        "--profile", default=None, metavar="DIR",
+        help="capture ONE inflate window with jax.profiler.trace into "
+             "this directory (TensorBoard format; SPARK_BAM_PROFILE env "
+             "var works too — fabric workers inherit it)",
     )
 
 
@@ -362,11 +370,33 @@ def build_parser() -> argparse.ArgumentParser:
     sub.add_argument("-w", "--warn", action="store_true",
                      help="root log level WARN")
 
-    # Render a --metrics-out JSONL trace as the reference stats format.
+    # Render --metrics-out JSONL trace(s) as the reference stats format.
+    # Several files (e.g. a fabric run's per-worker trace directory) are
+    # merged by trace_id into one cross-process report.
     sub = sp.add_parser("metrics-report")
     sub.add_argument("-o", "--out", default=None, help="write output to file")
     sub.add_argument("-l", "--print-limit", type=int, default=10)
-    sub.add_argument("trace", help="JSONL trace a --metrics-out run wrote")
+    sub.add_argument(
+        "trace", nargs="+",
+        help="JSONL trace(s) --metrics-out runs wrote; pass every "
+             "per-process file of one fleet run to merge spans by "
+             "trace_id",
+    )
+
+    # One-shot fleet telemetry view: per-worker health, queue depth,
+    # per-op p50/p99, host/H2D/device ms split (docs/observability.md).
+    sub = sp.add_parser("top")
+    sub.add_argument("-o", "--out", default=None, help="write output to file")
+    sub.add_argument(
+        "--prometheus", action="store_true",
+        help="print the (fleet-merged) Prometheus exposition text "
+             "instead of the human view",
+    )
+    sub.add_argument(
+        "address",
+        help="serve worker or fabric router address "
+             "(tcp:host:port or unix:path)",
+    )
 
     return ap
 
@@ -383,6 +413,10 @@ def main(argv=None) -> int:
     from spark_bam_tpu import obs
     from spark_bam_tpu.cli.output import Printer
 
+    # Known-benign backend banners (xla_bridge's "Platform ... is
+    # experimental") stay out of every subcommand's stderr; real
+    # warnings still pass (obs/noise.py).
+    obs.install_noise_filter()
     out = open(args.out, "w") if getattr(args, "out", None) else None
     p = Printer(out=out, limit=getattr(args, "print_limit", 10))
     config = Config.from_env()
@@ -466,6 +500,12 @@ def main(argv=None) -> int:
     )
     if metrics_out:
         obs.configure()
+        metrics_out = obs.resolve_metrics_path(metrics_out)
+    # --profile rides the env var so the inflate pipeline (and any
+    # fabric worker subprocess inheriting the environment) sees it.
+    profile_set = getattr(args, "profile", None)
+    if profile_set:
+        os.environ["SPARK_BAM_PROFILE"] = profile_set
     cmd = args.command
     root_span = obs.span(f"cli.{cmd}")
     root_span.__enter__()
@@ -666,6 +706,10 @@ def main(argv=None) -> int:
             from spark_bam_tpu.cli import metrics_report
 
             metrics_report.run(args.trace, p)
+        elif cmd == "top":
+            from spark_bam_tpu.cli import top
+
+            top.run(args.address, p, prometheus=args.prometheus)
         # Fault-tolerance postscript: whenever partition execution had to
         # retry/hedge/quarantine, say so (the quarantine list is the
         # operator's cue that the output is a degraded-but-complete run).
@@ -686,6 +730,8 @@ def main(argv=None) -> int:
         print(f"error: {e}", file=sys.stderr)
         return 2
     finally:
+        if profile_set:
+            os.environ.pop("SPARK_BAM_PROFILE", None)
         if chaos_state is not None:
             uninstall_chaos()
         if getattr(args, "remote", None) is not None:

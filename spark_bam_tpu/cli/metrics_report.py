@@ -1,12 +1,27 @@
-"""metrics-report: render a ``--metrics-out`` JSONL trace as a human
+"""metrics-report: render ``--metrics-out`` JSONL trace(s) as a human
 report in the reference stats format (obs/report.py does the parsing and
-formatting; this is just the CLI face)."""
+formatting; this is just the CLI face).
+
+One file renders the classic single-process report. Several files — the
+per-worker traces a fabric run leaves when ``--metrics-out`` names a
+directory — are merged: metric snapshots combine into one fleet view and
+span events join across processes by ``trace_id``, so one serve request
+reads as one tree (router relay → worker → tick → device dispatch).
+"""
 
 from __future__ import annotations
 
 from spark_bam_tpu.cli.output import Printer
-from spark_bam_tpu.obs.report import render_report
+from spark_bam_tpu.obs.report import render_merged_report, render_report
 
 
-def run(trace_path, p: Printer) -> None:
-    p.echo(render_report(trace_path))
+def run(trace_paths, p: Printer) -> None:
+    if isinstance(trace_paths, (str, bytes)) or not hasattr(
+        trace_paths, "__iter__"
+    ):
+        trace_paths = [trace_paths]
+    paths = list(trace_paths)
+    if len(paths) == 1:
+        p.echo(render_report(paths[0]))
+    else:
+        p.echo(render_merged_report(paths))

@@ -1,0 +1,108 @@
+"""spark-bam-tpu top: one-shot fleet telemetry view.
+
+Scrapes the ``telemetry`` op from a serve worker or fabric router and
+renders the operator's glance view: per-worker health, queue depth,
+per-op p50/p99, and the host/H2D/device ms split the inflate attribution
+gauges carry. Point it at the same address clients use — the op is an
+admin op, so it bypasses admission control and works mid-overload.
+"""
+
+from __future__ import annotations
+
+from spark_bam_tpu.cli.output import Printer
+
+
+def _ms(v) -> str:
+    return "-" if v is None else f"{float(v):.1f}"
+
+
+def _hd_split(snapshot) -> str:
+    """``host/h2d/dev`` last-window ms from the attribution gauges."""
+    vals = {}
+    for g in (snapshot or {}).get("gauges", []):
+        if g.get("name") in ("inflate.host_ms", "inflate.h2d_ms",
+                             "inflate.device_ms"):
+            vals[g["name"].rsplit(".", 1)[1]] = g.get("value")
+    if not vals:
+        return "-"
+    return "/".join(
+        _ms(vals.get(k)) for k in ("host_ms", "h2d_ms", "device_ms")
+    )
+
+
+def _worker_lines(p: Printer, label: str, tel: dict, indent: str = "") -> None:
+    stats = tel.get("stats") or {}
+    snap = tel.get("snapshot")
+    p.echo(
+        f"{indent}{label}: pid={tel.get('pid')} "
+        f"served={stats.get('served', 0)} "
+        f"queue={stats.get('queue_depth', 0)} "
+        f"p50={_ms(stats.get('latency_p50_ms'))}ms "
+        f"p99={_ms(stats.get('latency_p99_ms'))}ms "
+        f"host/h2d/dev={_hd_split(snap)}ms"
+        + ("" if tel.get("telemetry_enabled") else " (metrics disabled)")
+    )
+    ops = stats.get("ops") or {}
+    for op, s in sorted(ops.items()):
+        p.echo(
+            f"{indent}  {op}: n={s.get('requests', 0)} "
+            f"rows={s.get('rows', 0)} "
+            f"p50={_ms(s.get('p50_ms'))}ms p99={_ms(s.get('p99_ms'))}ms"
+        )
+
+
+def _render_fabric(p: Printer, resp: dict) -> None:
+    workers = resp.get("workers") or {}
+    healthy = sum(1 for w in workers.values() if w.get("healthy"))
+    p.echo(
+        f"fabric: {len(workers)} workers ({healthy} healthy)"
+        + (" DRAINING" if resp.get("draining") else "")
+    )
+    counters = resp.get("counters") or {}
+    if counters:
+        p.echo("router: " + " ".join(
+            f"{k}={v}" for k, v in sorted(counters.items())
+        ))
+    for wid, w in sorted(workers.items()):
+        state = "up" if w.get("healthy") else "EJECTED"
+        if w.get("draining"):
+            state = "draining"
+        head = (f"{wid} [{w.get('address')}] {state} "
+                f"inflight={w.get('inflight', 0)}")
+        tel = w.get("telemetry")
+        if not tel:
+            p.echo(f"{head} (no telemetry)")
+            continue
+        p.echo(head)
+        _worker_lines(p, "worker", tel, indent="  ")
+    flight_tail = (resp.get("flight") or [])[-5:]
+    if flight_tail:
+        p.echo("recent flight events:")
+        for ev in flight_tail:
+            kind = ev.get("e", "?")
+            rest = " ".join(
+                f"{k}={v}" for k, v in sorted(ev.items())
+                if k not in ("e", "t") and not isinstance(v, (list, dict))
+            )
+            p.echo(f"  {kind} {rest}")
+
+
+def run(address: str, p: Printer, prometheus: bool = False) -> None:
+    from spark_bam_tpu.serve.client import ServeClient
+
+    fields = {"prometheus": True} if prometheus else {}
+    with ServeClient(address) as client:
+        resp = client.request("telemetry", **fields)
+    if prometheus:
+        if resp.get("prometheus") is not None:
+            p.echo(resp["prometheus"].rstrip("\n"))
+        else:
+            # Single worker: render its own snapshot locally.
+            from spark_bam_tpu.obs.exporters import prometheus_text
+
+            p.echo(prometheus_text(resp.get("snapshot") or {}).rstrip("\n"))
+        return
+    if resp.get("fabric"):
+        _render_fabric(p, resp)
+    else:
+        _worker_lines(p, "worker", resp)

@@ -301,9 +301,11 @@ class Config:
 
     # SPARK_BAM_* sub-namespaces that are NOT Config knobs (cloud backend
     # endpoints/tokens in core/cloud.py; cache-store location/budget in
-    # sbi/store.py) — from_env must not trip on them. Note the bare
-    # SPARK_BAM_CACHE still maps to the ``cache`` knob.
-    _ENV_NON_CONFIG = ("gs_", "s3_", "profile_", "cache_")
+    # sbi/store.py; telemetry artifact paths in obs/) — from_env must not
+    # trip on them. Note the bare SPARK_BAM_CACHE still maps to the
+    # ``cache`` knob.
+    _ENV_NON_CONFIG = ("gs_", "s3_", "profile", "cache_",
+                       "metrics_out", "flight_dir")
 
     @classmethod
     def from_env(cls, env=os.environ, base: "Config | None" = None) -> "Config":

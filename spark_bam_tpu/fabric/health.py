@@ -17,6 +17,8 @@ from __future__ import annotations
 
 import asyncio
 
+from spark_bam_tpu.obs import flight
+
 
 async def _ping(link, timeout_s: float) -> None:
     await asyncio.wait_for(link.request({"op": "ping"}), timeout=timeout_s)
@@ -24,7 +26,9 @@ async def _ping(link, timeout_s: float) -> None:
 
 async def monitor_worker(link, fcfg, count) -> None:
     """Probe loop for one worker link; ``count`` is the router's counter
-    hook (``ejected`` / ``reinstated``)."""
+    hook (``ejected`` / ``reinstated``). Ejections and reinstatements
+    also land in the flight-recorder ring — a postmortem dump shows the
+    health history around the death, not just the death itself."""
     backoff_ms = fcfg.eject_ms
     timeout_s = fcfg.probe_timeout_ms / 1000.0
     while True:
@@ -33,16 +37,19 @@ async def monitor_worker(link, fcfg, count) -> None:
             if not link.healthy:
                 # Died between probes (connection-level ejection).
                 count("ejected")
+                flight.record("ejected", worker=link.wid, cause="connection")
                 backoff_ms = fcfg.eject_ms
                 continue
             try:
                 await _ping(link, timeout_s)
             except asyncio.CancelledError:
                 raise
-            except Exception:
+            except Exception as exc:
                 link.healthy = False
                 link._teardown()
                 count("ejected")
+                flight.record("ejected", worker=link.wid, cause="probe",
+                              error=str(exc))
                 backoff_ms = fcfg.eject_ms
         else:
             await asyncio.sleep(backoff_ms / 1000.0)
@@ -51,6 +58,7 @@ async def monitor_worker(link, fcfg, count) -> None:
                 await _ping(link, timeout_s)
                 backoff_ms = fcfg.eject_ms
                 count("reinstated")
+                flight.record("reinstated", worker=link.wid)
             except asyncio.CancelledError:
                 raise
             except Exception:

@@ -39,6 +39,8 @@ from spark_bam_tpu import obs
 from spark_bam_tpu.core.config import Config
 from spark_bam_tpu.core.faults import FaultPolicy
 from spark_bam_tpu.fabric.config import FabricConfig
+from spark_bam_tpu.obs import flight
+from spark_bam_tpu.obs import trace as obs_trace
 from spark_bam_tpu.serve.protocol import error_response, ok_response
 from spark_bam_tpu.serve.server import MAX_LINE, ServeAddress
 
@@ -81,6 +83,10 @@ class WorkerLink:
         self._writer = None
         self._reader_task = None
         self._pending: "dict[int, asyncio.Future]" = {}
+        # uid → (original client id, op): the postmortem ledger — when
+        # the link dies, the flight dump names exactly what was in
+        # flight on it (the dead worker can't dump for itself).
+        self._pending_meta: "dict[int, tuple]" = {}
         self._next_id = 0
         self._conn_lock = asyncio.Lock()
 
@@ -118,6 +124,7 @@ class WorkerLink:
         orig_id = req.get("id")
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[uid] = fut
+        self._pending_meta[uid] = (orig_id, req.get("op"))
         try:
             self._writer.write(
                 (json.dumps({**req, "id": uid}) + "\n").encode()
@@ -125,6 +132,7 @@ class WorkerLink:
             await self._writer.drain()
         except (ConnectionError, OSError) as exc:
             self._pending.pop(uid, None)
+            self._pending_meta.pop(uid, None)
             self._fail(exc)
             raise WorkerLost(f"worker {self.wid}: {exc}") from exc
         resp = await fut
@@ -147,6 +155,7 @@ class WorkerLink:
                         frames.append(await self._reader.readexactly(length))
                     resp["_binary"] = frames
                 fut = self._pending.pop(resp.get("id"), None)
+                self._pending_meta.pop(resp.get("id"), None)
                 if fut is not None and not fut.done():
                     fut.set_result(resp)
         except asyncio.CancelledError:
@@ -154,11 +163,31 @@ class WorkerLink:
         except Exception as exc:
             self._fail(exc)
 
-    def _fail(self, exc: BaseException) -> None:
+    def _fail(self, exc: BaseException, expected: bool = False) -> None:
         """Connection-level death: mark down NOW (placement must stop
-        choosing this link before any probe runs) and fail all pending."""
+        choosing this link before any probe runs) and fail all pending.
+
+        Unexpected deaths (everything but a deliberate ``close``) are the
+        router-observed ``WorkerLost``: the flight recorder notes the
+        lost worker and the request ids in flight on the link, and — when
+        ``SPARK_BAM_FLIGHT_DIR`` is set — dumps a postmortem JSONL,
+        because a SIGKILL'd worker leaves no artifact of its own."""
         self.healthy = False
         pending, self._pending = self._pending, {}
+        meta, self._pending_meta = self._pending_meta, {}
+        if not expected:
+            inflight = [
+                {"id": orig_id, "op": op} for orig_id, op in meta.values()
+            ]
+            flight.record(
+                "worker_lost", worker=self.wid, address=self.address.spec,
+                error=str(exc), inflight=inflight,
+            )
+            flight.dump_auto(
+                "worker_lost", who=self.wid,
+                extra={"worker": self.wid, "address": self.address.spec,
+                       "error": str(exc), "inflight": inflight},
+            )
         for fut in pending.values():
             if not fut.done():
                 fut.set_exception(
@@ -180,7 +209,7 @@ class WorkerLink:
         if self._reader_task is not None:
             self._reader_task.cancel()
             self._reader_task = None
-        self._fail(ConnectionError("link closed"))
+        self._fail(ConnectionError("link closed"), expected=True)
 
 
 class Router:
@@ -286,15 +315,41 @@ class Router:
             return await self._drain(req)
         if op == "tune":
             return await self._tune(req)
+        if op == "telemetry":
+            return await self._telemetry(req)
         if self.draining:
             return error_response(
                 req, "Draining", "fabric is draining; route elsewhere",
             )
         return await self._route(req)
 
+    async def _relay(self, link: WorkerLink, req: dict,
+                     ctx: "obs_trace.TraceContext | None") -> dict:
+        """One upstream attempt, carrying (and spanning) the trace: the
+        worker's spans parent under this router's ``fabric.relay`` span,
+        so the merged report reads client → router → worker as one tree."""
+        if ctx is None:
+            return await link.request(req)
+        if not obs.enabled():
+            # Relay the caller's carrier untouched — the router adds no
+            # span of its own when its metrics are off.
+            return await link.request(
+                dict(req, trace=obs_trace.carrier(ctx))
+            )
+        with obs_trace.bind(ctx):
+            with obs.span("fabric.relay", op=req.get("op"),
+                          worker=link.wid) as sp:
+                fwd = dict(req, trace={"id": sp.trace_id, "span": sp.span_id})
+                return await link.request(fwd)
+
     async def _route(self, req: dict) -> dict:
         op = req.get("op")
         path = req.get("path")
+        # Mint a trace on behalf of bare clients (the router is the fleet
+        # edge); clients that already sent one keep theirs.
+        ctx = obs_trace.from_carrier(req.get("trace"))
+        if ctx is None and obs.enabled():
+            ctx = obs_trace.mint()
         idempotent = op in IDEMPOTENT_OPS
         failed_over = False
         shed_resp = None
@@ -306,7 +361,7 @@ class Router:
                     break           # every healthy worker tried this round
                 tried.add(link.wid)
                 try:
-                    resp = await link.request(req)
+                    resp = await self._relay(link, req, ctx)
                 except WorkerLost:
                     if not idempotent or failed_over:
                         self._count("lost")
@@ -428,3 +483,58 @@ class Router:
             counters=dict(sorted(self.counters.items())),
             workers=workers,
         )
+
+    async def _telemetry(self, req: dict) -> dict:
+        """Fleet telemetry collector: scrape every healthy worker's
+        ``telemetry`` op, merge their obs snapshots into one fleet view,
+        and attach the router's own counters + flight ring. With
+        ``prometheus: true`` the merged snapshot is also rendered in the
+        exposition text format (one scrape endpoint for the whole
+        fabric)."""
+        from spark_bam_tpu.obs.exporters import (
+            merge_snapshots,
+            prometheus_text,
+        )
+
+        links = list(self.links)
+        fwd = {"op": "telemetry"}
+        if req.get("max_spans") is not None:
+            fwd["max_spans"] = req["max_spans"]
+
+        async def one(link):
+            if not link.healthy:
+                return None
+            try:
+                resp = await link.request(dict(fwd))
+            except Exception:
+                return None
+            if not resp.get("ok"):
+                return None
+            return {k: v for k, v in resp.items() if k not in ("id", "ok")}
+
+        upstream = await asyncio.gather(*(one(l) for l in links))
+        workers = {
+            l.wid: {
+                "address": l.address.spec,
+                "healthy": bool(l.healthy),
+                "draining": bool(l.draining),
+                "inflight": int(l.inflight),
+                "telemetry": t,
+            }
+            for l, t in zip(links, upstream)
+        }
+        merged = merge_snapshots([
+            t["snapshot"] for t in upstream
+            if t and t.get("snapshot")
+        ])
+        out = {
+            "fabric": True,
+            "draining": bool(self.draining),
+            "counters": dict(sorted(self.counters.items())),
+            "workers": workers,
+            "fleet": merged,
+            "flight": flight.recorder().events(),
+        }
+        if req.get("prometheus"):
+            out["prometheus"] = prometheus_text(merged)
+        return ok_response(req, **out)

@@ -68,10 +68,14 @@ def serve_worker(
 
     from spark_bam_tpu import obs
     from spark_bam_tpu.core.config import Config
+    from spark_bam_tpu.obs import flight
     from spark_bam_tpu.parallel.mesh import local_mesh
     from spark_bam_tpu.serve.server import ServerThread
     from spark_bam_tpu.serve.service import SplitService
 
+    # Keep the platform-is-experimental banner (and nothing else) out of
+    # worker stderr — N workers each re-import jax.
+    obs.install_noise_filter()
     # A live registry regardless of --metrics-out: the stats op's
     # split_resolutions (the per-worker warm-tier proof) reads it.
     if not obs.enabled():
@@ -87,6 +91,7 @@ def serve_worker(
     stop = threading.Event()
 
     def _drain_and_stop(signum, frame):
+        flight.record("sigterm", signum=int(signum))
         service.drain()
         stop.set()
 
@@ -96,6 +101,8 @@ def serve_worker(
     srv = ServerThread(service, listen).start()
     addr = srv.address
     spec = addr if isinstance(addr, str) else f"tcp:{addr[0]}:{addr[1]}"
+    flight.record("worker_start", address=spec,
+                  devices=int(service.mesh.devices.size))
     if announce:
         print(json.dumps({
             "fabric_worker": True,
@@ -113,9 +120,27 @@ def serve_worker(
         while (sum(service.gate.inflight().values()) > 0
                and time.monotonic() < deadline):
             time.sleep(0.05)
+    except BaseException as exc:
+        # The one crash the worker CAN narrate: dump the ring before
+        # the exception unwinds the process.
+        flight.dump_auto("crash", extra={"address": spec,
+                                         "error": repr(exc)})
+        raise
     finally:
         srv.stop()
         service.close()
+        # Postmortem + trace artifacts on the graceful path: the drain
+        # dump names the requests this worker saw; the JSONL trace is
+        # what metrics-report merges across the fleet by trace_id.
+        flight.dump_auto("drain", extra={"address": spec})
+        out = obs.resolve_metrics_path(
+            os.environ.get("SPARK_BAM_METRICS_OUT")
+        )
+        if out:
+            try:
+                obs.export_jsonl(out)
+            except OSError:
+                pass
     return 0
 
 

@@ -14,6 +14,8 @@ Everything is a shared no-op until ``obs.configure()`` runs (the CLI's
 ``--metrics-out`` / the ``SPARK_BAM_METRICS_OUT`` env var does this).
 """
 
+from spark_bam_tpu.obs import flight, trace
+from spark_bam_tpu.obs.noise import install_noise_filter
 from spark_bam_tpu.obs.registry import (
     NOOP,
     Counter,
@@ -31,6 +33,7 @@ from spark_bam_tpu.obs.registry import (
     observe,
     read_jsonl,
     registry,
+    resolve_metrics_path,
     shutdown,
     span,
 )
@@ -47,11 +50,15 @@ __all__ = [
     "counter",
     "enabled",
     "export_jsonl",
+    "flight",
     "gauge",
     "histogram",
+    "install_noise_filter",
     "observe",
     "read_jsonl",
     "registry",
+    "resolve_metrics_path",
     "shutdown",
     "span",
+    "trace",
 ]

@@ -31,13 +31,39 @@ def _prom_name(name: str) -> str:
     return name
 
 
+def _prom_escape(value) -> str:
+    # Exposition-format label value escaping: backslash first, then the
+    # quote and newline (the three characters the format reserves).
+    return (str(value)
+            .replace("\\", "\\\\")
+            .replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _prom_labels(labels: dict) -> str:
     if not labels:
         return ""
     inner = ",".join(
-        f'{_prom_name(str(k))}="{v}"' for k, v in sorted(labels.items())
+        f'{_prom_name(str(k))}="{_prom_escape(v)}"'
+        for k, v in sorted(labels.items())
     )
     return "{" + inner + "}"
+
+
+_LABEL_RE = re.compile(r'([a-zA-Z0-9_:]+)="((?:[^"\\]|\\.)*)"')
+_UNESCAPE_RE = re.compile(r"\\(.)")
+_UNESCAPES = {"n": "\n", '"': '"', "\\": "\\"}
+
+
+def parse_prom_labels(block: str) -> dict:
+    """Invert ``_prom_labels`` (round-trip testing + scrape tooling):
+    parse ``{k="v",...}`` back into a dict, unescaping values in one
+    left-to-right pass (sequential ``str.replace`` would corrupt a
+    literal backslash-n)."""
+    return {
+        k: _UNESCAPE_RE.sub(lambda m: _UNESCAPES.get(m.group(1), m.group(1)), v)
+        for k, v in _LABEL_RE.findall(block)
+    }
 
 
 def prometheus_text(snapshot: dict) -> str:
@@ -130,6 +156,67 @@ def stats_summary(snapshot: dict, spans_by_name: dict | None = None) -> str:
             )
         blocks.append("\n".join(lines))
     return "\n\n".join(blocks) + ("\n" if blocks else "")
+
+
+def merge_snapshots(snapshots: list[dict]) -> dict:
+    """Merge per-worker ``Registry.snapshot()`` dicts into one fleet view.
+
+    Counters sum; gauges sum values (queue depths and in-flight counts
+    read as fleet totals) and take the max of peaks; histograms sum
+    count/sum, merge min/max, and concatenate retained samples (capped)
+    so fleet p50/p99 come from a cross-worker sample. Series identity is
+    ``(name, sorted labels)`` — the registry's own key.
+    """
+    from spark_bam_tpu.obs.registry import _HIST_SAMPLE_CAP
+
+    def key(entry):
+        return (entry["name"], tuple(sorted(entry.get("labels", {}).items())))
+
+    counters: dict = {}
+    gauges: dict = {}
+    hists: dict = {}
+    dropped = 0
+    for snap in snapshots:
+        if not snap:
+            continue
+        dropped += int(snap.get("dropped_events", 0))
+        for c in snap.get("counters", []):
+            cur = counters.setdefault(
+                key(c), {"name": c["name"],
+                         "labels": dict(c.get("labels", {})), "value": 0})
+            cur["value"] += c["value"]
+        for g in snap.get("gauges", []):
+            cur = gauges.setdefault(
+                key(g), {"name": g["name"],
+                         "labels": dict(g.get("labels", {})),
+                         "value": 0.0, "max": None})
+            cur["value"] += g["value"]
+            gmax = g.get("max")
+            if gmax is not None and (cur["max"] is None or gmax > cur["max"]):
+                cur["max"] = gmax
+        for h in snap.get("hists", []):
+            cur = hists.setdefault(
+                key(h), {"name": h["name"],
+                         "labels": dict(h.get("labels", {})),
+                         "count": 0, "sum": 0.0, "min": None, "max": None,
+                         "values": []})
+            cur["count"] += h["count"]
+            cur["sum"] += h["sum"]
+            for bound, better in (("min", lambda a, b: b < a),
+                                  ("max", lambda a, b: b > a)):
+                v = h.get(bound)
+                if v is not None and (cur[bound] is None
+                                      or better(cur[bound], v)):
+                    cur[bound] = v
+            room = _HIST_SAMPLE_CAP - len(cur["values"])
+            if room > 0:
+                cur["values"].extend(h.get("values", [])[:room])
+    return {
+        "counters": list(counters.values()),
+        "gauges": list(gauges.values()),
+        "hists": list(hists.values()),
+        "dropped_events": dropped,
+    }
 
 
 def stage_totals(snapshot: dict) -> dict:

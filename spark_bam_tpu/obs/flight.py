@@ -1,0 +1,124 @@
+"""Flight recorder: a bounded ring of recent request/error events.
+
+Failover tests (and real fleets) recover from worker death but lose the
+*explanation* — the SIGKILL'd worker's in-flight requests, its last
+errors, what it was doing. This module keeps a small always-on ring
+(one deque append per recorded event; events are per-request, not
+per-row, so the hot path never sees it) that can be dumped to a
+postmortem JSONL:
+
+- the worker itself dumps on SIGTERM drain and on crash (the serve
+  loop's unhandled-exception path);
+- the *router* dumps on an observed ``WorkerLost`` — the SIGKILL case,
+  where the dead worker can't speak for itself — naming the lost worker
+  and the request ids that were in flight on that link.
+
+Dumps land in ``SPARK_BAM_FLIGHT_DIR`` (defaults to the process cwd
+only when a dump is explicitly requested with no directory configured
+→ disabled: ``dump_auto`` is a no-op without the env var, so normal
+runs never scatter files). The ring itself is independent of the obs
+registry: it records even when metrics are disabled, because the one
+moment you need it is precisely the crash you didn't plan to profile.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+
+FLIGHT_DIR_ENV = "SPARK_BAM_FLIGHT_DIR"
+_RING_CAP = 512
+
+
+class FlightRecorder:
+    """Thread-safe bounded event ring with a JSONL dump."""
+
+    def __init__(self, cap: int = _RING_CAP):
+        self._lock = threading.Lock()
+        self._ring: deque[dict] = deque(maxlen=cap)
+        self.cap = cap
+
+    def record(self, kind: str, **fields) -> None:
+        ev = {"e": kind, "t": round(time.time(), 6)}
+        for k, v in fields.items():
+            ev[k] = (v if isinstance(v, (int, float, str, bool, list, dict,
+                                         type(None))) else str(v))
+        with self._lock:
+            self._ring.append(ev)
+
+    def events(self) -> list[dict]:
+        with self._lock:
+            return list(self._ring)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+    def dump(self, path, reason: str, extra: dict | None = None) -> str:
+        """Write meta + ring to ``path`` as JSONL; returns the path."""
+        lines = [json.dumps({
+            "e": "flight_meta",
+            "version": 1,
+            "reason": reason,
+            "t": round(time.time(), 6),
+            "pid": os.getpid(),
+            **(extra or {}),
+        })]
+        for ev in self.events():
+            lines.append(json.dumps(ev))
+        with open(path, "w") as f:
+            f.write("\n".join(lines) + "\n")
+        return str(path)
+
+
+_recorder = FlightRecorder()
+
+
+def recorder() -> FlightRecorder:
+    """The process-wide flight recorder."""
+    return _recorder
+
+
+def record(kind: str, **fields) -> None:
+    _recorder.record(kind, **fields)
+
+
+def dump_path(reason: str, who: str | None = None) -> str | None:
+    """Where an automatic dump for ``reason`` would land, or None when
+    ``SPARK_BAM_FLIGHT_DIR`` is unset (auto-dumping disabled)."""
+    d = os.environ.get(FLIGHT_DIR_ENV)
+    if not d:
+        return None
+    tag = f"-{who}" if who else ""
+    return os.path.join(d, f"flight-{os.getpid()}{tag}-{reason}.jsonl")
+
+
+def dump_auto(reason: str, who: str | None = None,
+              extra: dict | None = None) -> str | None:
+    """Dump the ring if ``SPARK_BAM_FLIGHT_DIR`` is configured.
+
+    Never raises: a postmortem writer that crashes the postmortem path
+    would be worse than no artifact.
+    """
+    path = dump_path(reason, who)
+    if path is None:
+        return None
+    try:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        return _recorder.dump(path, reason, extra=extra)
+    except OSError:
+        return None
+
+
+def read_dump(path) -> list[dict]:
+    """Parse a flight dump back into event dicts."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out

@@ -1,0 +1,55 @@
+"""Benign-noise filtering for worker stderr and CLI output.
+
+``jax.numpy`` import on a CPU-only host logs ``Platform '<x>' is
+experimental`` through the xla_bridge logger. Every fabric worker
+re-imports jax, so without filtering the line lands in N worker stderrs,
+flight-recorder dumps, and every CLI invocation. bench.py already
+scrubbed it from *captured child output*; this installs the filter at
+the source — a ``logging.Filter`` on the jax/absl loggers plus a
+matching ``warnings`` rule — so live processes are quiet too.
+
+Only the known-benign pattern is dropped; anything else (real platform
+errors, deprecations, OOM warnings) passes through untouched, and
+``tests/test_obs.py`` pins that behavior.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+import warnings
+
+BENIGN_NOISE = re.compile(r"Platform '\w+' is experimental")
+
+# Loggers jax has used for the platform banner across versions, plus
+# absl (which jax routes through when present).
+_NOISY_LOGGERS = ("jax._src.xla_bridge", "jax.xla_bridge", "absl")
+
+
+class BenignNoiseFilter(logging.Filter):
+    """Drops records matching ``BENIGN_NOISE``; passes everything else."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        try:
+            msg = record.getMessage()
+        except Exception:
+            return True
+        return not BENIGN_NOISE.search(msg)
+
+
+_installed: BenignNoiseFilter | None = None
+
+
+def install_noise_filter() -> BenignNoiseFilter:
+    """Attach the filter to the known noisy loggers (idempotent)."""
+    global _installed
+    if _installed is None:
+        _installed = BenignNoiseFilter()
+        warnings.filterwarnings(
+            "ignore", message=r".*Platform '\w+' is experimental.*"
+        )
+    for name in _NOISY_LOGGERS:
+        lg = logging.getLogger(name)
+        if _installed not in lg.filters:
+            lg.addFilter(_installed)
+    return _installed

@@ -31,13 +31,20 @@ Span naming convention: dotted ``layer.stage`` names — ``bgzf.read``,
 from __future__ import annotations
 
 import json
+import os
+import random
 import threading
 import time
+import zlib
 from typing import Iterator
 
+from spark_bam_tpu.obs import trace as _trace
+
 # Histograms keep raw samples (for reference-style stats rendering) up to
-# this many observations; count/sum/min/max stay exact beyond it.
-_HIST_SAMPLE_CAP = 1 << 20
+# this many observations; beyond it a uniform reservoir (algorithm R)
+# replaces slots at random so long serve runs stay bounded while p50/p99
+# remain stable. count/sum/min/max stay exact throughout.
+_HIST_SAMPLE_CAP = 4096
 # The JSONL trace buffer stops appending events past this; dropped events
 # are counted and still feed the per-name duration histograms.
 _TRACE_EVENT_CAP = 200_000
@@ -79,10 +86,14 @@ class Gauge:
 
 
 class Histogram:
-    """Sample distribution: exact count/sum/min/max, raw values retained
-    up to ``_HIST_SAMPLE_CAP`` for stats-format rendering."""
+    """Sample distribution: exact count/sum/min/max; raw values retained
+    up to ``_HIST_SAMPLE_CAP``, then reservoir-downsampled (algorithm R)
+    so hot serve paths never grow memory while quantiles stay a uniform
+    sample of the full stream. The RNG is seeded from the series name so
+    quantile renders are reproducible run-to-run."""
 
-    __slots__ = ("name", "labels", "count", "sum", "min", "max", "values")
+    __slots__ = ("name", "labels", "count", "sum", "min", "max", "values",
+                 "_rng")
 
     def __init__(self, name: str, labels: dict):
         self.name = name
@@ -92,6 +103,7 @@ class Histogram:
         self.min = None
         self.max = None
         self.values: list[float] = []
+        self._rng = None
 
     def observe(self, v: float) -> None:
         self.count += 1
@@ -102,12 +114,31 @@ class Histogram:
             self.max = v
         if len(self.values) < _HIST_SAMPLE_CAP:
             self.values.append(v)
+        else:
+            rng = self._rng
+            if rng is None:
+                seed = zlib.crc32(repr((self.name, self.labels)).encode())
+                rng = self._rng = random.Random(seed)
+            j = rng.randrange(self.count)
+            if j < _HIST_SAMPLE_CAP:
+                self.values[j] = v
 
 
 class Span:
-    """One timed, nesting unit of work. Use via ``obs.span(name, **attrs)``."""
+    """One timed, nesting unit of work. Use via ``obs.span(name, **attrs)``.
 
-    __slots__ = ("registry", "name", "attrs", "parent", "depth", "_t0", "t_wall")
+    When a :mod:`spark_bam_tpu.obs.trace` context is bound (a serve
+    request carried a trace_id across the wire), the span joins that
+    trace: it mints its own span_id, parents under the caller's span
+    (or the enclosing local span), and rebinds the trace contextvar for
+    its duration so nested work — including threads that capture the
+    context at the seam — lands in the same tree. With no trace bound,
+    spans behave exactly as before (local name-parenting only).
+    """
+
+    __slots__ = ("registry", "name", "attrs", "parent", "depth", "_t0",
+                 "t_wall", "trace_id", "span_id", "parent_span_id",
+                 "_ctx_token")
 
     def __init__(self, registry: "Registry", name: str, attrs: dict):
         self.registry = registry
@@ -117,6 +148,10 @@ class Span:
         self.depth = 0
         self._t0 = 0.0
         self.t_wall = 0.0
+        self.trace_id = None
+        self.span_id = None
+        self.parent_span_id = None
+        self._ctx_token = None
 
     def set(self, **attrs) -> None:
         """Attach attributes mid-span (e.g. measured device time)."""
@@ -125,8 +160,22 @@ class Span:
     def __enter__(self) -> "Span":
         stack = self.registry._stack()
         if stack:
-            self.parent = stack[-1].name
+            top = stack[-1]
+            self.parent = top.name
             self.depth = len(stack)
+            if top.trace_id is not None:
+                self.trace_id = top.trace_id
+                self.parent_span_id = top.span_id
+        if self.trace_id is None:
+            ctx = _trace.current()
+            if ctx is not None:
+                self.trace_id = ctx.trace_id
+                self.parent_span_id = ctx.span_id
+        if self.trace_id is not None:
+            self.span_id = _trace.new_id()
+            self._ctx_token = _trace.set_current(
+                _trace.TraceContext(self.trace_id, self.span_id)
+            )
         stack.append(self)
         self.t_wall = time.time()
         self._t0 = time.perf_counter()
@@ -137,6 +186,9 @@ class Span:
         stack = self.registry._stack()
         if stack and stack[-1] is self:
             stack.pop()
+        if self._ctx_token is not None:
+            _trace.reset(self._ctx_token)
+            self._ctx_token = None
         self.registry._finish_span(self, ms)
 
 
@@ -219,17 +271,62 @@ class Registry:
         }
         if span.parent is not None:
             event["parent"] = span.parent
+        if span.trace_id is not None:
+            event["trace"] = span.trace_id
+            event["span"] = span.span_id
+            if span.parent_span_id is not None:
+                event["pspan"] = span.parent_span_id
         if span.attrs:
             event["attrs"] = {
                 k: (v if isinstance(v, (int, float, str, bool, type(None)))
                     else str(v))
                 for k, v in span.attrs.items()
             }
+        self._append_event(event)
+
+    def _append_event(self, event: dict) -> None:
         with self._lock:
             if len(self._events) < self._max_events:
                 self._events.append(event)
             else:
                 self._dropped += 1
+
+    def emit_span_event(self, name: str, ms: float, *,
+                        trace_id: str | None = None,
+                        span_id: str | None = None,
+                        parent_span_id: str | None = None,
+                        t_wall: float | None = None,
+                        **attrs) -> str | None:
+        """Record a pre-timed span event without entering a context.
+
+        The batcher uses this: one device tick serves rows from many
+        traces, so the tick itself is a normal span while each row gets
+        a synthetic per-trace event parented under its request span.
+        Returns the (possibly minted) span_id.
+        """
+        self.histogram(name, unit="ms").observe(ms)
+        event = {
+            "e": "span",
+            "name": name,
+            "ms": round(ms, 3),
+            "t": round(t_wall if t_wall is not None else time.time(), 6),
+            "depth": 0,
+        }
+        if trace_id is not None:
+            if span_id is None:
+                span_id = _trace.new_id()
+            event["trace"] = trace_id
+            event["span"] = span_id
+            if parent_span_id is not None:
+                event["pspan"] = parent_span_id
+        if attrs:
+            event["attrs"] = {
+                k: (v if isinstance(v, (int, float, str, bool, type(None)))
+                    else str(v))
+                for k, v in attrs.items()
+            }
+        self._append_event(event)
+        return span_id
 
     # ------------------------------------------------------------ snapshot
     def snapshot(self) -> dict:
@@ -248,7 +345,7 @@ class Registry:
                 "hists": [
                     {"name": h.name, "labels": h.labels, "count": h.count,
                      "sum": h.sum, "min": h.min, "max": h.max,
-                     "values": list(h.values[:4096])}
+                     "values": list(h.values)}
                     for h in self._hists.values()
                 ],
                 "dropped_events": self._dropped,
@@ -325,20 +422,23 @@ def observe(name: str, v: float, **labels) -> None:
         r.histogram(name, **labels).observe(v)
 
 
-def export_jsonl(path) -> str:
-    """Write the live registry's trace + final metric snapshot as JSONL.
+def export_jsonl(path, reg: Registry | None = None) -> str:
+    """Write a registry's trace + final metric snapshot as JSONL.
 
     One JSON object per line: a ``meta`` header, every span event in
     completion order, then ``counter``/``gauge``/``hist`` snapshot lines.
-    Safe to call with observability disabled (writes an empty-run file).
+    Exports the live registry by default (safe to call with observability
+    disabled — writes an empty-run file); pass ``reg`` to export an
+    explicit instance (per-worker test registries).
     """
-    r = _active
+    r = reg if reg is not None else _active
     lines: list[str] = []
     meta = {
         "e": "meta",
         "version": 1,
         "t": round(time.time(), 6),
         "enabled": r is not None,
+        "pid": os.getpid(),
     }
     lines.append(json.dumps(meta))
     if r is not None:
@@ -358,6 +458,22 @@ def export_jsonl(path) -> str:
     with open(path, "w") as f:
         f.write("\n".join(lines) + "\n")
     return str(path)
+
+
+def resolve_metrics_path(raw) -> "str | None":
+    """Expand a ``--metrics-out`` / ``SPARK_BAM_METRICS_OUT`` value for
+    THIS process: a ``{pid}`` placeholder is substituted, and a
+    directory grows a ``trace-<pid>.jsonl`` inside it — so N fabric
+    workers inheriting one env var write N distinct trace files instead
+    of clobbering each other. Plain file paths pass through unchanged."""
+    if not raw:
+        return None
+    raw = str(raw)
+    if "{pid}" in raw:
+        return raw.replace("{pid}", str(os.getpid()))
+    if raw.endswith(os.sep) or os.path.isdir(raw):
+        return os.path.join(raw, f"trace-{os.getpid()}.jsonl")
+    return raw
 
 
 def read_jsonl(path) -> Iterator[dict]:

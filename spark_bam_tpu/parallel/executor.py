@@ -43,6 +43,7 @@ from typing import Callable, Iterable, Sequence, TypeVar
 from spark_bam_tpu import obs
 from spark_bam_tpu.core import guard
 from spark_bam_tpu.core.faults import FaultPolicy, retryable
+from spark_bam_tpu.obs import trace as obs_trace
 
 T = TypeVar("T")
 R = TypeVar("R")
@@ -288,6 +289,22 @@ def _run_pooled(fn, items, config, policy, reports, pool=None) -> list:
     pool_cls = (
         ThreadPoolExecutor if config.mode == "threads" else ProcessPoolExecutor
     )
+    # Pool threads don't inherit the submitter's contextvars: capture the
+    # trace context HERE (the serve handler's request span) and rebind it
+    # around every attempt, so partition spans land in the request's
+    # trace. Process pools skip this — a closure over the context would
+    # break pickling, and spans in a child process feed a different
+    # registry anyway.
+    ctx = obs_trace.current()
+    if ctx is not None and config.mode == "threads":
+        inner_fn = fn
+
+        def fn(item, _ctx=ctx, _fn=inner_fn):
+            token = obs_trace.set_current(_ctx)
+            try:
+                return _fn(item)
+            finally:
+                obs_trace.reset(token)
     owns_pool = pool is None
     results: list = [None] * n
     resolved = [False] * n

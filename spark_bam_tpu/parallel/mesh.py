@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from spark_bam_tpu import obs
 from spark_bam_tpu.tpu.checker import PAD, check_window
 
 
@@ -42,6 +43,24 @@ def local_mesh(axis: str = "data") -> Mesh:
     dispatch, which deadlocks a worker answering only its own requests.
     Single-host, this is exactly ``make_mesh()``."""
     return make_mesh(jax.local_devices(), axis)
+
+
+def _instrument_step(kind: str, step):
+    """Wrap a jit'd mesh step so each call emits a ``mesh.dispatch`` span
+    (joining whatever trace is bound — the batcher row's request trace).
+    Measures host dispatch/enqueue time, not device compute: the arrays
+    come back asynchronous, and the caller's own span (``serve.tick``)
+    covers the sync. When obs is disabled this is one enabled() check per
+    dispatch."""
+
+    def dispatch(*args):
+        if not obs.enabled():
+            return step(*args)
+        with obs.span("mesh.dispatch", step=kind):
+            return step(*args)
+
+    dispatch.__wrapped__ = step
+    return dispatch
 
 
 class MeshSteps:
@@ -78,7 +97,7 @@ class MeshSteps:
         with self._lock:
             step = self._steps.get(key)
             if step is None:
-                step = self._steps[key] = maker()
+                step = self._steps[key] = _instrument_step(key[0], maker())
             return step
 
     def count_step(self, reads_to_check: int = 10, flags_impl: str = "xla",

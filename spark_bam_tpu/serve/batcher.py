@@ -21,6 +21,7 @@ from concurrent.futures import Future
 import numpy as np
 
 from spark_bam_tpu import obs
+from spark_bam_tpu.obs import trace as obs_trace
 from spark_bam_tpu.serve.config import MAX_CONTIGS
 from spark_bam_tpu.tpu.checker import PAD
 
@@ -31,10 +32,15 @@ class RowTask:
     ``future`` resolves to ``(boundary_count, escaped_count)`` for the
     row's owned span, or to ``TimeoutError`` when the owning request's
     deadline passed while the row was still queued (load shedding).
+
+    Rows capture the submitting thread's trace context at creation: a
+    tick batches rows from many requests (many traces), so the dispatch
+    emits one synthetic span event per row, parented under that row's
+    request span rather than the shared tick.
     """
 
     __slots__ = ("window", "n", "at_eof", "lo", "own", "lengths", "nc",
-                 "deadline_ts", "enqueued_ts", "future")
+                 "deadline_ts", "enqueued_ts", "future", "trace_id", "pspan")
 
     def __init__(self, window, n, at_eof, lo, own, lengths, nc,
                  deadline_ts=None):
@@ -48,6 +54,9 @@ class RowTask:
         self.deadline_ts = deadline_ts  # monotonic seconds or None
         self.enqueued_ts = time.monotonic()
         self.future: Future = Future()
+        ctx = obs_trace.current()
+        self.trace_id = ctx.trace_id if ctx is not None else None
+        self.pspan = ctx.span_id if ctx is not None else None
 
 
 class Batcher:
@@ -195,14 +204,32 @@ class Batcher:
             obs.observe("serve.queue_ms", (now - t.enqueued_ts) * 1000.0)
         # Padding rows keep lo == own == 0: empty owned span, zero counts.
         put = self.steps.put
-        out = self._step(
-            put(ws), put(ns), put(eofs), put(los), put(owns),
-            put(lens), put(ncs),
-        )
-        res = np.asarray(out)
+        t_wall = time.time()
+        t0 = time.perf_counter()
+        with obs.span("serve.tick", rows=len(batch), shape=B):
+            out = self._step(
+                put(ws), put(ns), put(eofs), put(los), put(owns),
+                put(lens), put(ncs),
+            )
+            res = np.asarray(out)
+        tick_ms = (time.perf_counter() - t0) * 1000.0
         self.batch_sizes[len(batch)] += 1
         obs.count("serve.batches")
         obs.observe("serve.batch_rows", len(batch))
+        # One synthetic dispatch event per traced row: the tick is shared
+        # across requests, so each row's event parents under ITS request
+        # span — this is the cross-process hop that makes a serve request
+        # read router → worker → tick → device dispatch as one tree.
+        reg = obs.registry()
+        if reg is not None:
+            for t in batch:
+                if t.trace_id is not None:
+                    reg.emit_span_event(
+                        "serve.device_dispatch", tick_ms,
+                        trace_id=t.trace_id, parent_span_id=t.pspan,
+                        t_wall=t_wall, rows=len(batch),
+                        queue_ms=round((now - t.enqueued_ts) * 1000.0, 3),
+                    )
         for i, t in enumerate(batch):
             if not t.future.done():
                 t.future.set_result((int(res[i, 0]), int(res[i, 1])))

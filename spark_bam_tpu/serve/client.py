@@ -22,7 +22,9 @@ import socket
 import struct
 import time
 
+from spark_bam_tpu import obs
 from spark_bam_tpu.core.faults import FaultPolicy
+from spark_bam_tpu.obs import trace as obs_trace
 from spark_bam_tpu.serve.server import MAX_LINE, ServeAddress
 
 
@@ -89,6 +91,12 @@ class ServeClient:
     def _request_once(self, op: str, fields: dict) -> dict:
         self._next_id += 1
         req = {"op": op, "id": self._next_id, **fields}
+        if "trace" not in req and obs.enabled():
+            # Join the caller's trace (e.g. the CLI root span) or mint a
+            # fresh one per request; the server rebinds it so the whole
+            # request reads as one cross-process span tree.
+            ctx = obs_trace.current() or obs_trace.mint()
+            req["trace"] = obs_trace.carrier(ctx)
         self._sock.sendall((json.dumps(req) + "\n").encode())
         line = self._rfile.readline(MAX_LINE)
         if not line:

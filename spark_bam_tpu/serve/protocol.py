@@ -15,10 +15,19 @@ bytes. Concatenated, the frames are exactly a native columnar container
 same query. Handlers stage the chunks on the in-process response under
 the ``"_binary"`` key; the server pops it before JSON encoding.
 
-Admin ops (``drain``, ``tune``) bypass admission like ``ping``/``stats``:
-``drain`` stops new work-op admission (in-flight ticks finish unshed) and
-``tune`` retargets batching/admission knobs at runtime — the fabric
-autoscaler's actuator (docs/fabric.md).
+Admin ops (``drain``, ``tune``, ``telemetry``) bypass admission like
+``ping``/``stats``: ``drain`` stops new work-op admission (in-flight
+ticks finish unshed), ``tune`` retargets batching/admission knobs at
+runtime — the fabric autoscaler's actuator (docs/fabric.md) — and
+``telemetry`` returns the worker's merged obs snapshot, recent span
+events, and flight-recorder ring (docs/observability.md).
+
+Requests may carry an optional ``trace`` field — ``{"id": <trace_id>,
+"span": <parent span_id>}`` — minted by the client (or the fabric
+router on behalf of bare clients) and rebound in the worker's serve
+loop, so one request reads as one cross-process span tree
+(docs/observability.md "Trace propagation"). Servers ignore unknown
+carrier shapes rather than erroring.
 
 Error types are stable strings (``Overloaded``, ``DeadlineExceeded``,
 ``ProtocolError``, ``NotFound``, ``Unsupported``, ``Internal``,
@@ -31,7 +40,7 @@ import json
 
 #: ops answered by the service; anything else is a ProtocolError.
 OPS = ("ping", "stats", "plan", "record_starts", "count", "fleet", "batch",
-       "drain", "tune")
+       "drain", "tune", "telemetry")
 
 
 class ProtocolError(ValueError):

@@ -30,6 +30,8 @@ import numpy as np
 
 from spark_bam_tpu import obs
 from spark_bam_tpu.bam.header import read_header
+from spark_bam_tpu.obs import flight
+from spark_bam_tpu.obs import trace as obs_trace
 from spark_bam_tpu.bgzf.flat import flatten_file
 from spark_bam_tpu.core.config import Config
 from spark_bam_tpu.core.faults import LatencyTracker
@@ -213,6 +215,9 @@ class SplitService:
             except (KeyError, TypeError, ValueError) as exc:
                 fut.set_result(error_response(req, "ProtocolError", str(exc)))
             return fut
+        if op == "telemetry":
+            fut.set_result(ok_response(req, **self.telemetry(req)))
+            return fut
         klass = CLASS_OF[op]
         if self._closed:
             raise RuntimeError("service is closed")
@@ -238,6 +243,14 @@ class SplitService:
 
     def _run(self, op, req, fut, klass, deadline_ts, t0) -> None:
         handler = getattr(self, f"_handle_{op}")
+        # Rebind the caller's trace context (if the request carried one)
+        # around the request span, so every span this handler opens —
+        # including the batcher rows it fans out — joins the same
+        # cross-process trace (docs/observability.md).
+        ctx = obs_trace.from_carrier(req.get("trace"))
+        token = obs_trace.set_current(ctx) if ctx is not None else None
+        flight.record("request", op=op, id=req.get("id"),
+                      trace=ctx.trace_id if ctx else None)
         try:
             with obs.span("serve.request", op=op):
                 if deadline_ts is not None and time.monotonic() > deadline_ts:
@@ -260,10 +273,16 @@ class SplitService:
             )
         finally:
             self.gate.release(klass)
+            if token is not None:
+                obs_trace.reset(token)
         ms = (time.monotonic() - t0) * 1000.0
         self.latency.record(ms)
         obs.observe("serve.latency_ms", ms)
         self._note_op(op, ms, resp)
+        if not resp.get("ok"):
+            flight.record("error", op=op, id=req.get("id"),
+                          error=resp.get("error"),
+                          message=resp.get("message"))
         self.served += 1
         fut.set_result(resp)
 
@@ -325,6 +344,29 @@ class SplitService:
             )
         obs.count("serve.tuned")
         return {"applied": applied, **self._knobs()}
+
+    def telemetry(self, req: "dict | None" = None) -> dict:
+        """One scrape's worth of worker observability: the live obs
+        snapshot (None when metrics are disabled), a tail of recent span
+        events, the flight-recorder ring, and the same stats dict the
+        ``stats`` op serves — everything the router's fleet collector and
+        the ``top`` CLI need in a single round-trip."""
+        req = req or {}
+        max_spans = int(req.get("max_spans") or 256)
+        reg = obs.registry()
+        spans: list = []
+        snap = None
+        if reg is not None:
+            snap = reg.snapshot()
+            spans = reg.events()[-max_spans:]
+        return {
+            "pid": os.getpid(),
+            "telemetry_enabled": reg is not None,
+            "snapshot": snap,
+            "spans": spans,
+            "flight": flight.recorder().events(),
+            "stats": self.stats(),
+        }
 
     def _knobs(self) -> dict:
         return {

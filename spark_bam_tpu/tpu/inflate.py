@@ -50,6 +50,7 @@ checker consumes identical flat windows from either producer.
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import os
 import time
@@ -201,6 +202,7 @@ def tokenize_pack(
     """
     from spark_bam_tpu.native.build import tokenize_deflate_native
 
+    t_host = time.perf_counter()
     with obs.span("inflate.tokenize", blocks=len(offsets)):
         toks = tokenize_deflate_native(comp, offsets, lengths, stride=STRIDE)
     if toks is None:
@@ -221,6 +223,10 @@ def tokenize_pack(
         )
     with obs.span("inflate.pack", blocks=b, bytes=lit.nbytes + dist.nbytes):
         packed = pack_tokens(lit, dist)
+    # The host entropy phase IS tokenize+pack — both device-inflate
+    # consumers (two-phase resolve and the fused count kernel) route
+    # through here, so the per-window host-ms attribution lives here too.
+    attribute_ms(host_ms=(time.perf_counter() - t_host) * 1e3)
     return packed, out_lens, b
 
 
@@ -232,6 +238,66 @@ def _record_rounds(rounds_dev) -> None:
             obs.observe("inflate.rounds", int(rounds_dev), unit="rounds")
         except Exception:
             pass
+
+
+def attribute_ms(host_ms=None, h2d_ms=None, device_ms=None) -> None:
+    """Per-window host-vs-device attribution (ROADMAP item 1's missing
+    evidence): each phase lands as BOTH a gauge (last window + peak, the
+    ``top``/Prometheus view) and an ms-unit histogram (the stage digest
+    bench attaches to BENCH_HISTORY rows). No-op without a live registry.
+    """
+    r = obs.registry()
+    if r is None:
+        return
+    for name, v in (("inflate.host_ms", host_ms),
+                    ("inflate.h2d_ms", h2d_ms),
+                    ("inflate.device_ms", device_ms)):
+        if v is not None:
+            r.gauge(name).set(round(v, 3))
+            r.histogram(name, unit="ms").observe(v)
+
+
+PROFILE_ENV = "SPARK_BAM_PROFILE"
+_profiled = False
+
+
+@contextlib.contextmanager
+def maybe_profile_window(label: str = "inflate_window"):
+    """One-shot ``jax.profiler.trace`` around the FIRST window of the
+    process when ``SPARK_BAM_PROFILE`` names a dump directory (the CLI's
+    ``--profile`` flag sets it). Exactly one window is captured — the
+    profiler's own overhead would poison every later window's host/device
+    attribution. The dump path lands in the flight ring (and the log) so
+    ``top``/postmortems can point an operator at the TensorBoard trace.
+    Never raises: a missing/failed profiler degrades to a plain window."""
+    global _profiled
+    out = os.environ.get(PROFILE_ENV)
+    if not out or _profiled:
+        yield None
+        return
+    _profiled = True
+    path = os.path.join(out, f"profile-{os.getpid()}-{label}")
+    try:
+        os.makedirs(path, exist_ok=True)
+        prof = jax.profiler.trace(path)
+        prof.__enter__()
+    except Exception:
+        log.warning("jax.profiler.trace unavailable; --profile window "
+                    "skipped", exc_info=True)
+        yield None
+        return
+    try:
+        yield path
+    finally:
+        try:
+            prof.__exit__(None, None, None)
+        except Exception:
+            log.warning("profiler dump failed", exc_info=True)
+        else:
+            from spark_bam_tpu.obs import flight
+
+            flight.record("profile_dump", path=path, label=label)
+            log.info("profiler trace for one %s written to %s", label, path)
 
 
 def inflate_blocks_device(
@@ -252,13 +318,17 @@ def inflate_blocks_device(
         # Phase-split timing: H2D transfer (one packed buffer) vs the LZ77
         # kernel + D2H. The explicit sync between phases exists only under
         # a live registry — the production path keeps the async dispatch.
+        t0 = time.perf_counter()
         with obs.span("inflate.h2d", blocks=b, bytes=packed.nbytes):
             packed_dev = jnp.asarray(packed)
             packed_dev.block_until_ready()
+        t1 = time.perf_counter()
         obs.count("inflate.h2d_bytes", int(packed.nbytes))
         with obs.span("inflate.device_kernel", blocks=b):
             resolved_dev, rounds_dev = _resolve_packed(packed_dev)
             resolved = np.asarray(resolved_dev)[:b]
+        attribute_ms(h2d_ms=(t1 - t0) * 1e3,
+                     device_ms=(time.perf_counter() - t1) * 1e3)
         _record_rounds(rounds_dev)
         obs.count("inflate.device_windows")
     else:
@@ -304,8 +374,13 @@ class _PendingDeviceView:
         self.at_eof = at_eof
 
     def materialize(self) -> FlatView:
+        t0 = time.perf_counter()
         with obs.span("inflate.device_kernel", blocks=self.b):
             resolved = np.asarray(self.resolved_dev)[: self.b]
+        # Async dispatch means the kernel+D2H wait is only observable at
+        # the materialize sync — that wait is the window's device_ms.
+        if obs.enabled():
+            attribute_ms(device_ms=(time.perf_counter() - t0) * 1e3)
         _record_rounds(self.rounds_dev)
         obs.count("inflate.device_windows")
         data = np.concatenate(
@@ -346,9 +421,11 @@ def dispatch_group_device(
         return None
     packed, out_lens, b = tp
     if obs.enabled():
+        t0 = time.perf_counter()
         with obs.span("inflate.h2d", blocks=b, bytes=packed.nbytes):
             packed_dev = jnp.asarray(packed)
             packed_dev.block_until_ready()
+        attribute_ms(h2d_ms=(time.perf_counter() - t0) * 1e3)
         obs.count("inflate.h2d_bytes", int(packed.nbytes))
         resolved_dev, rounds_dev = _resolve_packed(packed_dev)
     else:
@@ -507,32 +584,41 @@ class InflatePipeline:
             ]
             for i in range(len(self.groups)):
                 fut = pending.pop(0)
-                # Double-buffer health: time spent blocked on the host
-                # producer is exactly the stall the ``depth`` knob exists
-                # to hide. >1ms of wait counts as a stall.
-                t0 = time.perf_counter()
-                view = fut.result()
-                wait_ms = (time.perf_counter() - t0) * 1e3
-                obs.observe("inflate.stall_ms", wait_ms, unit="ms")
-                if wait_ms > 1.0:
-                    obs.count("inflate.stalls")
-                nxt = i + self.depth
-                if nxt < len(self.groups):
-                    pending.append(pool.submit(produce, self.groups[nxt]))
-                if isinstance(view, _PendingDeviceView):
-                    # Materialize on the consumer thread: workers are
-                    # already tokenizing the NEXT groups while this D2H
-                    # syncs (the double-buffering overlap point). An async
-                    # dispatch error surfaces here — demote just this
-                    # window to host zlib.
-                    try:
-                        view = view.materialize()
-                    except Exception:
-                        self._demote_warn()
-                        view = inflate_blocks(
-                            ch, self.groups[i], file_total=self.total,
-                            threads=self.threads,
+                with contextlib.ExitStack() as stack:
+                    if i == 0:
+                        # --profile: the trace spans the first window's
+                        # produce overlap AND its materialize sync, and is
+                        # closed before the window is yielded so consumer
+                        # work stays out of the capture.
+                        stack.enter_context(maybe_profile_window())
+                    # Double-buffer health: time spent blocked on the host
+                    # producer is exactly the stall the ``depth`` knob
+                    # exists to hide. >1ms of wait counts as a stall.
+                    t0 = time.perf_counter()
+                    view = fut.result()
+                    wait_ms = (time.perf_counter() - t0) * 1e3
+                    obs.observe("inflate.stall_ms", wait_ms, unit="ms")
+                    if wait_ms > 1.0:
+                        obs.count("inflate.stalls")
+                    nxt = i + self.depth
+                    if nxt < len(self.groups):
+                        pending.append(
+                            pool.submit(produce, self.groups[nxt])
                         )
+                    if isinstance(view, _PendingDeviceView):
+                        # Materialize on the consumer thread: workers are
+                        # already tokenizing the NEXT groups while this D2H
+                        # syncs (the double-buffering overlap point). An
+                        # async dispatch error surfaces here — demote just
+                        # this window to host zlib.
+                        try:
+                            view = view.materialize()
+                        except Exception:
+                            self._demote_warn()
+                            view = inflate_blocks(
+                                ch, self.groups[i], file_total=self.total,
+                                threads=self.threads,
+                            )
                 if i == len(self.groups) - 1:
                     view.at_eof = True
                 yield view

@@ -54,6 +54,7 @@ overlaps the device's current one via the same prefetch pool.
 
 from __future__ import annotations
 
+import contextlib
 import time
 from typing import Callable, Iterator
 
@@ -590,7 +591,9 @@ class StreamChecker:
         from spark_bam_tpu.native.build import load_native
         from spark_bam_tpu.core.channel import open_channel
         from spark_bam_tpu.tpu.checker import make_count_window_tokens
-        from spark_bam_tpu.tpu.inflate import tokenize_group
+        from spark_bam_tpu.tpu.inflate import (
+            attribute_ms, maybe_profile_window, tokenize_group,
+        )
 
         lib = load_native()
         if lib is None or not hasattr(lib, "sbt_tokenize_deflate"):
@@ -663,21 +666,45 @@ class StreamChecker:
                 own_end = n if at_eof else max(n - halo, 0)
                 lo = min(max(self.header_end_abs - base, 0), own_end)
                 obs.count("inflate.h2d_bytes", int(packed.nbytes))
-                out = kernel(
-                    jnp.asarray(packed),
-                    jnp.asarray(out_lens.astype(np.int32)),
-                    carry_dev, lens_dev, nc,
-                    jnp.int32(carry_len), jnp.int32(n),
-                    jnp.bool_(at_eof), jnp.int32(lo), jnp.int32(own_end),
-                )
-                carry_dev = out["carry"]
-                carry_len = n - own_end
-                base += own_end
-                if obs.enabled():
-                    obs.observe(
-                        "inflate.rounds", int(out["rounds"]), unit="rounds"
+                with contextlib.ExitStack() as stack:
+                    if gi == 0:
+                        # --profile: one-shot capture of the first fused
+                        # window (H2D + count kernel + the rounds sync).
+                        stack.enter_context(maybe_profile_window(
+                            label="count_window"))
+                    if obs.enabled():
+                        # H2D split: sync the packed transfer alone before
+                        # the kernel dispatch. Only under a live registry —
+                        # the production path stays fully async.
+                        t_h2d = time.perf_counter()
+                        packed_dev = jnp.asarray(packed)
+                        packed_dev.block_until_ready()
+                        attribute_ms(
+                            h2d_ms=(time.perf_counter() - t_h2d) * 1e3
+                        )
+                    else:
+                        packed_dev = jnp.asarray(packed)
+                    out = kernel(
+                        packed_dev,
+                        jnp.asarray(out_lens.astype(np.int32)),
+                        carry_dev, lens_dev, nc,
+                        jnp.int32(carry_len), jnp.int32(n),
+                        jnp.bool_(at_eof), jnp.int32(lo), jnp.int32(own_end),
                     )
-                    obs.count("inflate.device_windows")
+                    carry_dev = out["carry"]
+                    carry_len = n - own_end
+                    base += own_end
+                    if obs.enabled():
+                        # The rounds sync below is the first wait on the
+                        # dispatch — its wall time IS the window's device
+                        # phase (kernel + scalar D2H).
+                        t_dev = time.perf_counter()
+                        rounds = int(out["rounds"])
+                        attribute_ms(
+                            device_ms=(time.perf_counter() - t_dev) * 1e3
+                        )
+                        obs.observe("inflate.rounds", rounds, unit="rounds")
+                        obs.count("inflate.device_windows")
                 dev_total = (
                     out["count"] if dev_total is None
                     else dev_total + out["count"]

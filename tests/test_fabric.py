@@ -473,6 +473,118 @@ def test_autoscaler_recovers_injected_latency(bam_path):
         assert router.counters.get("autoscale_moves", 0) >= 1
 
 
+# --------------------------------------------------------------- telemetry
+
+
+@contextlib.contextmanager
+def _live_obs():
+    """A live registry for the duration — fabric tests default to
+    metrics-off, so trace/telemetry tests opt in explicitly."""
+    from spark_bam_tpu import obs
+
+    obs.shutdown()
+    reg = obs.configure()
+    try:
+        yield reg
+    finally:
+        obs.shutdown()
+
+
+def test_fabric_request_yields_single_trace_tree(bam_path):
+    """Tentpole: one routed serve request is ONE trace — the client mints
+    it, the router relays it, the worker rebinds it, and the batcher's
+    per-row dispatch event parents under the request span. In-process
+    fabric, so every hop lands in the same registry."""
+    with _live_obs() as reg:
+        with _fabric(n=3) as (raddr, _router, _services, _addrs):
+            with ServeClient(raddr) as c:
+                c.request("plan", path=bam_path, split_size=256 << 10)
+                before = len(reg.events())
+                assert c.request("count", path=bam_path)["count"] > 0
+        new = reg.events()[before:]
+    traced = [ev for ev in new if "trace" in ev]
+    assert traced, "a live registry must trace the routed request"
+    tids = {ev["trace"] for ev in traced}
+    assert len(tids) == 1        # ONE request → ONE trace_id, every hop
+    names = {ev["name"] for ev in traced}
+    assert {"fabric.relay", "serve.request", "serve.device_dispatch"} <= names
+    by_span = {ev["span"]: ev for ev in traced}
+    relay = next(ev for ev in traced if ev["name"] == "fabric.relay")
+    request = next(ev for ev in traced if ev["name"] == "serve.request")
+    assert request["pspan"] == relay["span"]   # worker parents under router
+    # Every dispatch row chains up through the request span to the relay.
+    for ev in traced:
+        if ev["name"] != "serve.device_dispatch":
+            continue
+        chain = []
+        cur = ev
+        while cur is not None:
+            chain.append(cur["name"])
+            cur = by_span.get(cur.get("pspan"))
+        assert "serve.request" in chain and "fabric.relay" in chain
+
+
+def test_telemetry_op_worker_and_fleet(bam_path):
+    with _live_obs():
+        with _fabric(n=2) as (raddr, router, _services, addrs):
+            with ServeClient(raddr) as c:
+                c.request("plan", path=bam_path, split_size=256 << 10)
+                assert c.request("count", path=bam_path)["ok"]
+                resp = c.request("telemetry")
+                prom = c.request("telemetry", prometheus=True)
+            with ServeClient(addrs[0]) as c:
+                direct = c.request("telemetry")
+    # Fabric view: per-worker scrape + merged fleet snapshot + flight tail.
+    assert resp["fabric"] is True and resp["draining"] is False
+    assert set(resp["workers"]) == {"w0", "w1"}
+    for w in resp["workers"].values():
+        assert w["healthy"] is True
+        tel = w["telemetry"]
+        assert tel["telemetry_enabled"] is True
+        assert tel["stats"]["served"] >= 0
+    fleet = resp["fleet"]
+    counters = {c["name"]: c["value"] for c in fleet["counters"]}
+    assert counters.get("serve.requests", 0) >= 1
+    assert isinstance(resp["flight"], list)
+    assert resp["counters"].get("routed", 0) >= 1
+    # --prometheus asks the router to render the merged exposition text.
+    assert "serve_requests" in prom["prometheus"]
+    # Direct worker scrape: its own snapshot/stats/flight, no fleet keys.
+    assert direct.get("fabric") is None
+    assert direct["pid"] > 0 and "snapshot" in direct
+    assert "queue_depth" in direct["stats"]
+
+
+def test_worker_lost_leaves_flight_dump(bam_path, tmp_path, monkeypatch):
+    """A SIGKILL'd (here: mid-frame-dying) worker can't narrate its own
+    death — the ROUTER's flight dump must name the lost worker and the
+    request ids in flight on the link."""
+    from spark_bam_tpu.obs import flight
+
+    monkeypatch.setenv(flight.FLIGHT_DIR_ENV, str(tmp_path))
+    flaky = _FlakyWorker().start()
+    try:
+        router = Router(
+            [f"tcp:127.0.0.1:{flaky.port}"],
+            config=Config(fabric=QUIET_FABRIC),
+        )
+        with ServerThread(router) as rsrv:
+            with ServeClient(rsrv.address) as c:
+                with pytest.raises(ServeClientError) as exc:
+                    c.request("fleet", paths=[bam_path])
+        assert exc.value.error == "WorkerLost"
+    finally:
+        flaky.stop()
+    dumps = sorted(tmp_path.glob("flight-*-w0-worker_lost.jsonl"))
+    assert dumps, "router must dump a postmortem for the lost worker"
+    events = flight.read_dump(dumps[-1])
+    meta = events[0]
+    assert meta["e"] == "flight_meta" and meta["reason"] == "worker_lost"
+    assert meta["worker"] == "w0"
+    assert [e["op"] for e in meta["inflight"]] == ["fleet"]
+    assert any(e.get("e") == "worker_lost" for e in events[1:])
+
+
 # ------------------------------------------------------------- worker pool
 
 
@@ -500,3 +612,76 @@ def test_worker_pool_subprocess_smoke(bam_path, tmp_path):
             with pytest.raises(ServeClientError) as exc:
                 c.request("count", path=bam_path)
             assert exc.value.error == "Draining"
+
+
+@pytest.mark.slow
+def test_worker_pool_merged_trace_and_sigkill_dump(
+    bam_path, tmp_path, monkeypatch
+):
+    """The acceptance path end to end, across REAL process boundaries:
+    a routed request through a 3-worker pool leaves per-process trace
+    JSONL files that merge into one tree by trace_id, and a SIGKILL'd
+    worker leaves a router-side flight dump naming it."""
+    import os
+    import subprocess
+
+    from spark_bam_tpu.obs import flight
+    from spark_bam_tpu.obs.report import merge_traces
+
+    art = tmp_path / "telemetry"
+    art.mkdir()
+    # The router lives in THIS process — its worker-lost dump needs the
+    # flight dir here, not just in the worker subprocess env.
+    monkeypatch.setenv(flight.FLIGHT_DIR_ENV, str(art))
+    env = dict(os.environ,
+               SPARK_BAM_METRICS_OUT=str(art),
+               SPARK_BAM_FLIGHT_DIR=str(art),
+               SPARK_BAM_CACHE_DIR=str(tmp_path),
+               SPARK_BAM_CACHE="readwrite")
+    with _live_obs():
+        with WorkerPool(workers=3, devices=1,
+                        serve="window=64KB,halo=8KB,batch=8,tick=5",
+                        env=env, stderr=subprocess.DEVNULL) as pool:
+            router = Router(pool.addresses, config=Config(fabric=QUIET_FABRIC))
+            with ServerThread(router) as rsrv:
+                with ServeClient(rsrv.address) as c:
+                    c.request("plan", path=bam_path, split_size=256 << 10)
+                    expected = c.request("count", path=bam_path)["count"]
+                    assert expected > 0
+                    assert len(c.request("telemetry")["workers"]) == 3
+                    # SIGKILL one worker mid-fabric: requests keep being
+                    # answered (failover) and the router dumps a postmortem
+                    # for the dead link — the worker itself leaves nothing.
+                    pool.kill(0, hard=True)
+                    for _ in range(5):
+                        assert c.request("count",
+                                         path=bam_path)["count"] == expected
+        # __exit__ SIGTERMed the survivors: their drain handlers exported
+        # per-pid trace JSONL into `art`. Add the client/router side too.
+        from spark_bam_tpu import obs
+
+        obs.export_jsonl(art / f"trace-{os.getpid()}.jsonl")
+        obs.shutdown()
+
+    dumps = sorted(art.glob("flight-*-w0-worker_lost.jsonl"))
+    assert dumps, "SIGKILL must leave a router-side flight dump"
+    meta = flight.read_dump(dumps[-1])[0]
+    assert meta["worker"] == "w0"
+    assert "inflight" in meta
+
+    traces = sorted(art.glob("trace-*.jsonl"))
+    assert len(traces) >= 3      # ≥2 surviving workers + the test process
+    merged = merge_traces([str(p) for p in traces])
+    full = []
+    for tid, evs in merged["traces"].items():
+        names = {e["name"] for e in evs}
+        pids = {e.get("pid") for e in evs}
+        if ({"fabric.relay", "serve.request", "serve.device_dispatch"}
+                <= names and len(pids) >= 2):
+            full.append((tid, evs))
+    assert full, "one request must merge into one cross-process trace"
+    tid, evs = full[0]
+    spans = {e["span"]: e for e in evs}
+    req = next(e for e in evs if e["name"] == "serve.request")
+    assert spans[req["pspan"]]["name"] == "fabric.relay"
+    assert spans[req["pspan"]].get("pid") != req.get("pid")

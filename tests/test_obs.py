@@ -1,16 +1,24 @@
 """Observability subsystem: registry semantics, span nesting, JSONL
-round-trip, exporter formats, the disabled no-op fast path, and the
-``--metrics-out`` / ``metrics-report`` CLI surface."""
+round-trip, exporter formats, the disabled no-op fast path, trace
+propagation, the flight recorder, and the ``--metrics-out`` /
+``metrics-report`` CLI surface."""
+
+import logging
+import threading
 
 import pytest
 
 from spark_bam_tpu import obs
+from spark_bam_tpu.obs import flight
+from spark_bam_tpu.obs import trace as obs_trace
 from spark_bam_tpu.obs.exporters import (
+    merge_snapshots,
+    parse_prom_labels,
     prometheus_text,
     stage_totals,
     stats_summary,
 )
-from spark_bam_tpu.obs.registry import NOOP, Registry
+from spark_bam_tpu.obs.registry import _HIST_SAMPLE_CAP, NOOP, Registry
 
 
 @pytest.fixture
@@ -267,3 +275,353 @@ def test_cli_disabled_by_default(tmp_path, capsys, monkeypatch):
     assert rc == 0
     assert not obs.enabled()
     capsys.readouterr()
+
+
+# ---------------------------------------------------- prometheus escaping
+
+
+def test_prom_label_escape_round_trip(reg):
+    """Satellite: label values holding quotes, backslashes, and newlines
+    must render as valid exposition text and parse back verbatim —
+    including the nasty literal backslash-n that a sequential unescape
+    would corrupt."""
+    values = {
+        "plain": "worker-0",
+        "quote": 'say "hi"',
+        "newline": "line1\nline2",
+        "backslash": "C:\\temp\\x",
+        "literal_bs_n": "a\\nb",          # backslash + 'n', NOT a newline
+        "mixed": 'q"\\\n"end',
+    }
+    for i, (k, v) in enumerate(values.items()):
+        obs.counter("esc.test", kind=k, path=v).inc(i + 1)
+    text = prometheus_text(reg.snapshot())
+    assert "\n\n" not in text  # newlines in values never split a sample line
+    seen = {}
+    for line in text.splitlines():
+        if not line.startswith("esc_test{"):
+            continue
+        labels = parse_prom_labels(line[line.index("{"):line.rindex("}") + 1])
+        seen[labels["kind"]] = labels["path"]
+    assert seen == values
+
+
+def test_parse_prom_labels_single_pass_unescape():
+    # "\\n" (escaped backslash, then 'n') must NOT become a newline.
+    assert parse_prom_labels(r'{a="x\\ny"}') == {"a": "x\\ny"}
+    assert parse_prom_labels(r'{a="x\ny"}') == {"a": "x\ny".replace(
+        r"\n", "\n")}
+
+
+# --------------------------------------------------- histogram reservoir
+
+
+def test_histogram_reservoir_bounded_with_exact_aggregates(reg):
+    """Satellite: a long-running serve histogram stays bounded at the
+    reservoir cap while count/sum/min/max remain exact and p50/p99 stay
+    representative of the full stream."""
+    h = obs.histogram("serve.request", unit="ms")
+    n = 50_000
+    # Deterministic stream with known quantiles: 0..n-1 shuffled.
+    import random as _random
+
+    stream = list(range(n))
+    _random.Random(7).shuffle(stream)
+    for v in stream:
+        h.observe(float(v))
+    assert len(h.values) == _HIST_SAMPLE_CAP       # bounded
+    assert h.count == n                             # exact
+    assert h.sum == float(sum(range(n)))            # exact
+    assert (h.min, h.max) == (0.0, float(n - 1))    # exact
+    values = sorted(h.values)
+    p50 = values[len(values) // 2]
+    p99 = values[int(len(values) * 0.99)]
+    # A uniform reservoir over U[0, n) keeps quantiles near truth.
+    assert abs(p50 - n * 0.50) < n * 0.05
+    assert abs(p99 - n * 0.99) < n * 0.05
+
+
+def test_histogram_reservoir_deterministic_per_series():
+    a, b = Registry(), Registry()
+    for r in (a, b):
+        h = r.histogram("x", unit="ms")
+        for v in range(20_000):
+            h.observe(float(v))
+    assert a.histogram("x", unit="ms").values == \
+        b.histogram("x", unit="ms").values  # crc32-seeded RNG, not hash()
+
+
+# ------------------------------------------------------------ noise filter
+
+
+def _capture_logger(name):
+    records = []
+
+    class _Cap(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    lg = logging.getLogger(name)
+    h = _Cap()
+    lg.addHandler(h)
+    return lg, h, records
+
+
+def test_noise_filter_drops_benign_keeps_real_warnings():
+    obs.install_noise_filter()
+    obs.install_noise_filter()  # idempotent: no duplicate filters
+    lg, h, records = _capture_logger("jax._src.xla_bridge")
+    try:
+        assert sum(
+            1 for f in lg.filters if type(f).__name__ == "BenignNoiseFilter"
+        ) == 1
+        lg.warning("Platform 'METAL' is experimental and not all JAX "
+                   "functionality may be correctly supported!")
+        assert records == []  # the known-benign banner is dropped
+        lg.warning("Unable to initialize backend 'tpu': %s", "boom")
+        assert records == ["Unable to initialize backend 'tpu': boom"]
+    finally:
+        lg.removeHandler(h)
+
+
+# ------------------------------------------------------- trace propagation
+
+
+def test_trace_carrier_round_trip_and_lenient_parse():
+    ctx = obs_trace.mint()
+    assert len(ctx.trace_id) == 16 and ctx.span_id is None
+    c = obs_trace.carrier(ctx)
+    back = obs_trace.from_carrier(c)
+    assert back.trace_id == ctx.trace_id and back.span_id is None
+    child = obs_trace.TraceContext(ctx.trace_id, obs_trace.new_id())
+    c2 = obs_trace.from_carrier(obs_trace.carrier(child))
+    assert (c2.trace_id, c2.span_id) == (child.trace_id, child.span_id)
+    # Lenient: malformed carriers never fail a request.
+    for bad in (None, "x", 7, [], {}, {"id": ""}, {"id": 3},
+                {"span": "only"}):
+        assert obs_trace.from_carrier(bad) is None
+    assert obs_trace.carrier(None) is None  # nothing bound → no field
+
+
+def test_span_joins_bound_trace_and_parents(reg):
+    ctx = obs_trace.TraceContext("f" * 16, "a" * 16)
+    with obs_trace.bind(ctx):
+        with obs.span("serve.request", op="count"):
+            with obs.span("load.partition"):
+                pass
+    events = {ev["name"]: ev for ev in reg.events()}
+    req, part = events["serve.request"], events["load.partition"]
+    assert req["trace"] == part["trace"] == "f" * 16
+    assert req["pspan"] == "a" * 16          # parents under the carrier span
+    assert part["pspan"] == req["span"]      # local nesting keeps the chain
+    # Outside the bind, spans stay trace-less (existing local behavior).
+    with obs.span("bare"):
+        pass
+    assert "trace" not in reg.events()[-1]
+
+
+def test_emit_span_event_feeds_histogram_and_tree(reg):
+    sid = reg.emit_span_event(
+        "serve.device_dispatch", 4.5, trace_id="t" * 16,
+        parent_span_id="p" * 16, rows=8,
+    )
+    ev = reg.events()[-1]
+    assert ev["trace"] == "t" * 16 and ev["span"] == sid
+    assert ev["pspan"] == "p" * 16 and ev["attrs"]["rows"] == 8
+    hists = {h["name"]: h for h in reg.snapshot()["hists"]}
+    assert hists["serve.device_dispatch"]["count"] == 1
+
+
+def test_concurrent_span_nesting_across_threads(reg):
+    """Satellite: span stacks are per-thread and trace binds are
+    per-context — concurrent nested spans from many threads never
+    corrupt each other's parentage."""
+    n_threads, per_thread = 8, 25
+    errors: list = []
+
+    def worker(i):
+        ctx = obs_trace.TraceContext(f"{i:016x}")
+        token = obs_trace.set_current(ctx)
+        try:
+            for _ in range(per_thread):
+                with obs.span("outer", thread=i) as outer:
+                    with obs.span("inner") as inner:
+                        if inner.trace_id != f"{i:016x}":
+                            errors.append((i, "trace", inner.trace_id))
+                        if inner.parent_span_id != outer.span_id:
+                            errors.append((i, "parent"))
+                        if inner.depth != 1:
+                            errors.append((i, "depth", inner.depth))
+        finally:
+            obs_trace.reset(token)
+
+    threads = [
+        threading.Thread(target=worker, args=(i,))
+        for i in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert errors == []
+    events = reg.events()
+    assert len(events) == n_threads * per_thread * 2
+    by_span = {ev["span"]: ev for ev in events}
+    for ev in events:
+        if ev["name"] != "inner":
+            continue
+        parent = by_span[ev["pspan"]]
+        # Every inner's parent is an outer of the SAME thread's trace.
+        assert parent["name"] == "outer"
+        assert parent["trace"] == ev["trace"]
+        assert int(ev["trace"], 16) == parent["attrs"]["thread"]
+
+
+def test_executor_threads_rebind_trace(reg):
+    from spark_bam_tpu.parallel.executor import ParallelConfig, run_partitions
+
+    def fn(i):
+        with obs.span("load.partition", i=i):
+            pass
+        return i
+
+    ctx = obs_trace.TraceContext("c" * 16, "d" * 16)
+    with obs_trace.bind(ctx):
+        results, _ = run_partitions(
+            fn, list(range(6)), ParallelConfig(mode="threads", workers=3)
+        )
+    assert results == list(range(6))
+    parts = [ev for ev in reg.events() if ev["name"] == "load.partition"]
+    assert len(parts) == 6
+    # Pool threads don't inherit contextvars; the executor rebinds at the
+    # seam so every partition span lands in the request's trace.
+    assert all(ev["trace"] == "c" * 16 for ev in parts)
+    assert all(ev["pspan"] == "d" * 16 for ev in parts)
+
+
+# --------------------------------------------------------- flight recorder
+
+
+def test_flight_recorder_ring_bounds_and_dump(tmp_path, monkeypatch):
+    rec = flight.FlightRecorder(cap=4)
+    for i in range(7):
+        rec.record("request", op="count", id=i)
+    evs = rec.events()
+    assert len(evs) == 4 and [e["id"] for e in evs] == [3, 4, 5, 6]
+    path = tmp_path / "post.jsonl"
+    rec.dump(path, "crash", extra={"worker": "w0"})
+    dumped = flight.read_dump(path)
+    assert dumped[0]["e"] == "flight_meta"
+    assert dumped[0]["reason"] == "crash" and dumped[0]["worker"] == "w0"
+    assert [e["id"] for e in dumped[1:]] == [3, 4, 5, 6]
+
+
+def test_flight_dump_auto_gated_on_env(tmp_path, monkeypatch):
+    monkeypatch.delenv(flight.FLIGHT_DIR_ENV, raising=False)
+    assert flight.dump_auto("drain") is None     # no env → no files
+    monkeypatch.setenv(flight.FLIGHT_DIR_ENV, str(tmp_path / "fl"))
+    flight.record("sigterm", signum=15)
+    path = flight.dump_auto("drain", who="w1", extra={"address": "tcp:x:1"})
+    assert path is not None and "w1" in path and "drain" in path
+    dumped = flight.read_dump(path)
+    assert dumped[0]["address"] == "tcp:x:1"
+    assert any(e.get("e") == "sigterm" for e in dumped)
+
+
+# ----------------------------------------------- multi-process trace merge
+
+
+def test_resolve_metrics_path(tmp_path):
+    import os
+
+    assert obs.resolve_metrics_path(None) is None
+    assert obs.resolve_metrics_path("") is None
+    plain = str(tmp_path / "t.jsonl")
+    assert obs.resolve_metrics_path(plain) == plain
+    pid = os.getpid()
+    assert obs.resolve_metrics_path(
+        str(tmp_path / "t-{pid}.jsonl")
+    ) == str(tmp_path / f"t-{pid}.jsonl")
+    assert obs.resolve_metrics_path(str(tmp_path)) == str(
+        tmp_path / f"trace-{pid}.jsonl"
+    )
+
+
+def test_merge_snapshots_fleet_view():
+    a, b = Registry(), Registry()
+    a.counter("serve.requests").inc(3)
+    b.counter("serve.requests").inc(4)
+    a.gauge("queue.depth").set(2)
+    b.gauge("queue.depth").set(5)
+    a.histogram("serve.request", unit="ms").observe(1.0)
+    b.histogram("serve.request", unit="ms").observe(9.0)
+    m = merge_snapshots([a.snapshot(), b.snapshot()])
+    counters = {c["name"]: c["value"] for c in m["counters"]}
+    assert counters["serve.requests"] == 7
+    g = next(g for g in m["gauges"] if g["name"] == "queue.depth")
+    assert g["value"] == 7 and g["max"] == 5
+    h = next(h for h in m["hists"] if h["name"] == "serve.request")
+    assert (h["count"], h["sum"], h["min"], h["max"]) == (2, 10.0, 1.0, 9.0)
+    assert sorted(h["values"]) == [1.0, 9.0]
+
+
+def _simulated_process_trace(tmp_path, name, trace_id, spans):
+    """One registry's worth of spans, exported as its own JSONL file —
+    a stand-in for a separate fabric process (same pid, distinct file)."""
+    r = Registry()
+    for sname, span_id, pspan, ms in spans:
+        r.emit_span_event(
+            sname, ms, trace_id=trace_id, span_id=span_id,
+            parent_span_id=pspan,
+        )
+    path = tmp_path / name
+    obs.export_jsonl(path, reg=r)
+    return str(path)
+
+
+def test_merge_traces_joins_by_trace_id_across_files(tmp_path):
+    from spark_bam_tpu.obs.report import merge_traces, render_merged_report
+
+    tid = "ab" * 8
+    router = _simulated_process_trace(
+        tmp_path, "router.jsonl", tid,
+        [("fabric.relay", "r" * 16, None, 30.0)],
+    )
+    worker = _simulated_process_trace(
+        tmp_path, "worker.jsonl", tid,
+        [("serve.request", "w" * 16, "r" * 16, 25.0),
+         ("serve.device_dispatch", "e" * 16, "w" * 16, 5.0)],
+    )
+    merged = merge_traces([router, worker])
+    assert set(merged["traces"]) == {tid}
+    events = merged["traces"][tid]
+    assert [e["name"] for e in events] == [
+        "fabric.relay", "serve.request", "serve.device_dispatch",
+    ]  # sorted by start time, across files
+    text = render_merged_report([router, worker])
+    assert f"trace {tid} (3 spans):" in text
+    tree = [l for l in text.splitlines() if "fabric.relay" in l
+            or "serve." in l and "ms" in l]
+    # Indentation encodes the cross-process parent chain.
+    assert any(l.startswith("fabric.relay") for l in tree)
+    assert any(l.startswith("  serve.request") for l in tree)
+    assert any(l.startswith("    serve.device_dispatch") for l in tree)
+
+
+def test_cli_metrics_report_merges_multiple_traces(tmp_path, capsys):
+    from spark_bam_tpu.cli.main import main
+
+    tid = "cd" * 8
+    a = _simulated_process_trace(
+        tmp_path, "a.jsonl", tid, [("fabric.relay", "1" * 16, None, 2.0)]
+    )
+    b = _simulated_process_trace(
+        tmp_path, "b.jsonl", tid,
+        [("serve.request", "2" * 16, "1" * 16, 1.5)],
+    )
+    rc = main(["metrics-report", a, b])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "processes: 2" in out
+    assert f"trace {tid} (2 spans):" in out
+    assert "  serve.request" in out
