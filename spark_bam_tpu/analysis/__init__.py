@@ -1,0 +1,50 @@
+"""Project-native static analysis (``spark-bam-tpu lint``).
+
+The reference's value proposition is a battery of structural checks that
+drove split false positives to zero (docs/motivation.md); this package
+applies the same philosophy to the codebase itself. Every open roadmap
+item is a concurrency- and tracer-heavy refactor of hot paths, and these
+AST rule passes mechanically prevent the classic regressions:
+
+- ``jit-purity``      Python branches on traced values / varying
+                      ``static_argnums`` that defeat the ``MeshSteps``
+                      compile cache (tpu/, parallel/)
+- ``blocking-async``  blocking calls dropped into the router / health /
+                      autoscaler event loops (serve/, fabric/)
+- ``guard-boundary``  ``struct.unpack`` on untrusted bytes reachable
+                      outside the core/guard.py taxonomy (bam/, bgzf/,
+                      cram/, sbi/, columnar/)
+- ``shared-state``    attributes mutated from both the event loop and
+                      batcher/executor threads without a lock
+- ``obs-contract``    metric/span names not in the registered catalog
+                      (obs/names.py) or with unbounded cardinality
+
+Run ``spark-bam-tpu lint`` (docs/static-analysis.md). Findings carry
+``file:line`` + a fix hint; grandfathered findings live in the committed
+``lint-baseline.json``; one-off waivers use an inline
+``# lint: allow[rule-id] reason`` comment on (or above) the line.
+"""
+
+from spark_bam_tpu.analysis.base import RULES, LintContext, Rule, register
+from spark_bam_tpu.analysis.baseline import Baseline
+from spark_bam_tpu.analysis.findings import Finding, Severity
+from spark_bam_tpu.analysis.runner import (
+    LintReport,
+    lint_source,
+    render_report,
+    run_lint,
+)
+
+__all__ = [
+    "Baseline",
+    "Finding",
+    "LintContext",
+    "LintReport",
+    "RULES",
+    "Rule",
+    "Severity",
+    "lint_source",
+    "register",
+    "render_report",
+    "run_lint",
+]

@@ -1,0 +1,131 @@
+"""Rule base class, registry, and the per-file lint context.
+
+A rule is a class with an ``id``, a default ``severity``, a path
+``scope`` (repo-relative prefixes it applies to; empty = every file),
+and a ``check(ctx)`` generator yielding :class:`Finding`s. Registration
+is declarative — ``@register`` at class-definition time — so importing
+:mod:`spark_bam_tpu.analysis.rules` assembles the whole suite and a new
+rule is one new module with one decorated class (docs/static-analysis.md
+"Adding a rule").
+"""
+
+from __future__ import annotations
+
+import ast
+
+from spark_bam_tpu.analysis.findings import Finding
+
+
+class LintContext:
+    """Everything a rule sees for one file: path, source, parsed tree,
+    and a parent map (``ast`` has no parent links; rules that reason
+    about enclosing ``try``/function blocks need them)."""
+
+    def __init__(self, rel_path: str, source: str, tree: ast.AST):
+        self.rel_path = rel_path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        self._parents: "dict[ast.AST, ast.AST] | None" = None
+
+    @property
+    def parents(self) -> "dict[ast.AST, ast.AST]":
+        if self._parents is None:
+            self._parents = {
+                child: parent
+                for parent in ast.walk(self.tree)
+                for child in ast.iter_child_nodes(parent)
+            }
+        return self._parents
+
+    def ancestors(self, node: ast.AST):
+        """Innermost-first chain of enclosing nodes."""
+        p = self.parents.get(node)
+        while p is not None:
+            yield p
+            p = self.parents.get(p)
+
+    def enclosing_function(self, node: ast.AST):
+        for a in self.ancestors(node):
+            if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return a
+        return None
+
+    def line_text(self, lineno: int) -> str:
+        if 0 < lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+
+class Rule:
+    """Base class; subclasses set ``id``/``severity``/``scope`` and
+    implement ``check``. ``scope`` entries are path prefixes relative to
+    the package root (e.g. ``("serve/", "fabric/")``); ``exclude``
+    prefixes are carved back out."""
+
+    id: str = ""
+    severity: str = "P2"
+    scope: tuple = ()
+    exclude: tuple = ()
+    doc: str = ""
+
+    def applies_to(self, rel_path: str) -> bool:
+        if any(rel_path.startswith(e) for e in self.exclude):
+            return False
+        if not self.scope:
+            return True
+        return any(rel_path.startswith(s) for s in self.scope)
+
+    def check(self, ctx: LintContext):
+        raise NotImplementedError
+
+    def finding(self, ctx: LintContext, node, message: str,
+                hint: str = "", severity: "str | None" = None) -> Finding:
+        return Finding(
+            rule=self.id,
+            severity=severity or self.severity,
+            path=ctx.rel_path,
+            line=getattr(node, "lineno", 0),
+            col=getattr(node, "col_offset", 0),
+            message=message,
+            hint=hint or self.doc,
+        )
+
+
+#: id → rule instance; populated by ``@register`` at import time.
+RULES: "dict[str, Rule]" = {}
+
+
+def register(cls):
+    """Class decorator: instantiate and add to the suite."""
+    rule = cls()
+    if not rule.id:
+        raise ValueError(f"rule {cls.__name__} has no id")
+    if rule.id in RULES:
+        raise ValueError(f"duplicate rule id {rule.id!r}")
+    RULES[rule.id] = rule
+    return cls
+
+
+# ------------------------------------------------------------ shared helpers
+
+def dotted_name(node: ast.AST) -> str:
+    """Render ``a.b.c`` call targets for matching; '' when not a plain
+    name/attribute chain."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    if isinstance(node, ast.Call):
+        inner = dotted_name(node.func)
+        return f"{inner}()" if inner else ""
+    return ""
+
+
+def const_str(node) -> "str | None":
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None

@@ -1,0 +1,75 @@
+"""Baseline suppression file: grandfathered findings, with justifications.
+
+The committed ``lint-baseline.json`` holds findings that are understood
+and deliberately tolerated (e.g. GIL-atomic histogram mutation the obs
+layer accepts by design). Each entry pins ``(rule, path, key)`` — the
+key is content-addressed (findings.py), so entries survive line shifts
+but die with the offending line, and a fixed finding leaves a *stale*
+entry the runner reports so the baseline only ever shrinks.
+
+Every entry MUST carry a non-empty ``justification``; the runner treats
+an unjustified entry as invalid and the finding stays live.
+"""
+
+from __future__ import annotations
+
+import json
+
+
+class Baseline:
+    def __init__(self, entries: "list[dict] | None" = None, path=None):
+        self.path = path
+        self.entries = entries or []
+        self._index: dict[tuple, dict] = {}
+        self._matched: set = set()
+        for e in self.entries:
+            just = str(e.get("justification") or "").strip()
+            if not just:
+                continue            # unjustified entries do not suppress
+            self._index[(e.get("rule"), e.get("path"), e.get("key"))] = e
+
+    @classmethod
+    def load(cls, path) -> "Baseline":
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except FileNotFoundError:
+            return cls(path=path)
+        if not isinstance(data, dict) or "entries" not in data:
+            raise ValueError(f"bad baseline file {path}: expected an object "
+                             "with an 'entries' list")
+        return cls(list(data["entries"]), path=path)
+
+    def match(self, finding) -> "dict | None":
+        """The suppressing entry for this finding, if any (marks it used)."""
+        key = (finding.rule, finding.path, finding.key)
+        e = self._index.get(key)
+        if e is not None:
+            self._matched.add(key)
+        return e
+
+    def stale_entries(self) -> "list[dict]":
+        """Justified entries that matched nothing this run — the finding
+        was fixed, so the entry should be deleted."""
+        return [e for k, e in self._index.items() if k not in self._matched]
+
+    @staticmethod
+    def write(path, findings, justification: str) -> int:
+        """Write a baseline covering ``findings`` (the --write-baseline
+        bootstrap; the operator then edits per-entry justifications)."""
+        entries = [
+            {
+                "rule": f.rule,
+                "path": f.path,
+                "key": f.key,
+                "line": f.line,          # informational; matching ignores it
+                "message": f.message,    # informational
+                "justification": justification,
+            }
+            for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+        ]
+        with open(path, "w") as fh:
+            json.dump({"version": 1, "entries": entries}, fh, indent=2,
+                      sort_keys=False)
+            fh.write("\n")
+        return len(entries)

@@ -1,0 +1,85 @@
+"""Finding objects: what a rule reports and how it is addressed.
+
+A finding pins a rule violation to ``file:line``, carries a fix hint,
+and owns a *stable key* — a content hash of the flagged source line plus
+its occurrence index — so baseline entries survive unrelated edits that
+shift line numbers (the same property ``.sbi`` fingerprints give split
+plans: identity by content, not position).
+"""
+
+from __future__ import annotations
+
+import zlib
+from dataclasses import dataclass
+
+
+class Severity:
+    """P1 fails the gate outright; P2 fails unless baselined; P3 is
+    advisory (reported, never fails). Ordering: P1 < P2 < P3."""
+
+    P1 = "P1"
+    P2 = "P2"
+    P3 = "P3"
+    ORDER = (P1, P2, P3)
+
+    @classmethod
+    def rank(cls, sev: str) -> int:
+        return cls.ORDER.index(sev)
+
+
+@dataclass
+class Finding:
+    rule: str
+    severity: str
+    path: str            # repo-relative posix path
+    line: int
+    col: int
+    message: str
+    hint: str = ""
+    key: str = ""        # content hash; filled by the runner
+    justification: str = ""   # set when suppressed by baseline/inline
+    suppressed: str = ""      # "", "baseline", or "inline"
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}"
+
+    def render(self) -> str:
+        out = f"{self.location()}: {self.severity} [{self.rule}] {self.message}"
+        if self.hint:
+            out += f"\n    hint: {self.hint}"
+        return out
+
+    def to_json(self) -> dict:
+        return {
+            "rule": self.rule,
+            "severity": self.severity,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "hint": self.hint,
+            "key": self.key,
+            "suppressed": self.suppressed,
+            "justification": self.justification,
+        }
+
+
+def finding_key(rule: str, line_text: str, occurrence: int) -> str:
+    """Stable identity for one finding: the rule, the flagged line's
+    stripped text, and which same-text occurrence in the file this is.
+    Line numbers deliberately excluded — edits above the finding must
+    not orphan its baseline entry."""
+    crc = zlib.crc32(line_text.strip().encode("utf-8", "replace"))
+    return f"{rule}:{crc:08x}:{occurrence}"
+
+
+def assign_keys(findings: "list[Finding]", lines: "list[str]") -> None:
+    """Fill ``key`` on every finding of ONE file (findings must carry
+    1-based line numbers into ``lines``)."""
+    seen: dict[tuple, int] = {}
+    for f in sorted(findings, key=lambda f: (f.line, f.col)):
+        text = lines[f.line - 1] if 0 < f.line <= len(lines) else ""
+        ident = (f.rule, text.strip())
+        n = seen.get(ident, 0)
+        seen[ident] = n + 1
+        f.key = finding_key(f.rule, text, n)

@@ -1,0 +1,9 @@
+"""Rule passes: importing this package registers the whole suite."""
+
+from spark_bam_tpu.analysis.rules import (  # noqa: F401
+    blocking_async,
+    guard_boundary,
+    jit_purity,
+    obs_contract,
+    shared_state,
+)

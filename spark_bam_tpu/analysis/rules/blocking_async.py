@@ -1,0 +1,127 @@
+"""blocking-async: blocking calls on the router/health/autoscaler loops.
+
+One ``time.sleep`` in an ``async def`` stalls EVERY connection the
+accept loop multiplexes — the serve daemon's contract is that the event
+loop only parses lines and shuttles futures (serve/server.py docstring);
+real work belongs on the service's thread pool. This pass flags, inside
+``async def`` bodies in serve/ and fabric/:
+
+- ``time.sleep`` (P1 — use ``await asyncio.sleep``);
+- ``subprocess.*`` / ``os.system`` / ``os.popen`` / ``os.wait*`` (P1);
+- synchronous network clients: ``ServeClient`` (its socket I/O blocks),
+  ``urllib.request.urlopen``, ``requests.*``, ``socket.create_connection``
+  (P1 — use the async link, or run_in_executor);
+- ``Future.result()`` / ``.join()`` on threads (P1 — await
+  ``asyncio.wrap_future`` instead);
+- filesystem I/O: ``open()`` and pathlib ``read_*``/``write_*`` (P2 —
+  tolerable for tiny config reads, but hot paths must move to the pool).
+
+Code inside nested ``def``/``lambda`` is exempt: that is exactly how
+work is handed to ``run_in_executor``/``to_thread``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from spark_bam_tpu.analysis.base import LintContext, Rule, dotted_name, register
+
+_P1_CALLS = {
+    "time.sleep": "await asyncio.sleep(...) instead",
+    "os.system": "use asyncio.create_subprocess_exec",
+    "os.popen": "use asyncio.create_subprocess_exec",
+    "os.wait": "use asyncio.create_subprocess_exec + await proc.wait()",
+    "os.waitpid": "use asyncio.create_subprocess_exec + await proc.wait()",
+    "socket.create_connection": "use asyncio.open_connection",
+    "urllib.request.urlopen": "run it in the executor",
+    "ServeClient": "ServeClient does blocking socket I/O; use the async "
+                   "WorkerLink (fabric/router.py) or run_in_executor",
+}
+_P1_PREFIXES = {
+    "subprocess.": "use asyncio.create_subprocess_exec",
+    "requests.": "run it in the executor",
+}
+_P1_METHODS = {
+    "result": "await asyncio.wrap_future(fut) instead of fut.result()",
+}
+_P2_CALLS = {
+    "open": "file I/O blocks the loop; loop.run_in_executor for hot paths",
+}
+_P2_METHODS = {
+    "read_text": "pathlib I/O blocks the loop; run_in_executor on hot paths",
+    "read_bytes": "pathlib I/O blocks the loop; run_in_executor on hot paths",
+    "write_text": "pathlib I/O blocks the loop; run_in_executor on hot paths",
+    "write_bytes": "pathlib I/O blocks the loop; run_in_executor on hot paths",
+}
+
+
+def _async_body_calls(fn: ast.AsyncFunctionDef):
+    """Call nodes executed ON the loop: walk the async body but do not
+    descend into nested function definitions or lambdas."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        if isinstance(node, ast.Call):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+@register
+class BlockingAsyncRule(Rule):
+    id = "blocking-async"
+    severity = "P1"
+    scope = ("serve/", "fabric/")
+    doc = ("the event loop only parses lines and shuttles futures; "
+           "blocking work goes to the pool (docs/serving.md)")
+
+    def check(self, ctx: LintContext):
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, ast.AsyncFunctionDef):
+                continue
+            for call in _async_body_calls(fn):
+                name = dotted_name(call.func)
+                if name in _P1_CALLS:
+                    yield self.finding(
+                        ctx, call,
+                        f"blocking call `{name}` in async `{fn.name}` stalls "
+                        "the event loop",
+                        hint=_P1_CALLS[name],
+                    )
+                    continue
+                pref = next(
+                    (p for p in _P1_PREFIXES if name.startswith(p)), None
+                )
+                if pref is not None:
+                    yield self.finding(
+                        ctx, call,
+                        f"blocking call `{name}` in async `{fn.name}` stalls "
+                        "the event loop",
+                        hint=_P1_PREFIXES[pref],
+                    )
+                    continue
+                if isinstance(call.func, ast.Attribute):
+                    m = call.func.attr
+                    if m in _P1_METHODS:
+                        yield self.finding(
+                            ctx, call,
+                            f"blocking `.{m}()` in async `{fn.name}` stalls "
+                            "the event loop",
+                            hint=_P1_METHODS[m],
+                        )
+                        continue
+                    if m in _P2_METHODS:
+                        yield self.finding(
+                            ctx, call,
+                            f"blocking `.{m}()` in async `{fn.name}`",
+                            hint=_P2_METHODS[m], severity="P2",
+                        )
+                        continue
+                if name in _P2_CALLS:
+                    yield self.finding(
+                        ctx, call,
+                        f"blocking call `{name}` in async `{fn.name}`",
+                        hint=_P2_CALLS[name], severity="P2",
+                    )

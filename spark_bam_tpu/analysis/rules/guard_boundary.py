@@ -1,0 +1,191 @@
+"""guard-boundary: untrusted-byte unpacks outside the guard taxonomy.
+
+The PR 4 contract (core/guard.py, docs/robustness.md "Malformed
+inputs"): every parser consuming untrusted bytes fails with a typed
+``MalformedInputError`` — never a bare ``struct.error`` that the fault
+model would misread as retryable and the fuzz harness would count as a
+contract violation. New decode code must not silently regress that.
+
+A ``struct.unpack``/``unpack_from`` call in a parser module is
+*guarded* when any of:
+
+1. it sits inside a ``try`` whose handlers catch ``struct.error``, a
+   taxonomy type (``MalformedInputError`` and subclasses, including
+   module-local ones like ``SbiFormatError``), ``ValueError``, or
+   ``Exception``;
+2. its enclosing function raises a taxonomy type itself — the
+   validate-lengths-then-unpack idiom (bam/record.py ``decode``), where
+   the raises prove the function participates in the taxonomy;
+3. every module-local call site of its enclosing function satisfies (1)
+   — the parse-helper-wrapped-by-reader idiom (bam/bai.py ``_parse``);
+4. its byte source is a call to a same-module taxonomy-raising helper —
+   the guarded-feeder idiom (sbi/format.py ``_Reader.unpack`` feeds
+   ``struct.unpack`` from ``self.take(calcsize(fmt))``, which raises
+   ``SbiFormatError`` before short bytes ever reach the unpack).
+
+Anything else is a P1: a corrupt length field away from an untyped
+crash.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from spark_bam_tpu.analysis.base import LintContext, Rule, dotted_name, register
+
+#: the core taxonomy; module-local subclasses are discovered per file
+TAXONOMY = {
+    "MalformedInputError", "TruncatedInput", "StructurallyInvalid",
+    "LimitExceeded", "RecordGapError", "BlockGapError",
+}
+#: broad handlers that necessarily cover struct.error
+BROAD_HANDLERS = {"Exception", "ValueError", "struct.error", "error"}
+
+
+def _local_taxonomy(tree: ast.AST) -> set:
+    """TAXONOMY plus classes in this module derived from it (directly or
+    through other local classes)."""
+    names = set(TAXONOMY)
+    classes = [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]
+    changed = True
+    while changed:
+        changed = False
+        for cls in classes:
+            if cls.name in names:
+                continue
+            for base in cls.bases:
+                b = dotted_name(base)
+                if b.split(".")[-1] in names:
+                    names.add(cls.name)
+                    changed = True
+                    break
+    return names
+
+
+def _handler_names(handler: ast.ExceptHandler) -> set:
+    t = handler.type
+    if t is None:
+        return {"Exception"}          # bare except
+    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+    out = set()
+    for e in elts:
+        name = dotted_name(e)
+        out.add(name)
+        out.add(name.split(".")[-1])
+    return out
+
+
+def _in_guarded_try(ctx: LintContext, node: ast.AST, taxonomy: set) -> bool:
+    for anc in ctx.ancestors(node):
+        if isinstance(anc, ast.Try):
+            # Only the try BODY is protected by the handlers.
+            if not any(node is b or _contains(b, node) for b in anc.body):
+                continue
+            for h in anc.handlers:
+                caught = _handler_names(h)
+                if caught & BROAD_HANDLERS or caught & taxonomy:
+                    return True
+    return False
+
+
+def _contains(tree: ast.AST, node: ast.AST) -> bool:
+    return any(n is node for n in ast.walk(tree))
+
+
+def _raises_taxonomy(fn: ast.AST, taxonomy: set) -> bool:
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Raise) and n.exc is not None:
+            exc = n.exc
+            name = dotted_name(exc.func) if isinstance(exc, ast.Call) \
+                else dotted_name(exc)
+            if name.split(".")[-1] in taxonomy:
+                return True
+        # Delegating to a guard helper (`_bai_count(...)`, `r.take(...)`)
+        # counts when the helper itself raises taxonomy — approximated by
+        # a same-module helper check at the call layer below.
+    return False
+
+
+def _guarded_feeder(node: ast.Call, guarded_names: set) -> bool:
+    """True when an argument of this unpack is produced by a call to a
+    same-module taxonomy-raising helper (``self.take(...)``): the feeder
+    validates sizing and fails typed before bytes reach the unpack."""
+    for arg in node.args:
+        for sub in ast.walk(arg):
+            if (isinstance(sub, ast.Call)
+                    and dotted_name(sub.func).split(".")[-1]
+                    in guarded_names):
+                return True
+    return False
+
+
+def _is_unpack_call(node: ast.Call) -> bool:
+    if not isinstance(node.func, ast.Attribute):
+        return False
+    if node.func.attr not in ("unpack", "unpack_from"):
+        return False
+    # struct.unpack / struct.unpack_from / <Struct instance>.unpack_from —
+    # exclude obvious non-struct receivers? The attr names are specific
+    # enough in parser modules; keep the match broad so _FIXED.unpack_from
+    # (a precompiled Struct) is covered.
+    return True
+
+
+@register
+class GuardBoundaryRule(Rule):
+    id = "guard-boundary"
+    severity = "P1"
+    scope = ("bam/", "bgzf/", "cram/", "sbi/", "columnar/")
+    doc = ("untrusted bytes must fail typed: validate lengths then "
+           "unpack, or catch struct.error and raise TruncatedInput "
+           "(core/guard.py, docs/robustness.md)")
+
+    def check(self, ctx: LintContext):
+        taxonomy = _local_taxonomy(ctx.tree)
+        # Functions whose body raises the taxonomy (the validate-then-
+        # unpack idiom) — their unpacks are guarded.
+        guarded_fns = set()
+        fns = [n for n in ast.walk(ctx.tree)
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        for fn in fns:
+            if _raises_taxonomy(fn, taxonomy):
+                guarded_fns.add(fn)
+        # One-hop call-site guarding: helper functions whose every
+        # module-local call site sits in a guarded try (bai._parse).
+        callsite_guarded = set()
+        for fn in fns:
+            if fn in guarded_fns:
+                continue
+            sites = [
+                c for c in ast.walk(ctx.tree)
+                if isinstance(c, ast.Call)
+                and dotted_name(c.func).split(".")[-1] == fn.name
+            ]
+            if sites and all(
+                _in_guarded_try(ctx, c, taxonomy)
+                or ctx.enclosing_function(c) in guarded_fns
+                for c in sites
+            ):
+                callsite_guarded.add(fn)
+        guarded_names = {fn.name for fn in guarded_fns}
+
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call) and _is_unpack_call(node)):
+                continue
+            if _in_guarded_try(ctx, node, taxonomy):
+                continue
+            if _guarded_feeder(node, guarded_names):
+                continue
+            fn = ctx.enclosing_function(node)
+            if fn is not None and (fn in guarded_fns
+                                   or fn in callsite_guarded):
+                continue
+            where = f" in `{fn.name}`" if fn is not None else ""
+            yield self.finding(
+                ctx, node,
+                f"bare `{dotted_name(node.func)}` on untrusted bytes"
+                f"{where}: a corrupt input raises untyped struct.error",
+                hint="bounds-check first and raise TruncatedInput/"
+                     "StructurallyInvalid, or wrap in try/except "
+                     "struct.error (core/guard.py)",
+            )

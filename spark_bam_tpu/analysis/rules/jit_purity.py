@@ -1,0 +1,254 @@
+"""jit-purity: Python control flow on traced values inside jitted code.
+
+A Python ``if``/``while``/``for``/``assert`` on a traced array forces a
+concretization error at best and, with shape-dependent branching, a
+silent recompile per distinct shape at worst — defeating the
+``MeshSteps`` compiled-step registry that the serve daemon's whole perf
+story rests on (docs/serving.md). This pass finds, inside functions
+reachable as jit roots:
+
+- Python branches/loops whose condition mentions a traced parameter
+  (``.shape``/``.ndim``/``.dtype``/``.size``/``len()`` access is static
+  and exempt, as are ``is``/``is not`` None-sentinel tests);
+- host concretizations: ``int()``/``bool()``/``float()`` on traced
+  values, ``.item()``/``.tolist()`` calls;
+- non-literal ``static_argnums``/``static_argnames`` at any ``jax.jit``
+  site (varying statics silently fork the compile cache).
+
+Taint is intraprocedural: traced = non-static parameters plus names
+assigned from expressions that mention traced names (through the static
+exemptions). Nested ``def``s (vmap/shard_map bodies) extend the traced
+set with their own parameters.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from spark_bam_tpu.analysis.base import LintContext, Rule, dotted_name, register
+
+#: attribute reads on a tracer that are static at trace time
+STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "itemsize", "nbytes"}
+#: builtins whose application to a tracer concretizes (ConcretizationError)
+CONCRETIZERS = {"int", "bool", "float"}
+CONCRETIZER_METHODS = {"item", "tolist"}
+JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit"}
+PARTIAL_NAMES = {"functools.partial", "partial"}
+
+
+def _is_jit_func(node: ast.AST) -> bool:
+    return dotted_name(node) in JIT_NAMES
+
+
+def _static_names_from_kwargs(keywords) -> "tuple[set, set, list]":
+    """(static_argnames, static_argnums, non-literal kw nodes)."""
+    names: set = set()
+    nums: set = set()
+    bad = []
+    for kw in keywords or ():
+        if kw.arg not in ("static_argnames", "static_argnums"):
+            continue
+        v = kw.value
+        elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+        ok = True
+        for e in elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                names.add(e.value)
+            elif isinstance(e, ast.Constant) and isinstance(e.value, int):
+                nums.add(e.value)
+            else:
+                ok = False
+        if not ok:
+            bad.append(kw)
+    return names, nums, bad
+
+
+def _jit_decoration(fn: ast.FunctionDef):
+    """(is_jitted, static_argnames, static_argnums, bad_kw_nodes)."""
+    for dec in fn.decorator_list:
+        if _is_jit_func(dec):
+            return True, set(), set(), []
+        if isinstance(dec, ast.Call):
+            if _is_jit_func(dec.func):
+                names, nums, bad = _static_names_from_kwargs(dec.keywords)
+                return True, names, nums, bad
+            if (dotted_name(dec.func) in PARTIAL_NAMES and dec.args
+                    and _is_jit_func(dec.args[0])):
+                names, nums, bad = _static_names_from_kwargs(dec.keywords)
+                return True, names, nums, bad
+    return False, set(), set(), []
+
+
+def _callsite_jitted_names(tree: ast.AST):
+    """Function names passed to ``jax.jit(f, ...)`` / ``jax.jit(
+    shard_map(f, ...))`` call sites, plus static kwargs seen there."""
+    jitted: dict[str, tuple] = {}
+    bad_static: list = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and _is_jit_func(node.func)
+                and node.args):
+            continue
+        names, nums, bad = _static_names_from_kwargs(node.keywords)
+        bad_static.extend(bad)
+        target = node.args[0]
+        if (isinstance(target, ast.Call)
+                and dotted_name(target.func).endswith("shard_map")
+                and target.args):
+            target = target.args[0]
+        if isinstance(target, ast.Name):
+            jitted[target.id] = (names, nums)
+    return jitted, bad_static
+
+
+class _TaintScanner:
+    """Walk one jit-root function; yield (node, why) violations."""
+
+    def __init__(self, ctx: LintContext, fn: ast.FunctionDef,
+                 static_names: set, static_nums: set):
+        self.ctx = ctx
+        self.fn = fn
+        params = [a.arg for a in (
+            fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs
+        )]
+        self.tainted = {
+            p for i, p in enumerate(params)
+            if p not in static_names and i not in static_nums
+            and p not in ("self", "cls")
+        }
+        # Parameters with non-array defaults (str/bool/None sentinels) are
+        # config-shaped, not data: branching on them retraces at most once
+        # per distinct config — the compile-cache contract, not a bug.
+        defaults = fn.args.defaults
+        if defaults:
+            for a, d in zip(fn.args.args[-len(defaults):], defaults):
+                if isinstance(d, ast.Constant):
+                    self.tainted.discard(a.arg)
+        for a, d in zip(fn.args.kwonlyargs, fn.args.kw_defaults):
+            if isinstance(d, ast.Constant):
+                self.tainted.discard(a.arg)
+
+    def _traced_name_in(self, expr: ast.AST):
+        """The first Name node in ``expr`` that reads a traced value in a
+        non-static position, else None."""
+        parents = self.ctx.parents
+        for n in ast.walk(expr):
+            if not (isinstance(n, ast.Name) and n.id in self.tainted):
+                continue
+            p = parents.get(n)
+            # x.shape / x.ndim / ... are static metadata.
+            if isinstance(p, ast.Attribute) and p.attr in STATIC_ATTRS:
+                continue
+            # len(x) is static (leading-axis length).
+            if (isinstance(p, ast.Call) and isinstance(p.func, ast.Name)
+                    and p.func.id == "len"):
+                continue
+            # `x is None` / `x is not None` sentinel tests are host-level.
+            if isinstance(p, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in p.ops
+            ):
+                continue
+            return n
+        return None
+
+    def scan(self):
+        # Propagate taint through simple assignments first (top to bottom).
+        for node in ast.walk(self.fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                t = node.targets[0]
+                if isinstance(t, ast.Name) and self._traced_name_in(node.value):
+                    self.tainted.add(t.id)
+            elif isinstance(node, ast.FunctionDef) and node is not self.fn:
+                # vmap/shard_map bodies: their params are traced too.
+                for a in node.args.args:
+                    if a.arg not in ("self", "cls"):
+                        self.tainted.add(a.arg)
+
+        for node in ast.walk(self.fn):
+            if isinstance(node, (ast.If, ast.While)):
+                hit = self._traced_name_in(node.test)
+                if hit is not None:
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                    yield node, (
+                        f"Python `{kind}` on traced value {hit.id!r} inside "
+                        f"jitted `{self.fn.name}` — branches must be "
+                        "jnp.where/lax.cond/lax.while_loop, or the argument "
+                        "must be static"
+                    )
+            elif isinstance(node, ast.IfExp):
+                hit = self._traced_name_in(node.test)
+                if hit is not None:
+                    yield node, (
+                        f"conditional expression on traced value {hit.id!r} "
+                        f"inside jitted `{self.fn.name}` — use jnp.where"
+                    )
+            elif isinstance(node, ast.Assert):
+                hit = self._traced_name_in(node.test)
+                if hit is not None:
+                    yield node, (
+                        f"assert on traced value {hit.id!r} inside jitted "
+                        f"`{self.fn.name}` — concretizes at trace time; use "
+                        "checkify or a host-side precondition"
+                    )
+            elif isinstance(node, ast.For):
+                hit = self._traced_name_in(node.iter)
+                if (hit is not None and isinstance(node.iter, ast.Name)):
+                    yield node, (
+                        f"Python `for` iterating traced value {hit.id!r} "
+                        f"inside jitted `{self.fn.name}` — use lax.scan or "
+                        "lax.fori_loop"
+                    )
+            elif isinstance(node, ast.Call):
+                name = dotted_name(node.func)
+                if (name in CONCRETIZERS and node.args
+                        and self._traced_name_in(node.args[0]) is not None):
+                    yield node, (
+                        f"`{name}()` concretizes a traced value inside "
+                        f"jitted `{self.fn.name}` — forces a host sync / "
+                        "trace error; keep it an array op"
+                    )
+                elif (isinstance(node.func, ast.Attribute)
+                        and node.func.attr in CONCRETIZER_METHODS
+                        and self._traced_name_in(node.func.value) is not None):
+                    yield node, (
+                        f"`.{node.func.attr}()` on a traced value inside "
+                        f"jitted `{self.fn.name}` — device→host sync defeats "
+                        "async dispatch"
+                    )
+
+
+@register
+class JitPurityRule(Rule):
+    id = "jit-purity"
+    severity = "P1"
+    scope = ("tpu/", "parallel/")
+    doc = ("keep jitted bodies trace-pure: lax control flow for traced "
+           "values, literal static_argnums/argnames (docs/design.md)")
+
+    def check(self, ctx: LintContext):
+        callsite_jitted, bad_static = _callsite_jitted_names(ctx.tree)
+        for kw in bad_static:
+            yield self.finding(
+                ctx, kw.value,
+                "non-literal static_argnums/static_argnames at a jax.jit "
+                "site — varying statics fork the compile cache per call",
+                hint="pass a literal int/str tuple; route dynamic choices "
+                     "through MeshSteps keys instead",
+            )
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.FunctionDef):
+                continue
+            jitted, names, nums, bad = _jit_decoration(node)
+            for kw in bad:
+                yield self.finding(
+                    ctx, kw.value,
+                    f"non-literal static_argnums/static_argnames on jitted "
+                    f"`{node.name}`",
+                    hint="use a literal tuple of names/positions",
+                )
+            if not jitted and node.name in callsite_jitted:
+                jitted = True
+                names, nums = callsite_jitted[node.name]
+            if not jitted:
+                continue
+            for bad_node, msg in _TaintScanner(ctx, node, names, nums).scan():
+                yield self.finding(ctx, bad_node, msg)

@@ -1,0 +1,205 @@
+"""shared-state: unsynchronized mutation of attributes shared across
+threads.
+
+The serve/fabric plane mixes three execution domains in one process:
+the asyncio accept loop, the batcher tick thread, and the executor pool
+(docs/serving.md). An attribute written from one domain and read from
+another without a lock is a torn-read / lost-update waiting for load —
+exactly the ``Batcher.pause``/``tune`` seam the fabric autoscaler pokes
+at runtime.
+
+Per class, this pass:
+
+1. finds *thread-entry* methods — ``target=self.X`` handed to
+   ``threading.Thread`` or ``pool.submit(self.X)`` — and closes them
+   over ``self.Y()`` calls (the thread domain);
+2. treats every other method (sync or async) as the foreign domain —
+   public mutators like ``set_batch_rows`` are called from the loop or
+   request threads;
+3. flags ``self.attr`` assignments outside ``__init__`` that are not
+   inside a ``with self.<lock>`` block, when the attribute is also
+   touched from the other domain.
+
+Classes that spawn no threads and hold no ``threading`` lock are
+skipped (single-domain). Attributes whose value is itself a
+synchronization primitive (``Event``/``Lock``/``Condition``/
+``Semaphore``/``Queue``) are exempt — mutating THROUGH them is the
+fix, not the bug. ``asyncio`` locks do not count: they serialize
+coroutines, not threads.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from spark_bam_tpu.analysis.base import LintContext, Rule, dotted_name, register
+
+_LOCK_CTORS = {
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "Lock", "RLock", "Condition",
+}
+_SYNC_CTORS = _LOCK_CTORS | {
+    "threading.Event", "Event", "threading.Semaphore", "Semaphore",
+    "threading.BoundedSemaphore", "queue.Queue", "Queue",
+    "concurrent.futures.Future", "Future",
+}
+
+
+def _self_attr(node: ast.AST) -> "str | None":
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _method_map(cls: ast.ClassDef) -> dict:
+    return {
+        n.name: n for n in cls.body
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+
+
+def _attr_kinds(cls: ast.ClassDef) -> "tuple[set, set]":
+    """(lock attrs, all sync-primitive attrs) assigned anywhere in the
+    class from a threading/queue constructor."""
+    locks, sync = set(), set()
+    for node in ast.walk(cls):
+        if not (isinstance(node, ast.Assign) and isinstance(node.value, ast.Call)):
+            continue
+        ctor = dotted_name(node.value.func)
+        for t in node.targets:
+            a = _self_attr(t)
+            if a is None:
+                continue
+            if ctor in _LOCK_CTORS:
+                locks.add(a)
+                sync.add(a)
+            elif ctor in _SYNC_CTORS:
+                sync.add(a)
+    return locks, sync
+
+
+def _thread_entries(cls: ast.ClassDef) -> set:
+    """Method names handed to Thread(target=...) or pool.submit(self.X)."""
+    entries = set()
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func)
+        if name.split(".")[-1] == "Thread":
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    a = _self_attr(kw.value)
+                    if a:
+                        entries.add(a)
+        elif name.endswith(".submit") and node.args:
+            a = _self_attr(node.args[0])
+            if a:
+                entries.add(a)
+    return entries
+
+
+def _close_over_calls(cls: ast.ClassDef, seeds: set) -> set:
+    """Transitive closure of ``self.X()`` calls from seed methods."""
+    methods = _method_map(cls)
+    domain = set(seeds)
+    frontier = list(seeds)
+    while frontier:
+        m = methods.get(frontier.pop())
+        if m is None:
+            continue
+        for node in ast.walk(m):
+            if isinstance(node, ast.Call):
+                a = _self_attr(node.func)
+                if a and a in methods and a not in domain:
+                    domain.add(a)
+                    frontier.append(a)
+    return domain
+
+
+def _locked(ctx: LintContext, node: ast.AST, locks: set) -> bool:
+    """Is ``node`` inside ``with self.<lock>:`` for a known lock attr?"""
+    for anc in ctx.ancestors(node):
+        if isinstance(anc, (ast.With, ast.AsyncWith)):
+            for item in anc.items:
+                a = _self_attr(item.context_expr)
+                if a in locks:
+                    return True
+    return False
+
+
+@register
+class SharedStateRule(Rule):
+    id = "shared-state"
+    severity = "P1"
+    scope = ("serve/", "fabric/", "obs/", "parallel/")
+    doc = ("guard cross-thread attribute writes with the class lock, or "
+           "hand off through an Event/Queue (docs/serving.md)")
+
+    def check(self, ctx: LintContext):
+        for cls in ast.walk(ctx.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            entries = _thread_entries(cls)
+            locks, sync_attrs = _attr_kinds(cls)
+            if not entries and not locks:
+                continue            # single-domain class
+            methods = _method_map(cls)
+            thread_domain = _close_over_calls(cls, entries) if entries else set()
+            # Per-attribute touch map: method → reads/writes (+lock state).
+            touches: dict[str, dict] = {}
+            for mname, m in methods.items():
+                for node in ast.walk(m):
+                    a = None
+                    wrote = False
+                    if isinstance(node, (ast.Assign, ast.AugAssign)):
+                        targets = (node.targets
+                                   if isinstance(node, ast.Assign)
+                                   else [node.target])
+                        for t in targets:
+                            a = _self_attr(t)
+                            if a is not None:
+                                wrote = True
+                                break
+                    if a is None and isinstance(node, ast.Attribute):
+                        a = _self_attr(node)
+                    if a is None or a in sync_attrs:
+                        continue
+                    rec = touches.setdefault(
+                        a, {"writes": [], "readers": set()}
+                    )
+                    if wrote:
+                        rec["writes"].append(
+                            (mname, node, _locked(ctx, node, locks))
+                        )
+                    else:
+                        rec["readers"].add(mname)
+
+            for attr, rec in sorted(touches.items()):
+                toucher_methods = ({m for m, _, _ in rec["writes"]}
+                                   | rec["readers"])
+                if entries:
+                    in_thread = toucher_methods & thread_domain
+                    foreign = toucher_methods - thread_domain - {"__init__"}
+                    cross = bool(in_thread) and bool(foreign)
+                else:
+                    # Lock-owning class with no visible thread spawn: it
+                    # declared itself shared; any touch beyond __init__
+                    # from 2+ methods is treated as cross-domain.
+                    cross = len(toucher_methods - {"__init__"}) >= 2
+                if not cross:
+                    continue
+                for mname, node, locked in rec["writes"]:
+                    if mname == "__init__" or locked:
+                        continue
+                    yield self.finding(
+                        ctx, node,
+                        f"`{cls.name}.{attr}` is written in `{mname}` "
+                        "without a lock but is shared across the "
+                        "loop/thread boundary "
+                        f"(also touched by: "
+                        f"{', '.join(sorted(toucher_methods - {mname}))})",
+                        hint=(f"take `with self.{sorted(locks)[0]}:` around "
+                              "the write" if locks else
+                              "add a threading.Lock/Condition to the class"),
+                    )

@@ -1,0 +1,237 @@
+"""Lint runner: walk files, apply rules, resolve suppressions, report.
+
+Suppression resolution order per finding:
+
+1. inline ``# lint: allow[rule-id] reason`` on the flagged line or the
+   line directly above (reason required — a bare allow is itself a
+   finding);
+2. a justified entry in the baseline file (baseline.py);
+3. otherwise the finding is live and P1/P2 findings fail the gate.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+import time
+from dataclasses import dataclass, field
+
+from spark_bam_tpu.analysis.base import RULES, LintContext
+from spark_bam_tpu.analysis.baseline import Baseline
+from spark_bam_tpu.analysis.findings import Finding, Severity, assign_keys
+
+_ALLOW_RE = re.compile(r"#\s*lint:\s*allow\[([a-z0-9_,\- ]+)\]\s*(.*)")
+
+
+def _ensure_rules_loaded() -> None:
+    # Importing the rules package registers every rule (base.register).
+    from spark_bam_tpu.analysis import rules  # noqa: F401
+
+
+@dataclass
+class LintReport:
+    findings: "list[Finding]" = field(default_factory=list)   # live only
+    suppressed: "list[Finding]" = field(default_factory=list)
+    stale_baseline: "list[dict]" = field(default_factory=list)
+    errors: "list[str]" = field(default_factory=list)
+    files: int = 0
+    rules: "tuple[str, ...]" = ()
+    elapsed_ms: float = 0.0
+
+    @property
+    def failing(self) -> "list[Finding]":
+        return [f for f in self.findings
+                if f.severity in (Severity.P1, Severity.P2)]
+
+    @property
+    def ok(self) -> bool:
+        return not self.failing and not self.stale_baseline and not self.errors
+
+    def to_json(self) -> dict:
+        return {
+            "ok": self.ok,
+            "files": self.files,
+            "rules": list(self.rules),
+            "elapsed_ms": round(self.elapsed_ms, 1),
+            "findings": [f.to_json() for f in self.findings],
+            "suppressed": [f.to_json() for f in self.suppressed],
+            "stale_baseline": self.stale_baseline,
+            "errors": self.errors,
+        }
+
+
+def _inline_allows(lines: "list[str]") -> "dict[int, tuple[set, str]]":
+    """line → (rule ids allowed, reason). An allow on a line that holds
+    only the comment applies to the next NON-comment line (so the reason
+    may wrap onto continuation comment lines); otherwise to its own."""
+    allows: dict[int, tuple[set, str]] = {}
+    for i, text in enumerate(lines, start=1):
+        m = _ALLOW_RE.search(text)
+        if not m:
+            continue
+        ids = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        reason = m.group(2).strip()
+        if text.lstrip().startswith("#"):
+            target = i + 1
+            while (target <= len(lines)
+                   and lines[target - 1].lstrip().startswith("#")):
+                target += 1
+        else:
+            target = i
+        allows[target] = (ids, reason)
+    return allows
+
+
+def lint_source(rel_path: str, source: str,
+                rules: "list | None" = None) -> "list[Finding]":
+    """Run (a subset of) the suite over one in-memory file. The fixture
+    tests drive rules through this; the CLI path goes through
+    :func:`run_lint`. Inline allows are honored; no baseline."""
+    _ensure_rules_loaded()
+    active = rules if rules is not None else list(RULES.values())
+    tree = ast.parse(source, filename=rel_path)
+    ctx = LintContext(rel_path, source, tree)
+    found: list[Finding] = []
+    for rule in active:
+        if rule.applies_to(rel_path):
+            found.extend(rule.check(ctx))
+    assign_keys(found, ctx.lines)
+    allows = _inline_allows(ctx.lines)
+    live = []
+    for f in sorted(found, key=lambda f: (f.line, f.col, f.rule)):
+        allowed = allows.get(f.line)
+        if allowed and (f.rule in allowed[0] or "*" in allowed[0]):
+            ids, reason = allowed
+            if not reason:
+                f.message += " (inline allow has no reason; not suppressed)"
+                live.append(f)
+                continue
+            f.suppressed = "inline"
+            f.justification = reason
+            continue
+        live.append(f)
+    return live
+
+
+def iter_py_files(root: str):
+    """Yield (abs_path, rel_path) for package sources under ``root``,
+    skipping caches and the rule-fixture corpus (fixtures violate on
+    purpose)."""
+    skip_dirs = {"__pycache__", ".git", "fixtures"}
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames if d not in skip_dirs)
+        for name in sorted(filenames):
+            if name.endswith(".py"):
+                abs_path = os.path.join(dirpath, name)
+                yield abs_path, os.path.relpath(abs_path, root).replace(
+                    os.sep, "/"
+                )
+
+
+def run_lint(root: "str | None" = None, paths: "list[str] | None" = None,
+             rule_ids: "list[str] | None" = None,
+             baseline: "Baseline | str | None" = None) -> LintReport:
+    """Lint the package (or explicit ``paths``) and resolve suppressions.
+
+    ``root`` defaults to the installed ``spark_bam_tpu`` package
+    directory; rel paths in findings are package-relative (e.g.
+    ``serve/batcher.py``).
+    """
+    _ensure_rules_loaded()
+    t0 = time.perf_counter()
+    if root is None:
+        import spark_bam_tpu
+
+        root = os.path.dirname(os.path.abspath(spark_bam_tpu.__file__))
+    if rule_ids:
+        unknown = [r for r in rule_ids if r not in RULES]
+        if unknown:
+            raise ValueError(
+                f"unknown rule id(s): {', '.join(unknown)} "
+                f"(have: {', '.join(sorted(RULES))})"
+            )
+        active = [RULES[r] for r in rule_ids]
+    else:
+        active = list(RULES.values())
+    if isinstance(baseline, str):
+        baseline = Baseline.load(baseline)
+
+    report = LintReport(rules=tuple(r.id for r in active))
+    if paths:
+        files = []
+        for p in paths:
+            ap = os.path.abspath(p)
+            if os.path.isdir(ap):
+                files.extend(iter_py_files(ap))
+            else:
+                files.append((ap, os.path.relpath(ap, root).replace(os.sep, "/")))
+    else:
+        files = list(iter_py_files(root))
+
+    for abs_path, rel_path in files:
+        report.files += 1
+        try:
+            with open(abs_path, encoding="utf-8") as f:
+                source = f.read()
+            found = lint_source(rel_path, source, rules=active)
+        except (OSError, SyntaxError) as exc:
+            report.errors.append(f"{rel_path}: {exc}")
+            continue
+        for f in found:
+            entry = baseline.match(f) if baseline is not None else None
+            if entry is not None:
+                f.suppressed = "baseline"
+                f.justification = str(entry.get("justification", ""))
+                report.suppressed.append(f)
+            else:
+                report.findings.append(f)
+    if baseline is not None:
+        # Stale reporting only makes sense for a full-scope run: a
+        # --rules or paths subset never visits the other entries, and
+        # calling them stale would make every narrowed run red.
+        if not paths and not rule_ids:
+            report.stale_baseline = baseline.stale_entries()
+    report.findings.sort(
+        key=lambda f: (Severity.rank(f.severity), f.path, f.line)
+    )
+    report.elapsed_ms = (time.perf_counter() - t0) * 1000.0
+    return report
+
+
+def render_report(report: LintReport, verbose: bool = False) -> str:
+    out = []
+    for f in report.findings:
+        out.append(f.render())
+    for e in report.stale_baseline:
+        out.append(
+            f"{e.get('path')}: stale baseline entry for [{e.get('rule')}] "
+            f"key={e.get('key')} — finding no longer exists; delete the entry"
+        )
+    for err in report.errors:
+        out.append(f"error: {err}")
+    if verbose and report.suppressed:
+        out.append("")
+        for f in report.suppressed:
+            out.append(f"suppressed ({f.suppressed}): {f.location()} "
+                       f"[{f.rule}] — {f.justification}")
+    n_fail = len(report.failing)
+    n_adv = len(report.findings) - n_fail
+    tail = (
+        f"lint: {report.files} files, {len(report.rules)} rules, "
+        f"{n_fail} failing finding{'s' if n_fail != 1 else ''}"
+        + (f", {n_adv} advisory" if n_adv else "")
+        + (f", {len(report.suppressed)} suppressed" if report.suppressed else "")
+        + (f", {len(report.stale_baseline)} stale baseline entries"
+           if report.stale_baseline else "")
+        + f" ({report.elapsed_ms:.0f} ms)"
+    )
+    out.append(tail)
+    return "\n".join(out)
+
+
+def write_json(report: LintReport, path: str) -> None:
+    with open(path, "w") as f:
+        json.dump(report.to_json(), f, indent=2)
+        f.write("\n")

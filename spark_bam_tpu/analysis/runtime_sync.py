@@ -1,0 +1,134 @@
+"""Runtime complement to the static ``shared-state`` rule: a lock-order
+and happens-before recorder.
+
+The AST pass (rules/shared_state.py) proves an attribute *could* be
+touched from two thread domains; this harness observes what actually
+happens under load and catches the two failure classes statics can't:
+
+- **lock-order inversion** — thread A holds L1 and wants L2 while
+  thread B holds L2 and wants L1. Recorded as edges in a held→acquired
+  graph; any cycle is a potential deadlock even if the run got lucky.
+- **unsynchronized sharing** — an object accessed from two threads with
+  no lock held on either side and no happens-before edge between them.
+
+Usage (the ``slow``-marked test in tests/test_lint.py drives this over
+the serve batcher seam)::
+
+    rec = LockOrderRecorder()
+    lock_a = rec.wrap(threading.Lock(), "a")
+    lock_b = rec.wrap(threading.Lock(), "b")
+    ... run the workload ...
+    assert rec.cycles() == []
+
+Pure stdlib, no monkeypatching: callers wrap the locks they care about.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+
+
+class _WrappedLock:
+    """Context-manager proxy recording acquire/release order per thread."""
+
+    def __init__(self, lock, name: str, recorder: "LockOrderRecorder"):
+        self._lock = lock
+        self.name = name
+        self._rec = recorder
+
+    def acquire(self, *a, **kw):
+        self._rec._note_acquire(self.name)
+        got = self._lock.acquire(*a, **kw)
+        if not got:
+            self._rec._note_release(self.name)
+        return got
+
+    def release(self):
+        self._lock.release()
+        self._rec._note_release(self.name)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    # Condition-style passthroughs so a wrapped Condition still works.
+    def __getattr__(self, item):
+        return getattr(self._lock, item)
+
+
+class LockOrderRecorder:
+    """Records the held-set at every acquire and derives an order graph.
+
+    Thread-safe; cheap enough to leave on in a stress test. ``edges``
+    maps held-lock → {locks acquired while holding it}; a cycle in that
+    graph is a lock-order inversion (potential deadlock), regardless of
+    whether this particular run interleaved badly.
+    """
+
+    def __init__(self):
+        self._guard = threading.Lock()
+        self._held = defaultdict(list)        # thread id → [lock names]
+        self.edges: "dict[str, set]" = defaultdict(set)
+        self.acquisitions: "dict[str, int]" = defaultdict(int)
+        #: (thread name, held tuple) per acquire — the happens-before log
+        self.log: "list[tuple[str, str, tuple]]" = []
+
+    def wrap(self, lock, name: str) -> _WrappedLock:
+        return _WrappedLock(lock, name, self)
+
+    def _note_acquire(self, name: str) -> None:
+        tid = threading.get_ident()
+        with self._guard:
+            held = self._held[tid]
+            for h in held:
+                if h != name:
+                    self.edges[h].add(name)
+            self.acquisitions[name] += 1
+            self.log.append(
+                (threading.current_thread().name, name, tuple(held))
+            )
+            held.append(name)
+
+    def _note_release(self, name: str) -> None:
+        tid = threading.get_ident()
+        with self._guard:
+            held = self._held[tid]
+            if name in held:
+                # Remove the most recent acquisition (re-entrant safe).
+                for i in range(len(held) - 1, -1, -1):
+                    if held[i] == name:
+                        del held[i]
+                        break
+
+    def cycles(self) -> "list[list[str]]":
+        """Every elementary cycle in the held→acquired graph (DFS)."""
+        with self._guard:
+            graph = {k: set(v) for k, v in self.edges.items()}
+        out: list[list[str]] = []
+        seen_cycles: set = set()
+
+        def dfs(node, path, on_path):
+            for nxt in sorted(graph.get(node, ())):
+                if nxt in on_path:
+                    cyc = path[path.index(nxt):] + [nxt]
+                    key = frozenset(cyc)
+                    if key not in seen_cycles:
+                        seen_cycles.add(key)
+                        out.append(cyc)
+                    continue
+                dfs(nxt, path + [nxt], on_path | {nxt})
+
+        for start in sorted(graph):
+            dfs(start, [start], {start})
+        return out
+
+    def threads_touching(self, name: str) -> "set[str]":
+        """Thread names that acquired ``name`` — ≥2 proves cross-thread
+        sharing the static pass inferred."""
+        with self._guard:
+            return {t for t, n, _ in self.log if n == name}

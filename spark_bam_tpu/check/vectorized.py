@@ -364,4 +364,5 @@ def _count_check_result(n_candidates: int, res: "ChainResult") -> None:
         for i, name in enumerate(FLAG_NAMES):
             hits = int(((masked >> i) & 1).sum())
             if hits:
+                # lint: allow[obs-contract] suffix bounded by FLAG_NAMES
                 obs.count(f"check.flag_refutations.{name}", hits)

@@ -398,6 +398,44 @@ def build_parser() -> argparse.ArgumentParser:
              "(tcp:host:port or unix:path)",
     )
 
+    # Project-native static analysis: AST rules guarding the jit,
+    # asyncio, and untrusted-byte seams (docs/static-analysis.md).
+    sub = sp.add_parser("lint")
+    sub.add_argument("-o", "--out", default=None, help="write output to file")
+    sub.add_argument(
+        "paths", nargs="*",
+        help="files or directories to lint (default: the installed "
+             "spark_bam_tpu package)",
+    )
+    sub.add_argument(
+        "--rules", default=None,
+        help="comma-separated rule ids to run (default: all registered)",
+    )
+    sub.add_argument(
+        "--baseline", default=None,
+        help="baseline suppression file (default: lint-baseline.json "
+             "next to the package; missing file = empty baseline)",
+    )
+    sub.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore the baseline file — report every finding",
+    )
+    sub.add_argument(
+        "--json", dest="json_out", default=None, metavar="PATH",
+        help="also write the full findings report as JSON (the CI "
+             "artifact format)",
+    )
+    sub.add_argument(
+        "--write-baseline", default=None, metavar="REASON",
+        help="write the current live findings to the baseline file with "
+             "REASON as the justification stub, then exit 0 (edit "
+             "per-entry justifications before committing)",
+    )
+    sub.add_argument(
+        "-v", "--verbose", action="store_true",
+        help="also list suppressed findings with their justifications",
+    )
+
     return ap
 
 
@@ -507,6 +545,8 @@ def main(argv=None) -> int:
     if profile_set:
         os.environ["SPARK_BAM_PROFILE"] = profile_set
     cmd = args.command
+    # lint: allow[obs-contract] cmd bounded by the subparser set; every
+    # cli.<subcommand> span is enumerated in obs/names.py
     root_span = obs.span(f"cli.{cmd}")
     root_span.__enter__()
     try:
@@ -710,6 +750,36 @@ def main(argv=None) -> int:
             from spark_bam_tpu.cli import top
 
             top.run(args.address, p, prometheus=args.prometheus)
+        elif cmd == "lint":
+            import spark_bam_tpu as _pkg
+            from spark_bam_tpu.analysis import Baseline, render_report, run_lint
+            from spark_bam_tpu.analysis.runner import write_json
+
+            pkg_dir = os.path.dirname(os.path.abspath(_pkg.__file__))
+            baseline_path = args.baseline or os.path.join(
+                os.path.dirname(pkg_dir), "lint-baseline.json"
+            )
+            rule_ids = ([r.strip() for r in args.rules.split(",") if r.strip()]
+                        if args.rules else None)
+            try:
+                if args.write_baseline is not None:
+                    rep = run_lint(paths=args.paths or None,
+                                   rule_ids=rule_ids)
+                    n = Baseline.write(baseline_path, rep.findings,
+                                       args.write_baseline)
+                    p.echo(f"wrote {n} entries to {baseline_path} — edit "
+                           "per-entry justifications before committing")
+                    return 0
+                rep = run_lint(
+                    paths=args.paths or None, rule_ids=rule_ids,
+                    baseline=None if args.no_baseline else baseline_path,
+                )
+            except ValueError as e:
+                raise UsageError(str(e)) from e
+            if args.json_out:
+                write_json(rep, args.json_out)
+            p.echo(render_report(rep, verbose=args.verbose))
+            return 0 if rep.ok else 1
         # Fault-tolerance postscript: whenever partition execution had to
         # retry/hedge/quarantine, say so (the quarantine list is the
         # operator's cue that the output is a degraded-but-complete run).

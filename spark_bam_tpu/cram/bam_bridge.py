@@ -11,6 +11,8 @@ from __future__ import annotations
 
 import struct
 
+from spark_bam_tpu.core.guard import StructurallyInvalid, TruncatedInput
+
 # BAM tag value byte-lengths by type char (value excludes tag+type).
 _FIXED_TAG = {"A": 1, "c": 1, "C": 1, "s": 2, "S": 2, "i": 4, "I": 4, "f": 4}
 _SUB_SIZE = {"c": 1, "C": 1, "s": 2, "S": 2, "i": 4, "I": 4, "f": 4}
@@ -21,6 +23,12 @@ def split_tags(raw: bytes) -> list[tuple[bytes, int, bytes]]:
 
     Z/H values keep their NUL terminator out of the value (re-added on
     rebuild); B values keep subtype+count+payload.
+
+    The blob comes off disk (or a CRAM stream), so every length and
+    offset is untrusted: damage raises the core/guard.py taxonomy —
+    :class:`TruncatedInput` when a declared value runs past the blob,
+    :class:`StructurallyInvalid` for unknown type/subtype codes or a
+    negative B-array count — never a bare ``struct.error``/``ValueError``.
     """
     out = []
     p = 0
@@ -32,20 +40,46 @@ def split_tags(raw: bytes) -> list[tuple[bytes, int, bytes]]:
         t = chr(typ)
         if t in _FIXED_TAG:
             size = _FIXED_TAG[t]
+            if p + size > n:
+                raise TruncatedInput(
+                    f"tag {tag!r}:{t} value runs past blob end "
+                    f"(need {size} bytes at {p}, have {n - p})"
+                )
             out.append((tag, typ, bytes(raw[p: p + size])))
             p += size
         elif t in "ZH":
-            end = raw.index(b"\x00", p)
+            end = raw.find(b"\x00", p)
+            if end < 0:
+                raise TruncatedInput(
+                    f"tag {tag!r}:{t} string missing NUL terminator"
+                )
             out.append((tag, typ, bytes(raw[p:end])))
             p = end + 1
         elif t == "B":
+            if p + 5 > n:
+                raise TruncatedInput(
+                    f"tag {tag!r}:B header runs past blob end"
+                )
             sub = chr(raw[p])
+            if sub not in _SUB_SIZE:
+                raise StructurallyInvalid(
+                    f"tag {tag!r}:B has unknown subtype {sub!r}"
+                )
             count = struct.unpack_from("<i", raw, p + 1)[0]
+            if count < 0:
+                raise StructurallyInvalid(
+                    f"tag {tag!r}:B declares negative count {count}"
+                )
             size = 5 + count * _SUB_SIZE[sub]
+            if p + size > n:
+                raise TruncatedInput(
+                    f"tag {tag!r}:B[{sub}] x{count} runs past blob end "
+                    f"(need {size} bytes at {p}, have {n - p})"
+                )
             out.append((tag, typ, bytes(raw[p: p + size])))
             p += size
         else:
-            raise ValueError(f"unknown tag type {t!r}")
+            raise StructurallyInvalid(f"unknown tag type {t!r}")
     return out
 
 

@@ -1,0 +1,104 @@
+"""Registered metric/span name catalog — the obs naming contract.
+
+Every metric and span name the package emits lives here, grouped by
+hot-path layer (the dotted ``layer.stage`` convention from
+obs/registry.py). The ``obs-contract`` lint pass
+(analysis/rules/obs_contract.py) enforces it: a literal name passed to
+``obs.count``/``observe``/``span``/``counter``/``gauge``/``histogram``
+that is not in :data:`NAMES` fails the gate, and dynamic (f-string)
+names are flagged unless their literal prefix is a registered layer AND
+the call site carries an inline allow justifying bounded cardinality.
+
+Why a registry: the PR 11 telemetry plane merges snapshots across
+processes by name (obs/exporters.py ``merge_snapshots``) and renders
+fleet dashboards from them — an ad-hoc name in one worker silently
+forks a series the merge can't join, and an unbounded name (one series
+per request id) OOMs the registry. Adding a metric = adding one line
+here; the whole-repo lint test fails until you do.
+"""
+
+from __future__ import annotations
+
+#: Layer prefixes (the segment before the first dot). A new layer means
+#: a new subsystem — add it here alongside its names.
+LAYERS = frozenset({
+    "bgzf", "cache", "chaos", "check", "cli", "columnar", "fabric",
+    "faults", "funnel", "guard", "inflate", "load", "mesh", "progress",
+    "remote", "serve", "timer",
+})
+
+NAMES = frozenset({
+    # bgzf — block streaming (docs/design.md)
+    "bgzf.blocks_read", "bgzf.blocks_scanned", "bgzf.bytes_inflated",
+    "bgzf.bytes_read", "bgzf.read",
+    # cache — .sbi split-index sidecars (docs/caching.md)
+    "cache.bytes", "cache.evictions", "cache.hits", "cache.invalidations",
+    "cache.misses", "cache.read_ms", "cache.write_ms",
+    # chaos — deterministic fault injection (docs/robustness.md)
+    "chaos.corrupted_bytes", "chaos.io_errors", "chaos.latency_spikes",
+    "chaos.short_reads",
+    # check — record-boundary checker
+    "check.accepted", "check.candidates", "check.count_escape_retries",
+    "check.defer_resolved", "check.defer_retries", "check.deferred",
+    "check.escaped", "check.find_record_start", "check.positions",
+    "check.window", "check.windows",
+    # cli — root spans, one per subcommand (cli/main.py)
+    "cli.check-bam", "cli.check-blocks", "cli.compare-splits",
+    "cli.compute-splits", "cli.count-reads", "cli.export", "cli.fabric",
+    "cli.full-check", "cli.fuzz-decode", "cli.htsjdk-rewrite",
+    "cli.index", "cli.index-bam", "cli.index-blocks", "cli.index-records",
+    "cli.lint", "cli.metrics-report", "cli.rewrite", "cli.serve",
+    "cli.time-load", "cli.top",
+    # columnar — record-batch analytics plane (docs/analytics.md)
+    "columnar.build_ms", "columnar.bytes_out", "columnar.encode_ms",
+    "columnar.export", "columnar.rows",
+    # fabric — control plane (docs/fabric.md); fabric.<counter> names are
+    # emitted through Router._count's bounded literal set
+    "fabric.relay", "fabric.autoscale_moves", "fabric.drained",
+    "fabric.ejected", "fabric.failovers", "fabric.lost",
+    "fabric.reinstated", "fabric.relayed_overload", "fabric.routed",
+    "fabric.spilled",
+    # faults — retry/hedge/quarantine ledger (docs/robustness.md)
+    "faults.attempt_ms", "faults.hedges", "faults.quarantined",
+    "faults.quarantined_blocks", "faults.retries",
+    # funnel — two-stage checker candidate funnel (docs/design.md)
+    "funnel.positions", "funnel.reduction", "funnel.survivors",
+    "funnel.window_survivors",
+    # guard — untrusted-byte decode boundary (core/guard.py)
+    "guard.quarantined_blocks", "guard.quarantined_records",
+    # inflate — device-resident BGZF inflate (docs/design.md)
+    "inflate.block", "inflate.blocks", "inflate.bytes",
+    "inflate.device_kernel", "inflate.device_ms", "inflate.device_windows",
+    "inflate.h2d", "inflate.h2d_bytes", "inflate.h2d_ms", "inflate.host_ms",
+    "inflate.pack", "inflate.rounds", "inflate.stall_ms", "inflate.stalls",
+    "inflate.tokenize", "inflate.window", "inflate.windows",
+    # load — partition execution
+    "load.count", "load.fleet_files", "load.parse", "load.partition",
+    "load.partitions", "load.record_starts", "load.records",
+    "load.split_resolutions",
+    # mesh — compiled-step registry + shard_map dispatch
+    "mesh.dirty_steps", "mesh.dispatch", "mesh.escapes",
+    "mesh.patch_chunk_positions", "mesh.patch_chunks", "mesh.patch_rows",
+    "mesh.step", "mesh.steps",
+    # progress — long-run heartbeats
+    "progress.beats",
+    # remote — plan-driven data plane (docs/remote.md)
+    "remote.bucket_wait_ms", "remote.bytes", "remote.depth",
+    "remote.evictions", "remote.get_ms", "remote.gets", "remote.hedge_wins",
+    "remote.hedges", "remote.plan_segments", "remote.quota_wait_ms",
+    "remote.stalls", "remote.unplanned_gets",
+    # serve — split-service daemon (docs/serving.md)
+    "serve.batch_encode", "serve.batch_rows", "serve.batches",
+    "serve.connections", "serve.device_dispatch", "serve.latency_ms",
+    "serve.overloaded", "serve.parse", "serve.queue_depth", "serve.queue_ms",
+    "serve.request", "serve.requests", "serve.shed", "serve.tick",
+    "serve.tuned",
+})
+
+
+def is_registered(name: str) -> bool:
+    return name in NAMES
+
+
+def layer_of(name: str) -> str:
+    return name.split(".", 1)[0]

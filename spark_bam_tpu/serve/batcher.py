@@ -23,7 +23,6 @@ import numpy as np
 from spark_bam_tpu import obs
 from spark_bam_tpu.obs import trace as obs_trace
 from spark_bam_tpu.serve.config import MAX_CONTIGS
-from spark_bam_tpu.tpu.checker import PAD
 
 
 class RowTask:
@@ -106,9 +105,13 @@ class Batcher:
         return rows
 
     def set_tick_ms(self, tick_ms: float) -> float:
-        """Retarget the gather window (host-side only — no recompile)."""
+        """Retarget the gather window (host-side only — no recompile).
+        Written under the condition so the batcher thread's in-progress
+        ``_take_batch`` never reads a torn/stale tick mid-gather."""
         tick_ms = max(0.0, float(tick_ms))
-        self.tick_s = tick_ms / 1000.0
+        with self._cond:
+            self.tick_s = tick_ms / 1000.0
+            self._cond.notify()
         return tick_ms
 
     def pause(self) -> None:

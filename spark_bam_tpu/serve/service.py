@@ -283,7 +283,12 @@ class SplitService:
             flight.record("error", op=op, id=req.get("id"),
                           error=resp.get("error"),
                           message=resp.get("message"))
-        self.served += 1
+        # Under the op lock: ``+=`` from concurrent pool threads loses
+        # updates, and ``served`` feeds the autoscaler's served-changed
+        # hysteresis — a stuck count reads as "no fresh samples" and
+        # holds tuning moves forever.
+        with self._op_lock:
+            self.served += 1
         fut.set_result(resp)
 
     def _note_op(self, op: str, ms: float, resp: dict) -> None:

@@ -1120,6 +1120,7 @@ def full_check_summary_streaming(
         # path never passes through check_flat), so the two would
         # double-count under one name on the NumPy engine.
         for i, name in enumerate(FLAG_NAMES):
+            # lint: allow[obs-contract] suffix bounded by FLAG_NAMES
             obs.count(f"check.flag_fail_sites.{name}", int(per_flag[i]))
 
     crit_pos_a, crit_mask_a = cat_sorted(crit_pos, crit_mask)

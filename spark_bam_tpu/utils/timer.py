@@ -49,6 +49,8 @@ class Timer:
     def __exit__(self, *exc):
         self.seconds = time.perf_counter() - self._t0
         if self.name:
+            # lint: allow[obs-contract] timer names are literal strings at
+            # Timer(...) construction sites — a fixed, code-reviewed set
             obs.observe(f"timer.{self.name}", self.ms, unit="ms")
         if self.echo is not None and self.name:
             self.echo(f"{self.name}: {self.ms:.3f}ms")
