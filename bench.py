@@ -2358,6 +2358,97 @@ def inflate_ab_leg(path: str, window: int = 4 << 20, max_windows: int = 4):
     }
 
 
+def deflate_leg(path: str, target_bytes: int = 3 << 20, lanes: int = 16):
+    """Host zlib vs batched device deflate over IDENTICAL payload windows
+    — the write-path mirror of :func:`inflate_ab_leg` and the ROADMAP
+    ``deflate_vs_host`` criterion. Payloads are the fixture's first
+    ~``target_bytes`` of uncompressed stream re-chunked at the writer's
+    default block payload; both sides emit complete BGZF members, gated
+    on per-member validity (every member gunzips) and decoded-byte
+    equality against the source. The ratio is honest about backend: on a
+    CPU-only container the XLA scatter kernels lose to host zlib and the
+    number says so (``device_ok`` separates "device path ran without
+    demotion" from "device path won")."""
+    import zlib as _zlib
+
+    import jax
+
+    from spark_bam_tpu import obs
+    from spark_bam_tpu.bam.writer import DEFAULT_BLOCK_PAYLOAD
+    from spark_bam_tpu.bgzf.flat import inflate_blocks
+    from spark_bam_tpu.bgzf.index_blocks import blocks_metadata
+    from spark_bam_tpu.compress.codec import DeviceDeflateCodec, HostZlibCodec
+    from spark_bam_tpu.compress.config import DeflateConfig
+    from spark_bam_tpu.core.channel import open_channel
+
+    metas, total = [], 0
+    for m in blocks_metadata(path):
+        metas.append(m)
+        total += m.uncompressed_size
+        if total >= target_bytes:
+            break
+    with open_channel(path) as ch:
+        data = np.asarray(inflate_blocks(ch, metas).data).tobytes()
+    windows = [data[i: i + DEFAULT_BLOCK_PAYLOAD]
+               for i in range(0, len(data), DEFAULT_BLOCK_PAYLOAD)]
+    if not windows:
+        return {}
+    host = HostZlibCodec(6)
+    dev = DeviceDeflateCodec(DeflateConfig.parse(f"mode=fixed,lanes={lanes}"))
+    batches = [windows[i: i + lanes] for i in range(0, len(windows), lanes)]
+    for n in {len(b) for b in batches}:  # compile each pow2 lane bucket
+        dev.encode_blocks(windows[:n])
+    obs.shutdown()
+    reg = obs.configure()  # counters cover the timed run, not the warm-up
+
+    t0 = time.perf_counter()
+    host_members = []
+    for b in batches:
+        host_members += host.encode_blocks(b)
+    host_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    dev_members = []
+    for b in batches:
+        dev_members += dev.encode_blocks(b)
+    dev_s = time.perf_counter() - t0
+
+    def _decode_all(members):
+        out = []
+        for m in members:
+            d = _zlib.decompressobj(31)
+            out.append(d.decompress(m))
+            if not d.eof:
+                return None
+        return b"".join(out)
+
+    equal = (_decode_all(dev_members) == data
+             and _decode_all(host_members) == data)
+    counters = {
+        c["name"]: c["value"] for c in reg.snapshot()["counters"]
+    }
+    host_Bps = len(data) / max(host_s, 1e-9)
+    dev_Bps = len(data) / max(dev_s, 1e-9)
+    ratio = round(dev_Bps / max(host_Bps, 1e-9), 4)
+    return {
+        "deflate_ab": {
+            "host_Bps": round(host_Bps),
+            "device_Bps": round(dev_Bps),
+            "device_vs_host": ratio,
+            "equal": equal,
+            "windows": len(windows),
+            "bytes": len(data),
+            "bytes_out_device": sum(len(m) for m in dev_members),
+            "bytes_out_host": sum(len(m) for m in host_members),
+            "stored_members": counters.get("compress.stored", 0),
+            "fixed_members": counters.get("compress.fixed", 0),
+            "device_ok": counters.get("deflate.demotions", 0) == 0,
+            "backend": jax.default_backend(),
+        },
+        "deflate_vs_host": ratio,
+        "deflate_equal": equal,
+    }
+
+
 def cpu_e2e_rate(path: Path, cap_bytes: int = CPU_E2E_CAP_BYTES):
     """The same count-reads workload on the native CPU checker: pipelined
     host inflate + sequential native eager check of every position.
@@ -2447,6 +2538,33 @@ def main():
         return
     if len(sys.argv) > 1 and sys.argv[1] == "--child-fabric":
         _child_fabric()
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == "--deflate-only":
+        # Standalone write-path A/B: lands a deflate_vs_host row in the
+        # history without the 1 GB e2e synthesis (the reference fixture
+        # is optional — the in-package synthetic seed stands in).
+        record = {"metric": "deflate_vs_host", "value": 0, "unit": "x",
+                  "error": None}
+        try:
+            if FIXTURE.exists():
+                from spark_bam_tpu.benchmarks.synth import ensure_big_bam
+
+                p, _ = ensure_big_bam(QUICK_E2E_BYTES)
+            else:
+                from spark_bam_tpu.benchmarks.synth import synthetic_fixture
+
+                p = synthetic_fixture(reads=20000)
+            record.update(deflate_leg(str(p)))
+            record["value"] = record.get("deflate_vs_host", 0)
+        except Exception as e:
+            record["error"] = f"{type(e).__name__}: {e}"
+        print(json.dumps(record))
+        try:
+            hist = Path(__file__).resolve().parent / "BENCH_HISTORY.jsonl"
+            with open(hist, "a") as f:
+                f.write(json.dumps({"ts": time.time(), **record}) + "\n")
+        except OSError:
+            pass
         return
 
     record = {
@@ -2868,6 +2986,13 @@ def _main_measure(record, warnings, errors):
                     record[k] = v
         except Exception as e:
             warnings.append(f"inflate A/B leg: {type(e).__name__}: {e}")
+    # Host-zlib vs batched device deflate on identical payload windows —
+    # the write-path A/B (in-process backend; validity + equality gated).
+    if quick_path:
+        try:
+            record.update(deflate_leg(quick_path))
+        except Exception as e:
+            warnings.append(f"deflate A/B leg: {type(e).__name__}: {e}")
 
     pallas = results.get("pallas")
     if pallas is not None:

@@ -1,17 +1,29 @@
-"""BGZF/BAM writing: block compressor, header + record encoder.
+"""BGZF/BAM writing: block codecs, header + record encoder.
 
 Enables the reference's ``htsjdk-rewrite`` capability (round-trip a BAM so
 records stop being block-aligned — cli/.../rewrite/HTSJDKRewrite.scala:347-418)
 and synthetic-fixture generation for tests, without HTSJDK.
+
+The compressor is pluggable (``compress/codec.py``): the default is the
+host zlib path, while ``--deflate`` / ``SPARK_BAM_DEFLATE`` routes whole
+batches of payload lanes through the device CRC32/fixed-Huffman kernels.
+``BgzfWriter`` drives any codec through its dispatch/materialize split
+with up to two batches in flight — the write-side mirror of the inflate
+pipeline's double-buffering — and records per-member ``Metadata`` as it
+goes, so rewrite can emit ``.blocks``/``.sbi`` sidecars without ever
+re-reading its own output.
 """
 
 from __future__ import annotations
 
 import struct
-import zlib
+from collections import deque
+from dataclasses import dataclass, field
 
 from spark_bam_tpu.bam.header import BamHeader
 from spark_bam_tpu.bam.record import BamRecord
+from spark_bam_tpu.bgzf.block import Metadata
+from spark_bam_tpu.compress.huffman import zlib_member
 
 # Standard 28-byte BGZF EOF sentinel block.
 BGZF_EOF = bytes.fromhex(
@@ -23,46 +35,104 @@ DEFAULT_BLOCK_PAYLOAD = 0xFF00
 
 
 def compress_block(payload: bytes, level: int = 6) -> bytes:
-    """One complete BGZF block (header + raw-deflate payload + footer)."""
-    compressor = zlib.compressobj(level, zlib.DEFLATED, -15)
-    comp = compressor.compress(payload) + compressor.flush()
-    bsize = 18 + len(comp) + 8  # header + payload + footer
-    if bsize > 0x10000:
-        raise ValueError("Block too large after compression; lower payload size")
-    header = (
-        b"\x1f\x8b\x08\x04"        # gzip magic, deflate, FEXTRA
-        + b"\x00\x00\x00\x00"      # mtime
-        + b"\x00\xff"              # XFL, OS
-        + b"\x06\x00"              # XLEN = 6
-        + b"BC\x02\x00"            # BC subfield
-        + struct.pack("<H", bsize - 1)
-    )
-    footer = struct.pack("<II", zlib.crc32(payload), len(payload))
-    return header + comp + footer
+    """One complete BGZF block (header + raw-deflate payload + footer).
+
+    The host escape hatch every device demotion lands on. An
+    incompressible payload whose zlib output would overflow the u16
+    BSIZE field falls back to a stored-block member (bounded 5-byte
+    expansion — always fits at the default payload size); only a payload
+    too large even for stored raises ``core/guard.py LimitExceeded``.
+    """
+    return zlib_member(payload, level)
+
+
+@dataclass
+class WriteResult:
+    """Everything ``write_bam_result`` learned while packing: enough to
+    build every sidecar (``.blocks``/``.records``/``.sbi``) in memory."""
+
+    count: int = 0
+    header_len: int = 0
+    blocks: "list[Metadata]" = field(default_factory=list)
+    #: Flat (uncompressed-stream) offset of each record start, in order.
+    record_flats: "list[int]" = field(default_factory=list)
+    bytes_out: int = 0
 
 
 class BgzfWriter:
-    """Buffer bytes; flush complete BGZF blocks to a file object."""
+    """Buffer bytes; flush complete BGZF blocks to a file object.
 
-    def __init__(self, fobj, block_payload: int = DEFAULT_BLOCK_PAYLOAD, level: int = 6):
+    ``codec`` is any ``compress/codec.py`` block codec; payloads batch up
+    to ``codec.lanes`` per dispatch and at most two batches stay in
+    flight (dispatch batch N while materializing batch N-1). ``blocks``
+    accumulates one ``Metadata`` per member in file order — the same
+    rows ``bgzf/index_blocks.py`` would scan back, minus the EOF
+    sentinel — and ``flat_tell`` exposes the uncompressed-stream offset
+    so callers can note record starts as they pack.
+    """
+
+    def __init__(self, fobj, block_payload: int = DEFAULT_BLOCK_PAYLOAD,
+                 level: int = 6, codec=None):
+        if codec is None:
+            from spark_bam_tpu.compress.codec import HostZlibCodec
+
+            codec = HostZlibCodec(level)
         self.f = fobj
         self.block_payload = block_payload
         self.level = level
+        self.codec = codec
         self.buf = bytearray()
+        self.blocks: "list[Metadata]" = []
+        self._batch: "list[bytes]" = []
+        self._pending: "deque[tuple[list[int], object]]" = deque()
+        self._offset = 0
+        self._flat = 0
+
+    @property
+    def flat_tell(self) -> int:
+        """Uncompressed-stream offset of the next byte written."""
+        return self._flat
 
     def write(self, data: bytes) -> None:
+        self._flat += len(data)
         self.buf += data
         while len(self.buf) >= self.block_payload:
-            self._flush_block(self.block_payload)
+            payload = bytes(self.buf[: self.block_payload])
+            del self.buf[: self.block_payload]
+            self._enqueue(payload)
 
-    def _flush_block(self, n: int) -> None:
-        payload, self.buf = bytes(self.buf[:n]), self.buf[n:]
-        self.f.write(compress_block(payload, self.level))
+    def _enqueue(self, payload: bytes) -> None:
+        self._batch.append(payload)
+        if len(self._batch) >= max(int(getattr(self.codec, "lanes", 1)), 1):
+            self._dispatch_batch()
+
+    def _dispatch_batch(self) -> None:
+        if not self._batch:
+            return
+        plens = [len(p) for p in self._batch]
+        handle = self.codec.dispatch(self._batch)
+        self._batch = []
+        self._pending.append((plens, handle))
+        while len(self._pending) > 1:
+            self._write_oldest()
+
+    def _write_oldest(self) -> None:
+        plens, handle = self._pending.popleft()
+        for n, member in zip(plens, self.codec.materialize(handle)):
+            self.f.write(member)
+            self.blocks.append(Metadata(self._offset, len(member), n))
+            self._offset += len(member)
 
     def close(self) -> None:
         if self.buf:
-            self._flush_block(len(self.buf))
+            payload = bytes(self.buf)
+            self.buf = bytearray()
+            self._enqueue(payload)
+        self._dispatch_batch()
+        while self._pending:
+            self._write_oldest()
         self.f.write(BGZF_EOF)
+        self._offset += len(BGZF_EOF)
         self.f.flush()
 
     def __enter__(self):
@@ -87,12 +157,59 @@ def encode_bam_header(header: BamHeader) -> bytes:
     return bytes(out)
 
 
+def write_bam_result(
+    path,
+    header: BamHeader,
+    records,
+    block_payload: int = DEFAULT_BLOCK_PAYLOAD,
+    level: int = 6,
+    deflate=None,
+    codec=None,
+) -> WriteResult:
+    """``write_bam`` returning the full :class:`WriteResult` (counts,
+    per-member metadata, record-start flat offsets).
+
+    The output lands via ``core/atomic.AtomicFile`` — a crash mid-write
+    never leaves a truncated BAM (no EOF sentinel) at ``path``.
+    ``deflate`` is a ``DeflateConfig``/spec string selecting the codec
+    ("" /None/mode=off ⇒ host zlib at ``level``); ``codec`` overrides
+    it with a pre-built codec instance.
+    """
+    from spark_bam_tpu.core.atomic import AtomicFile
+
+    if codec is None:
+        from spark_bam_tpu.compress.codec import make_codec
+
+        codec = make_codec(deflate, level=level)
+    result = WriteResult()
+    out = AtomicFile(path)
+    try:
+        with BgzfWriter(out.f, block_payload, level, codec=codec) as w:
+            w.write(encode_bam_header(header))
+            result.header_len = w.flat_tell
+            for rec in records:
+                rec = rec[1] if isinstance(rec, tuple) else rec  # accept (Pos, rec)
+                assert isinstance(rec, BamRecord)
+                result.record_flats.append(w.flat_tell)
+                w.write(rec.encode())
+                result.count += 1
+        result.blocks = w.blocks
+        result.bytes_out = w._offset
+    except BaseException:
+        out.abort()
+        raise
+    out.commit()
+    return result
+
+
 def write_bam(
     path,
     header: BamHeader,
     records,
     block_payload: int = DEFAULT_BLOCK_PAYLOAD,
     level: int = 6,
+    deflate=None,
+    codec=None,
 ) -> int:
     """Write a BAM file; returns the number of records written.
 
@@ -100,12 +217,7 @@ def write_bam(
     record starts are deliberately *not* block-aligned — the property the
     reference's htsjdk-rewrite manufactures for adversarial split tests.
     """
-    count = 0
-    with open(path, "wb") as f, BgzfWriter(f, block_payload, level) as w:
-        w.write(encode_bam_header(header))
-        for rec in records:
-            rec = rec[1] if isinstance(rec, tuple) else rec  # accept (Pos, rec)
-            assert isinstance(rec, BamRecord)
-            w.write(rec.encode())
-            count += 1
-    return count
+    return write_bam_result(
+        path, header, records,
+        block_payload=block_payload, level=level, deflate=deflate, codec=codec,
+    ).count

@@ -113,6 +113,16 @@ def _add_columnar(sub):
     )
 
 
+def _add_deflate(sub):
+    sub.add_argument(
+        "--deflate", default=None, metavar="SPEC",
+        help="write-path codec knobs, e.g. 'mode=fixed,lanes=16,"
+             "device=auto' — stored/fixed members batch-compressed on "
+             "device, host zlib when off (SPARK_BAM_DEFLATE env var "
+             "works too; docs/design.md)",
+    )
+
+
 def _add_common(sub, split_default=None):
     _add_metrics(sub)
     _add_faults(sub)
@@ -287,10 +297,16 @@ def build_parser() -> argparse.ArgumentParser:
 
     sub = sp.add_parser("htsjdk-rewrite", aliases=["rewrite"])
     _add_metrics(sub)
+    _add_cache(sub)
+    _add_deflate(sub)
     sub.add_argument("-o", "--out", default=None, help="write output to file")
     sub.add_argument("-b", "--block-payload", default="65280")
+    sub.add_argument("--level", type=int, default=6,
+                     help="zlib level for the host codec path (default 6)")
     sub.add_argument("-i", "--index", action="store_true",
-                     help="also write .blocks/.records sidecars for the output")
+                     help="also write .blocks/.records/.sbi sidecars for "
+                          "the output, built from the packing metadata "
+                          "(no re-read)")
     sub.add_argument("in_path")
     sub.add_argument("out_path")
 
@@ -320,6 +336,7 @@ def build_parser() -> argparse.ArgumentParser:
     _add_remote(sub)
     _add_funnel(sub)
     _add_columnar(sub)
+    _add_deflate(sub)
     sub.add_argument(
         "--serve", default=None, metavar="SPEC",
         help="serving knobs, e.g. 'batch=16,tick=2,plan_queue=64,"
@@ -503,6 +520,11 @@ def main(argv=None) -> int:
 
             ColumnarConfig.parse(args.columnar)  # fail before any work starts
             config = config.replace(columnar=args.columnar)
+        if getattr(args, "deflate", None) is not None:
+            from spark_bam_tpu.compress.config import DeflateConfig
+
+            DeflateConfig.parse(args.deflate)  # fail before any work starts
+            config = config.replace(deflate=args.deflate)
         if getattr(args, "serve", None) is not None:
             from spark_bam_tpu.serve import ServeConfig
 
@@ -674,6 +696,9 @@ def main(argv=None) -> int:
                 args.in_path, args.out_path, p,
                 block_payload=parse_bytes(args.block_payload),
                 reindex=args.index,
+                level=args.level,
+                deflate=config.deflate,
+                config=config,
             )
         elif cmd == "fuzz-decode":
             from spark_bam_tpu.tools.fuzz_decode import run_fuzz

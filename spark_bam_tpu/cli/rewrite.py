@@ -1,32 +1,187 @@
-"""htsjdk-rewrite analog: round-trip a BAM through our writer so record
-starts stop being block-aligned — manufactures adversarial inputs for split
-testing (reference cli/.../rewrite/HTSJDKRewrite.scala:347-418)."""
+"""htsjdk-rewrite analog: a real re-blocking transform.
+
+Round-trips a BAM through our writer so record starts stop being
+block-aligned — the adversarial-input manufacture of the reference
+(cli/.../rewrite/HTSJDKRewrite.scala:347-418) — and, since PR 14, the
+transform half of the system: ``--block-payload`` re-blocks,
+``--deflate`` routes the members through the device compressor
+(compress/), the output lands atomically (core/atomic.py via
+``write_bam_result``), and ``--index`` emits the ``.blocks`` /
+``.records`` / ``.sbi`` sidecars *during* the write — every record
+start and block boundary is known as we pack, so the sidecars cost no
+re-read and the ``.sbi`` (blocks + record starts + a split plan for the
+config's split size) serves warm loads of the output immediately
+(docs/caching.md, the PR 3 cache).
+"""
 
 from __future__ import annotations
 
-from spark_bam_tpu.bam.index_records import index_records
+import os
+from dataclasses import dataclass, field
+
+import numpy as np
+
 from spark_bam_tpu.bam.iterators import RecordStream
-from spark_bam_tpu.bam.writer import write_bam
-from spark_bam_tpu.bgzf.index_blocks import index_blocks
+from spark_bam_tpu.bam.writer import DEFAULT_BLOCK_PAYLOAD, WriteResult, write_bam_result
 from spark_bam_tpu.cli.output import Printer
 from spark_bam_tpu.core.channel import open_channel
+from spark_bam_tpu.core.config import Config
+from spark_bam_tpu.core.pos import Pos
+
+
+@dataclass
+class RewriteResult:
+    count: int = 0
+    bytes_out: int = 0
+    n_blocks: int = 0
+    #: sidecar kind → written path ("blocks" / "records" / "sbi")
+    sidecars: "dict[str, str]" = field(default_factory=dict)
+
+
+def _flat_to_pos(blocks, flats: "list[int]") -> "list[Pos]":
+    """Flat uncompressed offsets → virtual positions, from the writer's
+    own block table (no re-read; the searchsorted half of
+    ``sbi.format.record_starts_to_virtual`` without needing a FlatView).
+    """
+    starts = np.array([m.start for m in blocks], dtype=np.int64)
+    flat0 = np.cumsum([0] + [m.uncompressed_size for m in blocks])[:-1]
+    f = np.asarray(flats, dtype=np.int64)
+    idx = np.searchsorted(flat0, f, side="right") - 1
+    return [
+        Pos(int(starts[i]), int(off))
+        for i, off in zip(idx, f - flat0[idx])
+    ]
+
+
+def _synth_split_plan(blocks, positions: "list[Pos]", splits):
+    """The split plan live resolution would produce, computed from the
+    write-time block table and record starts (sbi/plan.py semantics:
+    first block boundary at/after the split start, then the first record
+    start at/after that block; the first-record fast path mirrors
+    ``load.api._resolve_split_start``)."""
+    from spark_bam_tpu.sbi.format import PLAN_NONE, PLAN_POS, PlanEntry
+
+    block_starts = np.asarray([m.start for m in blocks], dtype=np.int64)
+    # A record at (block, offset) is at/after Pos(b, 0) iff block >= b
+    # (offsets are non-negative), so the record search is one
+    # searchsorted over record block positions.
+    rec_blocks = np.asarray([p.block_pos for p in positions], dtype=np.int64)
+    entries = []
+    first = positions[0] if positions else None
+    for split in splits:
+        if first is not None and split.start <= first.block_pos < split.end:
+            entries.append(PlanEntry(split.start, PLAN_POS, first))
+            continue
+        i = int(np.searchsorted(block_starts, split.start, side="left"))
+        if i >= len(block_starts) or block_starts[i] >= split.end:
+            entries.append(PlanEntry(split.start, PLAN_NONE, None))
+            continue
+        j = int(np.searchsorted(rec_blocks, block_starts[i], side="left"))
+        if j >= len(positions):
+            entries.append(PlanEntry(split.start, PLAN_NONE, None))
+        else:
+            entries.append(PlanEntry(split.start, PLAN_POS, positions[j]))
+    return entries
+
+
+def emit_sidecars(out_path, result: WriteResult, config: Config) -> "dict[str, str]":
+    """``.blocks`` + ``.records`` + ``.sbi`` for a just-written BAM, all
+    from the in-memory :class:`WriteResult` — index-aligned output for
+    free. The ``.sbi`` carries blocks, record starts AND a synthesized
+    split plan for the config's load split size, so a warm load of the
+    rewritten file does zero ``load.split_resolutions``."""
+    from spark_bam_tpu import sbi
+    from spark_bam_tpu.bam.index_records import format_record_line
+    from spark_bam_tpu.bgzf.index_blocks import format_block_line
+    from spark_bam_tpu.load.splits import file_splits
+
+    out_path = str(out_path)
+    positions = _flat_to_pos(result.blocks, result.record_flats)
+    written: dict[str, str] = {}
+
+    def atomic_text(path: str, lines) -> None:
+        tmp = f"{path}.tmp{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                for line in lines:
+                    f.write(line + "\n")
+            os.replace(tmp, path)
+        finally:
+            if os.path.exists(tmp):  # failure path only
+                os.unlink(tmp)
+
+    blocks_path = out_path + ".blocks"
+    atomic_text(blocks_path, (format_block_line(m) for m in result.blocks))
+    written["blocks"] = blocks_path
+    records_path = out_path + ".records"
+    atomic_text(records_path, (format_record_line(p) for p in positions))
+    written["records"] = records_path
+
+    size = config.split_size_or(Config.LOAD_SPLIT_SIZE_DEFAULT)
+    splits = file_splits(out_path, size)
+    virtual = np.array(
+        [(p.block_pos << 16) | p.offset for p in positions], dtype=np.uint64
+    )
+    index = sbi.SbiIndex(
+        sbi.fingerprint_of(out_path, config),
+        blocks=list(result.blocks),
+        split_plans={size: _synth_split_plan(result.blocks, positions, splits)},
+        record_starts=virtual,
+    )
+    store = sbi.CacheStore.from_env(policy=config.fault_policy)
+    sbi_path = store.store(out_path, index)
+    if sbi_path:
+        written["sbi"] = sbi_path
+    return written
+
+
+def rewrite_bam(
+    in_path,
+    out_path,
+    block_payload: int = DEFAULT_BLOCK_PAYLOAD,
+    level: int = 6,
+    deflate: "str | None" = None,
+    index: bool = False,
+    config: Config = Config(),
+) -> RewriteResult:
+    """The transform core (shared by the CLI and the serve ``rewrite``
+    op): stream records out of ``in_path``, re-block + re-compress into
+    ``out_path`` (atomic), optionally emitting sidecars from the packing
+    metadata."""
+    spec = deflate if deflate is not None else config.deflate
+    with open_channel(in_path) as ch:
+        stream = RecordStream.open(ch)
+        result = write_bam_result(
+            out_path, stream.header, stream,
+            block_payload=block_payload, level=level, deflate=spec,
+        )
+    out = RewriteResult(
+        count=result.count, bytes_out=result.bytes_out,
+        n_blocks=len(result.blocks),
+    )
+    if index:
+        out.sidecars = emit_sidecars(out_path, result, config)
+    return out
 
 
 def run(
     in_path,
     out_path,
     p: Printer,
-    block_payload: int = 0xFF00,
+    block_payload: int = DEFAULT_BLOCK_PAYLOAD,
     reindex: bool = False,
+    level: int = 6,
+    deflate: "str | None" = None,
+    config: Config = Config(),
 ) -> None:
-    with open_channel(in_path) as ch:
-        stream = RecordStream.open(ch)
-        header = stream.header
-        count = write_bam(
-            out_path, header, (rec for _, rec in stream), block_payload=block_payload
-        )
-    p.echo(f"Wrote {count} reads to {out_path}")
+    res = rewrite_bam(
+        in_path, out_path,
+        block_payload=block_payload, level=level, deflate=deflate,
+        index=reindex, config=config,
+    )
+    p.echo(f"Wrote {res.count} reads to {out_path}")
     if reindex:
-        _, n_blocks = index_blocks(out_path)
-        _, n_records = index_records(out_path)
-        p.echo(f"Indexed {n_blocks} blocks, {n_records} records")
+        n_records = res.count
+        p.echo(f"Indexed {res.n_blocks} blocks, {n_records} records")
+        if "sbi" in res.sidecars:
+            p.echo(f"Split index: {res.sidecars['sbi']}")

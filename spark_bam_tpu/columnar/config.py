@@ -9,7 +9,14 @@ frozen Config stays hashable and the ``SPARK_BAM_COLUMNAR`` env var and
 ``rows`` is the record-batch row target (frame segmentation — identical
 between the file sink and the serve ``batch`` op so their bytes match),
 ``codec`` compresses the per-column buffers of the native container
-("none" | "zlib"), ``columns`` is a ``+``-separated default projection.
+("none" | "zlib" | "deflate"), ``columns`` is a ``+``-separated default
+projection. ``deflate`` routes buffers through the write-path compressor
+(compress/codec.py ``encode_zlib_stream``: device fixed-Huffman lanes
+when ``SPARK_BAM_DEFLATE`` enables them) as spec-valid zlib streams —
+the read side is unchanged. Literal-only fixed Huffman never beats raw
+on binary planes, so the keep-only-when-smaller rule usually stores
+those buffers uncompressed; the codec exists for write-path parity, not
+ratio (docs/analytics.md).
 """
 
 from __future__ import annotations
@@ -19,7 +26,7 @@ from dataclasses import dataclass
 
 from spark_bam_tpu.columnar.schema import normalize_columns
 
-_CODECS = ("none", "zlib")
+_CODECS = ("none", "zlib", "deflate")
 
 
 @dataclass(frozen=True)

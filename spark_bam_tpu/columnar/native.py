@@ -84,6 +84,14 @@ def _encode_buffer(raw: bytes, codec: str, level: int) -> bytes:
         enc = zlib.compress(raw, level)
         if len(enc) < len(raw):
             return _BUF.pack(len(raw), len(enc)) + enc
+    elif codec == "deflate" and raw:
+        # Write-path compressor (device fixed-Huffman lanes when enabled);
+        # emits a plain zlib stream, so _decode_buffer needs no new code.
+        from spark_bam_tpu.compress.codec import encode_zlib_stream
+
+        enc = encode_zlib_stream(raw)
+        if len(enc) < len(raw):
+            return _BUF.pack(len(raw), len(enc)) + enc
     return _BUF.pack(len(raw), len(raw)) + raw
 
 

@@ -2,8 +2,9 @@
 
 All three write streamingly — one record batch at a time, O(batch) host
 memory — into a same-directory temp file that is atomically renamed
-into place on close (the ``.sbi`` store's tmp+replace discipline), so a
-crashed export never leaves a half-written output at the target path.
+into place on close (``core/atomic.AtomicFile``, the idiom shared with
+``write_bam`` and the rewrite CLI), so a crashed export never leaves a
+half-written output at the target path.
 
 Arrow and Parquet need the optional ``pyarrow`` extra
 (``pip install spark-bam-tpu[arrow]``); the native container has zero
@@ -13,8 +14,7 @@ schema's large-offset layout is exactly ``large_utf8``/``large_binary``.
 
 from __future__ import annotations
 
-import os
-
+from spark_bam_tpu.core.atomic import AtomicFile as _AtomicFile
 from spark_bam_tpu.columnar.native import (
     batch_frame,
     container_head,
@@ -43,30 +43,6 @@ def _pyarrow():
             "'native' format has no dependencies"
         ) from exc
     return pyarrow
-
-
-class _AtomicFile:
-    """Same-directory temp file, ``os.replace``d into place on commit."""
-
-    def __init__(self, out_path: str):
-        self.out_path = str(out_path)
-        self.tmp_path = f"{self.out_path}.tmp.{os.getpid()}"
-        self.f = open(self.tmp_path, "wb")
-
-    def commit(self) -> None:
-        self.f.flush()
-        os.fsync(self.f.fileno())
-        self.f.close()
-        os.replace(self.tmp_path, self.out_path)
-
-    def abort(self) -> None:
-        try:
-            self.f.close()
-        finally:
-            try:
-                os.unlink(self.tmp_path)
-            except OSError:
-                pass
 
 
 class NativeSink:

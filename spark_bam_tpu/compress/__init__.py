@@ -1,0 +1,43 @@
+"""Device-side BGZF compression — the write path's mirror of tpu/inflate.
+
+Layers (jax imports stay out of this package's import path until a
+device codec actually dispatches):
+
+* config.py  — the ``Config.deflate`` / ``SPARK_BAM_DEFLATE`` spec
+* huffman.py — host-reference member builders (the byte authority)
+* kernels.py — batched XLA CRC32 + fixed-Huffman pack (lazy import)
+* codec.py   — the pluggable ``BgzfWriter`` codec family with
+  dispatch/materialize double-buffering and demote-to-host
+
+See docs/design.md, "The write path".
+"""
+
+from spark_bam_tpu.compress.codec import (
+    DeviceDeflateCodec,
+    HostZlibCodec,
+    encode_zlib_stream,
+    make_codec,
+)
+from spark_bam_tpu.compress.config import DeflateConfig
+from spark_bam_tpu.compress.huffman import (
+    MAX_STORED_PAYLOAD,
+    bgzf_member,
+    fixed_member,
+    stored_member,
+    zlib_member,
+    zlib_stream,
+)
+
+__all__ = [
+    "DeflateConfig",
+    "DeviceDeflateCodec",
+    "HostZlibCodec",
+    "MAX_STORED_PAYLOAD",
+    "bgzf_member",
+    "encode_zlib_stream",
+    "fixed_member",
+    "make_codec",
+    "stored_member",
+    "zlib_member",
+    "zlib_stream",
+]

@@ -1,0 +1,322 @@
+"""Block codecs: the pluggable compressor behind ``BgzfWriter``.
+
+A codec turns uncompressed payloads into complete BGZF members. The
+writer drives it through a two-phase ``dispatch``/``materialize`` split
+so a device codec overlaps like the inflate pipeline does: dispatch N
+(async kernel launch) while materializing batch N-1 (D2H + member
+assembly) — real double-buffering, the device never idles on host
+framing and the host never idles on the kernel.
+
+Hardening mirrors tpu/inflate.py:
+
+* per-window demote-to-host on ANY device error — under ``stored`` /
+  ``fixed`` the host reference (compress/huffman.py) is byte-identical,
+  so demotion is invisible in the output; under ``auto`` the escape
+  hatch is host zlib (``zlib_member``, the seed ``compress_block``
+  body) — different bytes, same validity;
+* the demotion warning logs once per codec, every occurrence counts in
+  ``deflate.demotions``;
+* phase attribution (``deflate.pack_ms`` / ``device_ms`` / ``d2h_ms`` /
+  ``host_ms``) lands as gauge + histogram pairs, explicit device syncs
+  only under a live registry (``obs.enabled()``) — the production path
+  keeps the async dispatch.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+from spark_bam_tpu import obs
+from spark_bam_tpu.compress.config import DeflateConfig
+from spark_bam_tpu.compress.huffman import (
+    MAX_STORED_PAYLOAD,
+    bgzf_member,
+    fixed_member,
+    stored_body,
+    stored_member,
+    zlib_member,
+)
+from spark_bam_tpu.core.guard import LimitExceeded
+
+log = logging.getLogger(__name__)
+
+
+def attribute_ms(host_ms=None, pack_ms=None, d2h_ms=None, device_ms=None):
+    """Write-path phase attribution — the inflate side's gauge+histogram
+    convention under the ``deflate.*`` layer. No-op without a registry."""
+    r = obs.registry()
+    if r is None:
+        return
+    for name, v in (("deflate.host_ms", host_ms),
+                    ("deflate.pack_ms", pack_ms),
+                    ("deflate.d2h_ms", d2h_ms),
+                    ("deflate.device_ms", device_ms)):
+        if v is not None:
+            r.gauge(name).set(round(v, 3))
+            r.histogram(name, unit="ms").observe(v)
+
+
+def _check_payloads(payloads) -> None:
+    for p in payloads:
+        if len(p) > MAX_STORED_PAYLOAD:
+            # Truly impossible to emit while guaranteeing a valid member
+            # (even the stored fallback overflows BSIZE) — typed, never a
+            # demotion candidate.
+            raise LimitExceeded(
+                f"{len(p)}-byte payload cannot fit any BGZF member "
+                f"(max {MAX_STORED_PAYLOAD})"
+            )
+
+
+class HostZlibCodec:
+    """mode=off: host ``zlib.compressobj`` per block, the seed path (with
+    the stored-fallback hardening from ``zlib_member``)."""
+
+    lanes = 1
+
+    def __init__(self, level: int = 6):
+        self.level = level
+
+    def dispatch(self, payloads: "list[bytes]") -> "list[bytes]":
+        _check_payloads(payloads)
+        return [zlib_member(p, self.level) for p in payloads]
+
+    def materialize(self, pending: "list[bytes]") -> "list[bytes]":
+        return pending
+
+    def encode_blocks(self, payloads: "list[bytes]") -> "list[bytes]":
+        return self.dispatch(payloads)
+
+
+class _Pending:
+    """One in-flight batch: the payloads (for demotion / stored bodies)
+    plus the un-synced device arrays (None ⇒ host-only batch)."""
+
+    __slots__ = ("payloads", "dev", "t_dispatch")
+
+    def __init__(self, payloads, dev, t_dispatch=0.0):
+        self.payloads = payloads
+        self.dev = dev
+        self.t_dispatch = t_dispatch
+
+
+class DeviceDeflateCodec:
+    """mode=stored|fixed|auto: batched device CRC32 (+ fixed-Huffman
+    pack), lanes payloads per dispatch, host member assembly."""
+
+    def __init__(self, cfg: DeflateConfig):
+        if cfg.mode not in ("stored", "fixed", "auto"):
+            raise ValueError(f"DeviceDeflateCodec cannot serve mode={cfg.mode!r}")
+        self.cfg = cfg
+        self.mode = cfg.mode
+        self.lanes = cfg.lanes
+        self._kernels = None
+        self._device = cfg.device != "off"
+        self._warned = False
+        if cfg.device == "on":
+            self._load_kernels()  # fail loudly now, not mid-write
+
+    # ------------------------------------------------------------ device
+    def _load_kernels(self):
+        if self._kernels is None:
+            from spark_bam_tpu.compress import kernels
+
+            self._kernels = kernels
+        return self._kernels
+
+    def _demote(self, exc: Exception) -> None:
+        obs.count("deflate.demotions")
+        if not self._warned:
+            self._warned = True
+            log.warning(
+                "device deflate unavailable (%s: %s); window demoted to "
+                "host %s — output stays valid%s",
+                type(exc).__name__, exc,
+                "zlib" if self.mode == "auto" else self.mode,
+                "" if self.mode == "auto" else " and byte-identical",
+            )
+        if self.cfg.device == "auto" and self._kernels is None:
+            self._device = False  # import failed: stop retrying per window
+
+    def _host_member(self, payload: bytes) -> bytes:
+        if self.mode == "stored":
+            return stored_member(payload)
+        if self.mode == "fixed":
+            return fixed_member(payload)
+        return zlib_member(payload, self.cfg.level)  # auto's escape hatch
+
+    # ------------------------------------------------------------ phases
+    def dispatch(self, payloads: "list[bytes]") -> _Pending:
+        _check_payloads(payloads)
+        if not payloads or not self._device:
+            return _Pending(payloads, None)
+        t0 = time.perf_counter()
+        try:
+            k = self._load_kernels()
+            import jax.numpy as jnp
+
+            data, lengths, _ = k.pack_lanes(payloads)
+            t1 = time.perf_counter()
+            data_dev = jnp.asarray(data)
+            lengths_dev = jnp.asarray(lengths)
+            if self.mode == "stored":
+                dev = (k.crc32_lanes(data_dev, lengths_dev),)
+            else:
+                dev = k.deflate_fixed_lanes(data_dev, lengths_dev)
+            if obs.enabled():
+                with obs.span("deflate.dispatch", lanes=len(payloads)):
+                    for arr in dev:
+                        arr.block_until_ready()
+                attribute_ms(pack_ms=(t1 - t0) * 1e3,
+                             device_ms=(time.perf_counter() - t1) * 1e3)
+            obs.count("deflate.device_windows")
+        except LimitExceeded:
+            raise
+        except Exception as exc:
+            self._demote(exc)
+            return _Pending(payloads, None)
+        return _Pending(payloads, dev, t0)
+
+    def materialize(self, pending: _Pending) -> "list[bytes]":
+        import numpy as np
+
+        payloads = pending.payloads
+        if not payloads:
+            return []
+        if pending.dev is None:
+            members = [self._host_member(p) for p in payloads]
+        else:
+            t0 = time.perf_counter()
+            try:
+                host = [np.asarray(a) for a in pending.dev]
+            except Exception as exc:
+                self._demote(exc)
+                members = [self._host_member(p) for p in payloads]
+            else:
+                t1 = time.perf_counter()
+                members = self._assemble(payloads, host)
+                if obs.enabled():
+                    attribute_ms(d2h_ms=(t1 - t0) * 1e3,
+                                 host_ms=(time.perf_counter() - t1) * 1e3)
+        obs.count("compress.members", len(payloads))
+        obs.count("compress.batches")
+        obs.count("compress.bytes_in", sum(len(p) for p in payloads))
+        obs.count("compress.bytes_out", sum(len(m) for m in members))
+        return members
+
+    def _assemble(self, payloads, host) -> "list[bytes]":
+        """Device results → members; per-lane pick-smaller under fixed.
+        Same policy as ``huffman.fixed_member`` so a demoted window is
+        byte-identical (stored/fixed modes)."""
+        members = []
+        stored_n = fixed_n = 0
+        if self.mode == "stored":
+            (crc,) = host
+            for i, p in enumerate(payloads):
+                members.append(stored_member(p, crc=int(crc[i])))
+            stored_n = len(payloads)
+        else:
+            packed, total_bits, crc = host
+            for i, p in enumerate(payloads):
+                nbytes = (int(total_bits[i]) + 7) // 8
+                if nbytes >= len(p) + 5:
+                    members.append(
+                        bgzf_member(stored_body(p), int(crc[i]), len(p))
+                    )
+                    stored_n += 1
+                else:
+                    members.append(
+                        bgzf_member(
+                            packed[i, :nbytes].tobytes(), int(crc[i]), len(p)
+                        )
+                    )
+                    fixed_n += 1
+        if stored_n:
+            obs.count("compress.stored", stored_n)
+        if fixed_n:
+            obs.count("compress.fixed", fixed_n)
+        return members
+
+    def encode_blocks(self, payloads: "list[bytes]") -> "list[bytes]":
+        return self.materialize(self.dispatch(payloads))
+
+
+def encode_zlib_stream(raw: bytes, spec: "str | None" = None) -> bytes:
+    """Zlib-stream encoder for the columnar container's ``codec=deflate``
+    buffers (columnar/native.py ``_encode_buffer``): multi-block
+    fixed-Huffman DEFLATE wrapped per RFC 1950, device-packed when the
+    deflate spec (``spec`` or ``SPARK_BAM_DEFLATE``) enables the device,
+    host :func:`huffman.zlib_stream` otherwise. The two paths are
+    byte-identical — kernel parity plus the shared bit stitcher — so the
+    container stays deterministic across environments. A lane whose
+    fixed stream overflows the kernel's output stride (mostly ≥144
+    bytes) is re-packed on host; demotion of the whole call follows the
+    codec's demote-to-host rule."""
+    import os
+
+    from spark_bam_tpu.compress.huffman import (
+        fixed_stream_bits,
+        zlib_stream,
+    )
+
+    if spec is None:
+        spec = os.environ.get("SPARK_BAM_DEFLATE", "")
+    cfg = DeflateConfig.parse(spec)
+    if not cfg.enabled or cfg.device == "off":
+        return zlib_stream(raw)
+    import struct
+    import zlib as _zlib
+
+    import numpy as np
+
+    try:
+        from spark_bam_tpu.compress import kernels as k
+        import jax.numpy as jnp
+
+        window = MAX_STORED_PAYLOAD
+        mv = memoryview(raw)
+        nwin = max(1, (len(mv) + window - 1) // window)
+        chunks = [bytes(mv[i * window:(i + 1) * window]) for i in range(nwin)]
+        data, lengths, _ = k.pack_lanes(chunks)
+        packed, total_bits, _crc = k.deflate_fixed_lanes(
+            jnp.asarray(data), jnp.asarray(lengths)
+        )
+        packed = np.asarray(packed)
+        total_bits = np.asarray(total_bits)
+        obs.count("deflate.device_windows", nwin)
+    except LimitExceeded:
+        raise
+    except Exception:
+        return zlib_stream(raw)
+    bit_arrays = []
+    for i, chunk in enumerate(chunks):
+        tb = int(total_bits[i])
+        if tb > k.OUT_BYTES * 8:
+            # Kernel output stride overflow (incompressible window):
+            # host re-pack, same bytes by construction.
+            bit_arrays.append(fixed_stream_bits(chunk, final=i == nwin - 1))
+        else:
+            bit_arrays.append(fixed_stream_bits(
+                chunk, final=i == nwin - 1,
+                packed=packed[i, :(tb + 7) // 8].tobytes(), total_bits=tb,
+            ))
+    body = np.packbits(np.concatenate(bit_arrays), bitorder="little").tobytes()
+    return (
+        b"\x78\x01" + body
+        + struct.pack(">I", _zlib.adler32(raw) & 0xFFFFFFFF)
+    )
+
+
+def make_codec(cfg: "DeflateConfig | str | None", level: "int | None" = None):
+    """The codec for a deflate spec/config; ``None``/"" /mode=off ⇒ host
+    zlib at ``level`` (the seed write path)."""
+    if cfg is None:
+        cfg = DeflateConfig()
+    elif isinstance(cfg, str):
+        cfg = DeflateConfig.parse(cfg)
+    if level is not None and level != cfg.level:
+        cfg = DeflateConfig(cfg.mode, level, cfg.lanes, cfg.device)
+    if not cfg.enabled:
+        return HostZlibCodec(cfg.level)
+    return DeviceDeflateCodec(cfg)

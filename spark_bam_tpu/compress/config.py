@@ -1,0 +1,104 @@
+"""Write-path knobs: the ``Config.deflate`` string spec.
+
+Same compact-spec pattern as ``faults``/``columnar``/``serve`` so the
+frozen Config stays hashable and the ``SPARK_BAM_DEFLATE`` env var and
+``--deflate`` CLI flag work through the existing plumbing:
+
+    mode=fixed,level=6,lanes=32,device=auto
+
+``mode`` picks the block codec every BGZF member goes through:
+
+* ``off``    — host ``zlib.compressobj`` (dynamic Huffman), the seed
+  behavior; ``level`` is its compression level.
+* ``stored`` — stored-block members (BTYPE=00): no entropy coding, just
+  framing + CRC32, the fully parallel stage-1 codec.
+* ``fixed``  — fixed-Huffman literal-only DEFLATE (BTYPE=01), picking
+  the smaller of {fixed, stored} per block the way zlib does.
+* ``auto``   — ``fixed`` while the device path is healthy; any device
+  error demotes that window to host ``zlib`` (``compress_block``), the
+  inflate side's demote-to-host policy mirrored.
+
+``stored``/``fixed`` are *deterministic* codecs: the host reference in
+compress/huffman.py produces byte-identical members, so ``device=off``
+(or a runtime demotion under those modes) changes nothing but speed.
+``lanes`` is the payload batch per device dispatch (the (B, 64 KiB)
+kernel geometry); ``device`` force-enables/disables the jax path.
+Dynamic Huffman stays a documented non-goal (docs/design.md).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+MODES = ("off", "stored", "fixed", "auto")
+DEVICE = ("on", "off", "auto")
+
+
+@dataclass(frozen=True)
+class DeflateConfig:
+    mode: str = "off"
+    level: int = 6
+    lanes: int = 16
+    device: str = "auto"
+
+    @property
+    def enabled(self) -> bool:
+        """True when writes go through the compress/ codec family at all."""
+        return self.mode != "off"
+
+    @property
+    def deterministic(self) -> bool:
+        """True when output bytes are independent of where they were
+        computed (stored/fixed have a byte-identical host reference)."""
+        return self.mode in ("stored", "fixed")
+
+    @staticmethod
+    @functools.lru_cache(maxsize=64)
+    def parse(spec: str) -> "DeflateConfig":
+        """Parse a ``mode=...,level=...,lanes=...,device=...`` spec ("" ⇒
+        defaults, i.e. the host zlib path). Raises ``ValueError`` on
+        unknown keys/values — the CLI validates before any work starts,
+        like every other knob."""
+        kw: dict = {}
+        for part in (spec or "").split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if "=" not in part:
+                # Bare token shorthand: "--deflate fixed" reads naturally.
+                if part in MODES:
+                    kw["mode"] = part
+                    continue
+                raise ValueError(
+                    f"Bad deflate spec {spec!r}: {part!r} is not key=value"
+                )
+            key, value = part.split("=", 1)
+            key, value = key.strip(), value.strip()
+            if key == "mode":
+                if value not in MODES:
+                    raise ValueError(
+                        f"Bad deflate mode {value!r}: expected "
+                        f"{' | '.join(MODES)}"
+                    )
+                kw["mode"] = value
+            elif key == "level":
+                level = int(value)
+                if not 0 <= level <= 9:
+                    raise ValueError(f"deflate level must be 0..9: {value}")
+                kw["level"] = level
+            elif key == "lanes":
+                lanes = int(value)
+                if lanes <= 0:
+                    raise ValueError(f"deflate lanes must be positive: {value}")
+                kw["lanes"] = lanes
+            elif key == "device":
+                if value not in DEVICE:
+                    raise ValueError(
+                        f"Bad deflate device {value!r}: expected "
+                        f"{' | '.join(DEVICE)}"
+                    )
+                kw["device"] = value
+            else:
+                raise ValueError(f"Unknown deflate key {key!r} in {spec!r}")
+        return DeflateConfig(**kw)

@@ -1,0 +1,207 @@
+"""Host-reference BGZF member builders: stored, fixed-Huffman, zlib.
+
+This module is the *byte authority* for the write path. The device
+kernels in compress/kernels.py reproduce ``fixed_pack`` / ``crc32``
+bit-for-bit (same bit layout, same zero padding), so a runtime demotion
+from device to host under ``mode=stored|fixed`` changes nothing but
+speed — the demote-to-host parity property tests/test_deflate.py pins.
+
+Framing recap (every builder returns one complete BGZF member):
+
+    18-byte gzip header (FEXTRA "BC" subfield carrying BSIZE)
+    raw-DEFLATE body
+    8-byte footer: CRC32(payload), ISIZE = len(payload)
+
+with ``BSIZE = total member size - 1`` a u16 — the format's hard 64 KiB
+member bound. A *stored* body is ``\\x01 LEN NLEN payload`` (5 bytes of
+framing), so any payload up to :data:`MAX_STORED_PAYLOAD` always fits
+regardless of entropy; that makes stored the universal fallback when
+zlib output or fixed-Huffman output would overflow BSIZE.
+
+Fixed-Huffman here is literal-only (no LZ77 match search): each byte
+costs 8 bits (0–143) or 9 bits (144–255) plus a 3-bit block header and
+a 7-bit end-of-block code. Huffman codes are written MSB-first into
+DEFLATE's LSB-first bitstream, so the tables below store *bit-reversed*
+codes and every writer emits them LSB-first. Dynamic Huffman is a
+documented non-goal for this subsystem (docs/design.md, write path).
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+
+import numpy as np
+
+from spark_bam_tpu.core.guard import LimitExceeded
+
+#: Largest payload a stored-block member can carry:
+#: 18 (header) + 5 (stored framing) + payload + 8 (footer) ≤ 65536.
+MAX_STORED_PAYLOAD = 0x10000 - 18 - 5 - 8
+
+_HEADER_PREFIX = (
+    b"\x1f\x8b\x08\x04"        # gzip magic, deflate, FEXTRA
+    b"\x00\x00\x00\x00"        # mtime
+    b"\x00\xff"                # XFL, OS
+    b"\x06\x00"                # XLEN = 6
+    b"BC\x02\x00"              # BC subfield
+)
+
+
+def _fixed_tables() -> "tuple[np.ndarray, np.ndarray]":
+    """(nbits[256] u8, bit-reversed code[256] u16) for the RFC 1951 fixed
+    literal alphabet restricted to byte values (we never emit matches)."""
+    nbits = np.where(np.arange(256) < 144, 8, 9).astype(np.uint8)
+    rcode = np.empty(256, dtype=np.uint16)
+    for b in range(256):
+        code = 0x30 + b if b < 144 else 0x190 + (b - 144)
+        n = int(nbits[b])
+        rev = 0
+        for _ in range(n):
+            rev = (rev << 1) | (code & 1)
+            code >>= 1
+        rcode[b] = rev
+    return nbits, rcode
+
+
+NBITS, RCODE = _fixed_tables()
+
+
+def bgzf_member(body: bytes, crc: int, isize: int) -> bytes:
+    """Frame a raw-DEFLATE body into one BGZF member."""
+    bsize = 18 + len(body) + 8
+    if bsize > 0x10000:
+        raise LimitExceeded(
+            f"BGZF member would be {bsize} bytes; the BSIZE field caps "
+            f"members at 65536 (body {len(body)}B)"
+        )
+    return (
+        _HEADER_PREFIX
+        + struct.pack("<H", bsize - 1)
+        + body
+        + struct.pack("<II", crc & 0xFFFFFFFF, isize)
+    )
+
+
+def stored_body(payload: bytes) -> bytes:
+    """Final stored DEFLATE block: BFINAL=1/BTYPE=00 header byte, then
+    LEN/NLEN and the raw bytes."""
+    n = len(payload)
+    return b"\x01" + struct.pack("<HH", n, n ^ 0xFFFF) + payload
+
+
+def stored_member(payload: bytes, crc: "int | None" = None) -> bytes:
+    """One stored-block BGZF member — the entropy-free universal format.
+    ``crc`` lets a device batch supply the already-computed CRC32."""
+    if len(payload) > MAX_STORED_PAYLOAD:
+        raise LimitExceeded(
+            f"{len(payload)}-byte payload cannot fit a stored BGZF member "
+            f"(max {MAX_STORED_PAYLOAD})"
+        )
+    if crc is None:
+        crc = zlib.crc32(payload)
+    return bgzf_member(stored_body(payload), crc, len(payload))
+
+
+def fixed_pack(payload: bytes) -> "tuple[bytes, int]":
+    """Literal-only fixed-Huffman DEFLATE body for ``payload``; returns
+    ``(packed_bytes, total_bits)``. Bit layout (LSB-first within bytes):
+    3 header bits (BFINAL=1, BTYPE=01 → 1,1,0), then each byte's
+    bit-reversed code, then the 7-bit all-zero end-of-block code; the
+    final partial byte is zero-padded. The device kernel reproduces this
+    layout exactly (scatter-add of set bits into a zero buffer)."""
+    arr = np.frombuffer(payload, dtype=np.uint8)
+    nb = NBITS[arr].astype(np.int64)
+    total = 3 + int(nb.sum()) + 7
+    bits = np.zeros(total, dtype=np.uint8)
+    bits[0] = 1
+    bits[1] = 1  # BTYPE=01, LSB first: 1 then 0 (bits[2] stays 0)
+    if len(arr):
+        pos = 3 + np.cumsum(nb) - nb
+        span = np.arange(9)
+        idx = pos[:, None] + span[None, :]
+        sel = span[None, :] < nb[:, None]
+        vals = (RCODE[arr][:, None].astype(np.int64) >> span[None, :]) & 1
+        bits[idx[sel]] = vals[sel]
+    # EOB = 7 zero bits: already zero, only accounted in ``total``.
+    return np.packbits(bits, bitorder="little").tobytes(), total
+
+
+def fixed_member(
+    payload: bytes,
+    crc: "int | None" = None,
+    packed: "bytes | None" = None,
+) -> bytes:
+    """One fixed-Huffman BGZF member, demoting to stored when stored is
+    no larger (zlib's own pick-smaller policy; high-entropy payloads
+    cost 9 bits/byte under the fixed alphabet). ``packed`` lets a device
+    batch supply the already-packed body."""
+    if len(payload) > MAX_STORED_PAYLOAD:
+        raise LimitExceeded(
+            f"{len(payload)}-byte payload cannot fit a stored BGZF member "
+            f"(max {MAX_STORED_PAYLOAD})"
+        )
+    if packed is None:
+        packed, _ = fixed_pack(payload)
+    if crc is None:
+        crc = zlib.crc32(payload)
+    if len(packed) >= len(payload) + 5:
+        return bgzf_member(stored_body(payload), crc, len(payload))
+    return bgzf_member(packed, crc, len(payload))
+
+
+def fixed_stream_bits(
+    payload: bytes,
+    final: bool,
+    packed: "bytes | None" = None,
+    total_bits: "int | None" = None,
+) -> np.ndarray:
+    """One fixed-Huffman DEFLATE block as a u8 bit array (LSB-first
+    order), BFINAL set per ``final`` — the stitching unit for multi-block
+    streams. ``packed``/``total_bits`` let a device batch supply the
+    already-packed body (:func:`fixed_pack` layout, BFINAL=1); the bit
+    is rewritten here, so device and host chunks stitch identically."""
+    if packed is None:
+        packed, total_bits = fixed_pack(payload)
+    bits = np.unpackbits(
+        np.frombuffer(packed, dtype=np.uint8), bitorder="little"
+    )[:total_bits].copy()
+    bits[0] = 1 if final else 0
+    return bits
+
+
+def zlib_stream(payload: bytes, window: int = MAX_STORED_PAYLOAD) -> bytes:
+    """A spec-valid RFC 1950 zlib stream over ``payload``: ``0x78 0x01``
+    header, one literal-only fixed-Huffman DEFLATE block per ``window``
+    bytes (BFINAL only on the last), Adler-32 trailer. This is the
+    columnar container's ``codec=deflate`` buffer encoding —
+    ``zlib.decompress`` reads it unchanged, so the read side needs no new
+    code. Fixed blocks have no BSIZE cap, so any payload length works;
+    windowing exists only to match the device kernel's lane stride."""
+    mv = memoryview(payload)
+    nwin = max(1, (len(mv) + window - 1) // window)
+    bits = np.concatenate([
+        fixed_stream_bits(bytes(mv[i * window:(i + 1) * window]),
+                          final=(i == nwin - 1))
+        for i in range(nwin)
+    ])
+    body = np.packbits(bits, bitorder="little").tobytes()
+    return (
+        b"\x78\x01" + body
+        + struct.pack(">I", zlib.adler32(payload) & 0xFFFFFFFF)
+    )
+
+
+def zlib_member(payload: bytes, level: int = 6) -> bytes:
+    """One BGZF member via host zlib (dynamic Huffman) — the seed
+    ``compress_block`` behavior plus the stored fallback: an
+    incompressible payload whose zlib output overflows BSIZE demotes to
+    a stored member (bounded 5-byte expansion, always fits up to
+    :data:`MAX_STORED_PAYLOAD`); only a payload too big even for stored
+    is a true :class:`LimitExceeded`."""
+    compressor = zlib.compressobj(level, zlib.DEFLATED, -15)
+    comp = compressor.compress(payload) + compressor.flush()
+    crc = zlib.crc32(payload)
+    if 18 + len(comp) + 8 > 0x10000:
+        return stored_member(payload, crc)
+    return bgzf_member(comp, crc, len(payload))

@@ -1,0 +1,38 @@
+"""Atomic file writes: same-directory temp + fsync + ``os.replace``.
+
+Promoted from the columnar sink so every writer in the package — export
+sinks, ``write_bam``, the rewrite CLI — shares one crash-safety idiom: a
+crashed write never leaves a half-written file at the target path (for
+BAM, that would be a truncated file with no EOF sentinel that readers
+would trust). The temp name is pid-suffixed so concurrent writers to
+the same target cannot interleave; the loser of the final ``os.replace``
+race simply overwrites the winner with an equally complete file.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+class AtomicFile:
+    """Same-directory temp file, ``os.replace``d into place on commit."""
+
+    def __init__(self, out_path: str):
+        self.out_path = str(out_path)
+        self.tmp_path = f"{self.out_path}.tmp.{os.getpid()}"
+        self.f = open(self.tmp_path, "wb")
+
+    def commit(self) -> None:
+        self.f.flush()
+        os.fsync(self.f.fileno())
+        self.f.close()
+        os.replace(self.tmp_path, self.out_path)
+
+    def abort(self) -> None:
+        try:
+            self.f.close()
+        finally:
+            try:
+                os.unlink(self.tmp_path)
+            except OSError:
+                pass

@@ -139,6 +139,14 @@ class Config:
     # counts, native-container compression, and the default projection
     # for the export sinks and the serve ``batch`` op.
     columnar: str = ""
+    # --- write-path compression (compress/; docs/design.md) ---
+    # Compact DeflateConfig spec ("mode=fixed,level=6,lanes=16,
+    # device=auto"; "" = defaults: host zlib). Same string-spec pattern;
+    # ``deflate_config`` parses it (cached). Governs the block codec
+    # behind write_bam/htsjdk-rewrite/the serve ``rewrite`` op: stored /
+    # fixed-Huffman members batch-compressed on device with per-window
+    # demote-to-host, or the seed host-zlib path when off.
+    deflate: str = ""
     # --- serve fabric control plane (fabric/; docs/fabric.md) ---
     # Compact FabricConfig spec ("workers=3,slo=200,probe=500,spill=8";
     # "" = defaults). Same string-spec pattern; ``fabric_config`` parses
@@ -225,6 +233,13 @@ class Config:
         from spark_bam_tpu.columnar.config import ColumnarConfig
 
         return ColumnarConfig.parse(self.columnar)
+
+    @property
+    def deflate_config(self):
+        """The parsed ``DeflateConfig`` for this config's ``deflate`` spec."""
+        from spark_bam_tpu.compress.config import DeflateConfig
+
+        return DeflateConfig.parse(self.deflate)
 
     @property
     def fabric_config(self):

@@ -22,9 +22,9 @@ from __future__ import annotations
 #: Layer prefixes (the segment before the first dot). A new layer means
 #: a new subsystem — add it here alongside its names.
 LAYERS = frozenset({
-    "bgzf", "cache", "chaos", "check", "cli", "columnar", "fabric",
-    "faults", "funnel", "guard", "inflate", "load", "mesh", "progress",
-    "remote", "serve", "timer",
+    "bgzf", "cache", "chaos", "check", "cli", "columnar", "compress",
+    "deflate", "fabric", "faults", "funnel", "guard", "inflate", "load",
+    "mesh", "progress", "remote", "serve", "timer",
 })
 
 NAMES = frozenset({
@@ -52,6 +52,13 @@ NAMES = frozenset({
     # columnar — record-batch analytics plane (docs/analytics.md)
     "columnar.build_ms", "columnar.bytes_out", "columnar.encode_ms",
     "columnar.export", "columnar.rows",
+    # compress — write-path member/batch ledger (docs/design.md)
+    "compress.batches", "compress.bytes_in", "compress.bytes_out",
+    "compress.fixed", "compress.members", "compress.stored",
+    # deflate — device-side BGZF compression (docs/design.md, write path)
+    "deflate.d2h_ms", "deflate.demotions", "deflate.device_ms",
+    "deflate.device_windows", "deflate.dispatch", "deflate.host_ms",
+    "deflate.pack_ms",
     # fabric — control plane (docs/fabric.md); fabric.<counter> names are
     # emitted through Router._count's bounded literal set
     "fabric.relay", "fabric.autoscale_moves", "fabric.drained",
@@ -91,8 +98,8 @@ NAMES = frozenset({
     "serve.batch_encode", "serve.batch_rows", "serve.batches",
     "serve.connections", "serve.device_dispatch", "serve.latency_ms",
     "serve.overloaded", "serve.parse", "serve.queue_depth", "serve.queue_ms",
-    "serve.request", "serve.requests", "serve.shed", "serve.tick",
-    "serve.tuned",
+    "serve.request", "serve.requests", "serve.rewrite", "serve.shed",
+    "serve.tick", "serve.tuned",
 })
 
 

@@ -1,8 +1,8 @@
 """Admission control: bounded per-class inflight limits with load shedding.
 
 Two request classes share the daemon: *plan* (split plans, record-start
-indexes — bursty, index-bound) and *scan* (count verdicts, fleet loads —
-device-bound). Each has its own inflight cap so a flood of one class
+indexes — bursty, index-bound) and *scan* (count verdicts, fleet loads,
+rewrites — device-bound). Each has its own inflight cap so a flood of one class
 cannot starve the other. Over-limit arrivals are rejected synchronously
 with :class:`Overloaded` carrying a Retry-After hint derived from the
 observed service-latency median (``FaultPolicy.LatencyTracker``).
@@ -21,6 +21,7 @@ CLASS_OF = {
     "count": "scan",
     "fleet": "scan",
     "batch": "scan",
+    "rewrite": "scan",
 }
 
 

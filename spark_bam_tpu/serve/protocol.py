@@ -40,7 +40,7 @@ import json
 
 #: ops answered by the service; anything else is a ProtocolError.
 OPS = ("ping", "stats", "plan", "record_starts", "count", "fleet", "batch",
-       "drain", "tune", "telemetry")
+       "rewrite", "drain", "tune", "telemetry")
 
 
 class ProtocolError(ValueError):

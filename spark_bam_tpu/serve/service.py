@@ -473,6 +473,46 @@ class SplitService:
             total += int(count)
         return {"paths": counts, "total": total}
 
+    def _handle_rewrite(self, req: dict, deadline_ts) -> dict:
+        """Re-block + re-compress ``path`` into ``out`` through the write
+        path (cli/rewrite.py): the device compressor when the service
+        config (or the request's ``deflate`` spec) enables it, sidecars
+        emitted during the write when ``index`` is set. Scan-class: the
+        compressor competes with count/fleet for the device, so it shares
+        their inflight cap."""
+        from spark_bam_tpu.cli.rewrite import rewrite_bam
+        from spark_bam_tpu.compress.config import DeflateConfig
+
+        path = req["path"]
+        out = req.get("out")
+        if not out:
+            raise ServiceError("ProtocolError", "rewrite needs an 'out' path")
+        deflate = req.get("deflate")
+        if deflate is not None:
+            try:
+                DeflateConfig.parse(deflate)
+            except ValueError as exc:
+                raise ServiceError("ProtocolError", str(exc)) from exc
+        try:
+            block_payload = int(req.get("block_payload") or 0xFF00)
+            level = int(req.get("level") or 6)
+        except (TypeError, ValueError) as exc:
+            raise ServiceError("ProtocolError", str(exc)) from exc
+        with obs.span("serve.rewrite", path=str(path)):
+            res = rewrite_bam(
+                path, out,
+                block_payload=block_payload, level=level, deflate=deflate,
+                index=bool(req.get("index")), config=self.config,
+            )
+        return {
+            "path": str(path),
+            "out": str(out),
+            "count": res.count,
+            "n_blocks": res.n_blocks,
+            "bytes_out": res.bytes_out,
+            "sidecars": dict(res.sidecars),
+        }
+
     def _handle_batch(self, req: dict, deadline_ts) -> dict:
         """Columnar record batches for a (possibly interval/flag-filtered)
         file, staged as native-container frames (columnar/native.py) for

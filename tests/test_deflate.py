@@ -1,0 +1,489 @@
+"""Device-side BGZF compression (spark_bam_tpu/compress/): member
+builders, kernel/host byte parity, codec demotion, writer round-trips,
+rewrite sidecars + warm loads, the serve ``rewrite`` op, the columnar
+``deflate`` codec, and fuzz-consumer cleanliness on device-written
+files. docs/design.md, "The write path"."""
+
+import os
+import struct
+import zlib
+
+import numpy as np
+import pytest
+
+from spark_bam_tpu import obs
+from spark_bam_tpu.bam.iterators import RecordStream
+from spark_bam_tpu.bam.writer import (
+    BGZF_EOF,
+    compress_block,
+    write_bam_result,
+)
+from spark_bam_tpu.bgzf.stream import MetadataStream
+from spark_bam_tpu.compress.codec import (
+    DeviceDeflateCodec,
+    HostZlibCodec,
+    encode_zlib_stream,
+    make_codec,
+)
+from spark_bam_tpu.compress.config import DeflateConfig
+from spark_bam_tpu.compress.huffman import (
+    MAX_STORED_PAYLOAD,
+    fixed_member,
+    fixed_pack,
+    stored_member,
+    zlib_member,
+    zlib_stream,
+)
+from spark_bam_tpu.core.channel import open_channel
+from spark_bam_tpu.core.config import Config
+from spark_bam_tpu.core.guard import LimitExceeded
+from tests.bam_factories import random_bam
+
+pytestmark = pytest.mark.deflate
+
+RNG = np.random.default_rng(0xDEF1A7E)
+
+#: Shared by every payload-level test: empty, tiny, text (every byte
+#: <144 — the fixed alphabet's 8-bit half), binary (9-bit bytes mixed
+#: in), and both sides of the stored-member boundary.
+PAYLOADS = {
+    "empty": b"",
+    "one": b"\x00",
+    "text": bytes(RNG.integers(32, 127, 5000, dtype=np.uint8)),
+    "binary": RNG.integers(0, 256, 4000, dtype=np.uint8).tobytes(),
+    "runs": b"ACGT" * 4000,
+    "boundary": RNG.integers(0, 256, MAX_STORED_PAYLOAD,
+                             dtype=np.uint8).tobytes(),
+}
+
+
+def gunzip_member(member: bytes) -> bytes:
+    """Decode one complete BGZF member with stdlib zlib (the external
+    referee — never our own reader)."""
+    d = zlib.decompressobj(31)
+    out = d.decompress(member)
+    assert d.eof and not d.unconsumed_tail
+    return out
+
+
+def member_fields(member: bytes):
+    """(BSIZE+1, CRC32, ISIZE) from the BGZF framing."""
+    bsize = struct.unpack("<H", member[16:18])[0] + 1
+    crc, isize = struct.unpack("<II", member[-8:])
+    return bsize, crc, isize
+
+
+@pytest.fixture(scope="module")
+def src_bam(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("deflate") / "src.bam")
+    random_bam(path, seed=77, n_records=(400, 401))
+    return path
+
+
+def read_back(path):
+    """(header_text, [(Pos, encoded_record)]) via our own reader."""
+    with open_channel(path) as ch:
+        rs = RecordStream.open(ch)
+        return rs.header.text, [(pos, rec.encode()) for pos, rec in rs]
+
+
+# -------------------------------------------------------------- config
+
+
+def test_deflate_config_parse():
+    cfg = DeflateConfig.parse("mode=fixed,level=4,lanes=8,device=off")
+    assert (cfg.mode, cfg.level, cfg.lanes, cfg.device) == (
+        "fixed", 4, 8, "off")
+    assert DeflateConfig.parse("stored").mode == "stored"
+    assert DeflateConfig.parse("").mode == "off"
+    assert not DeflateConfig.parse("").enabled
+    assert DeflateConfig.parse("mode=stored").deterministic
+    assert not DeflateConfig.parse("mode=auto").deterministic
+    for bad in ("mode=lz77", "level=10", "lanes=0", "device=maybe",
+                "nope=1"):
+        with pytest.raises(ValueError):
+            DeflateConfig.parse(bad)
+
+
+def test_deflate_env_reaches_config(monkeypatch):
+    monkeypatch.setenv("SPARK_BAM_DEFLATE", "mode=stored,lanes=4")
+    cfg = Config.from_env()
+    assert cfg.deflate == "mode=stored,lanes=4"
+    assert cfg.deflate_config.mode == "stored"
+    assert cfg.deflate_config.lanes == 4
+
+
+# ------------------------------------------------------ member builders
+
+
+@pytest.mark.parametrize("name", list(PAYLOADS))
+def test_stored_member_roundtrip(name):
+    p = PAYLOADS[name]
+    m = stored_member(p)
+    assert gunzip_member(m) == p
+    bsize, crc, isize = member_fields(m)
+    assert bsize == len(m)
+    assert crc == zlib.crc32(p)
+    assert isize == len(p)
+
+
+@pytest.mark.parametrize("name", list(PAYLOADS))
+def test_fixed_member_roundtrip(name):
+    p = PAYLOADS[name]
+    m = fixed_member(p)
+    assert gunzip_member(m) == p
+    _, crc, isize = member_fields(m)
+    assert crc == zlib.crc32(p)
+    assert isize == len(p)
+
+
+@pytest.mark.parametrize("name", list(PAYLOADS))
+def test_fixed_pack_is_valid_deflate(name):
+    p = PAYLOADS[name]
+    packed, total_bits = fixed_pack(p)
+    assert len(packed) == (total_bits + 7) // 8
+    assert zlib.decompress(packed, wbits=-15) == p
+
+
+def test_fixed_wins_on_text_stored_on_binary():
+    # Every text byte is an 8-bit code, so fixed beats stored's 5-byte
+    # framing on any text payload past ~40 bytes; high-entropy binary
+    # mixes in 9-bit codes and stored wins — zlib's own policy.
+    text, binary = PAYLOADS["text"], PAYLOADS["boundary"]
+    assert len(fixed_member(text)) < len(stored_member(text))
+    assert fixed_member(binary) == stored_member(binary)
+
+
+def test_member_size_limits():
+    over = b"x" * (MAX_STORED_PAYLOAD + 1)
+    for builder in (stored_member, fixed_member):
+        with pytest.raises(LimitExceeded):
+            builder(over)
+    # compress_block's zlib body may still fit an oversize-but-
+    # compressible payload; only one that needs the stored fallback is a
+    # true LimitExceeded.
+    incompressible = RNG.integers(
+        0, 256, MAX_STORED_PAYLOAD + 1, dtype=np.uint8).tobytes()
+    with pytest.raises(LimitExceeded):
+        compress_block(incompressible)
+
+
+def test_compress_block_stored_fallback_exactly_fits():
+    # Incompressible max-size payload: zlib output would overflow BSIZE;
+    # the stored fallback lands on the format's exact 64 KiB ceiling.
+    p = PAYLOADS["boundary"]
+    m = compress_block(p)
+    assert len(m) == 0x10000
+    assert member_fields(m)[0] == 0x10000  # BSIZE field is 0xFFFF
+    assert gunzip_member(m) == p
+    # A compressible payload still takes the zlib body.
+    assert compress_block(b"a" * 1000) == zlib_member(b"a" * 1000)
+
+
+# ------------------------------------------------------- device kernels
+
+
+def test_kernel_crc32_parity():
+    from spark_bam_tpu.compress import kernels as k
+    import jax.numpy as jnp
+
+    payloads = [PAYLOADS["text"], b"", PAYLOADS["binary"],
+                PAYLOADS["boundary"]]
+    data, lengths, _ = k.pack_lanes(payloads)
+    crc = np.asarray(k.crc32_lanes(jnp.asarray(data), jnp.asarray(lengths)))
+    for i, p in enumerate(payloads):
+        assert int(crc[i]) == zlib.crc32(p), f"lane {i}"
+
+
+def test_kernel_fixed_pack_parity():
+    from spark_bam_tpu.compress import kernels as k
+    import jax.numpy as jnp
+
+    payloads = [PAYLOADS["text"], PAYLOADS["runs"], b"", b"\xff" * 1000]
+    data, lengths, _ = k.pack_lanes(payloads)
+    packed, total_bits, crc = k.deflate_fixed_lanes(
+        jnp.asarray(data), jnp.asarray(lengths))
+    packed, total_bits = np.asarray(packed), np.asarray(total_bits)
+    for i, p in enumerate(payloads):
+        want, want_bits = fixed_pack(p)
+        assert int(total_bits[i]) == want_bits, f"lane {i}"
+        assert packed[i, : len(want)].tobytes() == want, f"lane {i}"
+        assert int(np.asarray(crc)[i]) == zlib.crc32(p)
+
+
+# -------------------------------------------------------------- codecs
+
+
+def test_make_codec_selection():
+    assert isinstance(make_codec(None), HostZlibCodec)
+    assert isinstance(make_codec(""), HostZlibCodec)
+    assert isinstance(make_codec("mode=off"), HostZlibCodec)
+    assert isinstance(make_codec("mode=stored"), DeviceDeflateCodec)
+    assert make_codec("mode=off", level=1).level == 1
+
+
+@pytest.mark.parametrize("mode", ["stored", "fixed", "auto"])
+def test_codec_members_decode(mode):
+    codec = DeviceDeflateCodec(DeflateConfig.parse(f"mode={mode}"))
+    payloads = [PAYLOADS["text"], PAYLOADS["binary"], b"z"]
+    members = codec.encode_blocks(payloads)
+    assert [gunzip_member(m) for m in members] == payloads
+
+
+@pytest.mark.parametrize("mode", ["stored", "fixed"])
+def test_device_off_is_byte_identical(mode):
+    on = DeviceDeflateCodec(DeflateConfig.parse(f"mode={mode}"))
+    off = DeviceDeflateCodec(DeflateConfig.parse(f"mode={mode},device=off"))
+    payloads = [PAYLOADS["text"], PAYLOADS["binary"], PAYLOADS["boundary"]]
+    assert on.encode_blocks(payloads) == off.encode_blocks(payloads)
+
+
+@pytest.mark.parametrize("mode", ["stored", "fixed"])
+def test_demote_to_host_is_byte_identical(mode, monkeypatch):
+    """A device failure mid-batch demotes that window to host with
+    byte-identical output (the host builders are the byte authority)."""
+    from spark_bam_tpu.compress import kernels as k
+
+    payloads = [PAYLOADS["text"], PAYLOADS["binary"]]
+    want = DeviceDeflateCodec(
+        DeflateConfig.parse(f"mode={mode},device=off")).encode_blocks(payloads)
+
+    def boom(*a, **kw):
+        raise RuntimeError("injected device failure")
+
+    monkeypatch.setattr(k, "crc32_lanes", boom)
+    monkeypatch.setattr(k, "deflate_fixed_lanes", boom)
+    obs.shutdown()
+    reg = obs.configure()
+    try:
+        codec = DeviceDeflateCodec(DeflateConfig.parse(f"mode={mode}"))
+        got = codec.encode_blocks(payloads)
+        counters = {c["name"]: c["value"]
+                    for c in reg.snapshot()["counters"]}
+    finally:
+        obs.shutdown()
+    assert got == want
+    assert counters.get("deflate.demotions", 0) >= 1
+
+
+def test_limit_exceeded_never_demotes():
+    codec = DeviceDeflateCodec(DeflateConfig.parse("mode=stored"))
+    with pytest.raises(LimitExceeded):
+        codec.dispatch([b"x" * (MAX_STORED_PAYLOAD + 1)])
+
+
+# ------------------------------------------------------------- writer
+
+
+WRITE_SPECS = ["", "mode=stored", "mode=fixed", "mode=auto",
+               "mode=fixed,lanes=3", "mode=stored,device=off"]
+
+
+@pytest.mark.parametrize("spec", WRITE_SPECS)
+def test_write_bam_roundtrip(spec, src_bam, tmp_path):
+    header, want = read_back(src_bam)
+    out = str(tmp_path / "out.bam")
+    with open_channel(src_bam) as ch:
+        rs = RecordStream.open(ch)
+        res = write_bam_result(
+            out, rs.header, (rec for _, rec in rs),
+            block_payload=0x4000, deflate=spec,
+        )
+    got_header, got = read_back(out)
+    assert got_header == header
+    assert [r for _, r in got] == [r for _, r in want]
+    assert res.count == len(want)
+    data = open(out, "rb").read()
+    assert data.endswith(BGZF_EOF)
+    assert res.bytes_out == len(data)
+    # The writer's in-memory block table IS what a scan reads back.
+    with open_channel(out) as ch:
+        assert res.blocks == list(MetadataStream(ch))
+    # Every member independently valid, footer fields truthful.
+    off = 0
+    flat = b""
+    for m in res.blocks:
+        member = data[m.start: m.start + m.compressed_size]
+        payload = gunzip_member(member)
+        _, crc, isize = member_fields(member)
+        assert crc == zlib.crc32(payload) and isize == len(payload)
+        assert m.start == off and m.uncompressed_size == len(payload)
+        off += m.compressed_size
+        flat += payload
+    assert data[off:] == BGZF_EOF
+    # record_flats index the uncompressed stream exactly.
+    for f, (_, rec) in zip(res.record_flats, want):
+        assert flat[f: f + len(rec)] == rec
+
+
+def test_write_bam_empty_records(tmp_path, src_bam):
+    out = str(tmp_path / "empty.bam")
+    with open_channel(src_bam) as ch:
+        res = write_bam_result(out, RecordStream.open(ch).header, [],
+                               deflate="mode=fixed")
+    assert res.count == 0 and len(res.blocks) >= 1
+    _, got = read_back(out)
+    assert got == []
+
+
+def test_write_is_atomic_on_failure(tmp_path, src_bam):
+    out = str(tmp_path / "crash.bam")
+
+    def exploding():
+        with open_channel(src_bam) as ch:
+            for i, (_, rec) in enumerate(RecordStream.open(ch)):
+                if i == 50:
+                    raise RuntimeError("mid-write crash")
+                yield rec
+
+    with open_channel(src_bam) as ch:
+        header = RecordStream.open(ch).header
+    with pytest.raises(RuntimeError):
+        write_bam_result(out, header, exploding())
+    assert not os.path.exists(out)
+    assert not [f for f in os.listdir(tmp_path) if f.startswith("crash")]
+
+
+def test_stored_and_fixed_record_parity(src_bam, tmp_path):
+    """Different specs, same decoded stream — the format-level property
+    that lets ``--deflate`` change without touching any reader."""
+    outs = {}
+    for spec in ("mode=stored", "mode=fixed"):
+        out = str(tmp_path / f"{spec[5:]}.bam")
+        with open_channel(src_bam) as ch:
+            rs = RecordStream.open(ch)
+            write_bam_result(out, rs.header, (rec for _, rec in rs),
+                             deflate=spec)
+        outs[spec] = read_back(out)
+    assert outs["mode=stored"] == outs["mode=fixed"]
+
+
+# ---------------------------------------------------- rewrite + sidecars
+
+
+def test_rewrite_sidecars_and_warm_load(src_bam, tmp_path, monkeypatch):
+    from spark_bam_tpu.bgzf.index_blocks import format_block_line
+    from spark_bam_tpu.cli.rewrite import rewrite_bam
+    from spark_bam_tpu.load.api import split_starts
+
+    monkeypatch.setenv("SPARK_BAM_CACHE_DIR", str(tmp_path / "cache"))
+    out = str(tmp_path / "out.bam")
+    cfg = Config(split_size=64 << 10, cache="readwrite")
+    res = rewrite_bam(src_bam, out, deflate="mode=fixed", index=True,
+                      config=cfg)
+    assert sorted(res.sidecars) == ["blocks", "records", "sbi"]
+    # .blocks matches a scan of the output byte-for-byte.
+    with open_channel(out) as ch:
+        scan = [format_block_line(m) for m in MetadataStream(ch)]
+    assert open(res.sidecars["blocks"]).read().splitlines() == scan
+    assert len(scan) == res.n_blocks
+    # Live truth vs the synthesized plan: identical splits, and the warm
+    # load does ZERO checker invocations — the acceptance gate.
+    cold = split_starts(out, config=Config(split_size=64 << 10))
+    obs.shutdown()
+    reg = obs.configure()
+    try:
+        warm = split_starts(out, config=Config(split_size=64 << 10,
+                                               cache="read"))
+        counters = {c["name"]: c["value"]
+                    for c in reg.snapshot()["counters"]}
+    finally:
+        obs.shutdown()
+    assert warm == cold
+    assert counters.get("load.split_resolutions", 0) == 0
+    assert counters.get("cache.hits") == 1
+
+
+def test_rewrite_records_match_source(src_bam, tmp_path):
+    from spark_bam_tpu.cli.rewrite import rewrite_bam
+
+    out = str(tmp_path / "re.bam")
+    res = rewrite_bam(src_bam, out, block_payload=0x2000,
+                      deflate="mode=stored")
+    _, src = read_back(src_bam)
+    _, got = read_back(out)
+    assert [r for _, r in got] == [r for _, r in src]
+    assert res.count == len(src)
+    # Re-blocking actually re-blocked: different payload size, different
+    # member layout than the source.
+    with open_channel(out) as ch:
+        blocks = list(MetadataStream(ch))
+    assert all(m.uncompressed_size <= 0x2000 for m in blocks)
+
+
+@pytest.mark.fuzz
+def test_fuzz_consumers_clean_on_device_written(src_bam, tmp_path):
+    """The mutation-fuzz consumers (strict AND tolerant) read a
+    device-written file clean — device output joins the fuzz corpus's
+    idea of well-formed input."""
+    from spark_bam_tpu.cli.rewrite import rewrite_bam
+    from spark_bam_tpu.tools.fuzz_decode import _consume_bam, _run_case
+
+    out = str(tmp_path / "fz.bam")
+    res = rewrite_bam(src_bam, out, deflate="mode=fixed")
+    for tolerant in (False, True):
+        case = _run_case(_consume_bam, out, tolerant)
+        assert case["outcome"] == "clean", case
+    assert _consume_bam(out, tolerant=False) == res.count
+
+
+# ------------------------------------------------------------ serve op
+
+
+@pytest.mark.serve
+def test_serve_rewrite_op(src_bam, tmp_path, monkeypatch):
+    from spark_bam_tpu.serve.protocol import OPS, decode_request
+    from spark_bam_tpu.serve.service import SplitService
+
+    assert "rewrite" in OPS
+    monkeypatch.setenv("SPARK_BAM_CACHE_DIR", str(tmp_path / "cache"))
+    out = str(tmp_path / "served.bam")
+    svc = SplitService(Config(
+        serve="window=64KB,halo=8KB,batch=8,tick=5,workers=4",
+        cache="write"))
+    try:
+        req = decode_request(
+            '{"op":"rewrite","id":1,"path":"%s","out":"%s",'
+            '"deflate":"mode=fixed","index":true}' % (src_bam, out))
+        resp = svc.submit(req).result(timeout=120)
+        assert resp["ok"], resp
+        assert resp["count"] == len(read_back(src_bam)[1])
+        assert os.path.exists(out)
+        assert sorted(resp["sidecars"]) == ["blocks", "records", "sbi"]
+        # Typed errors, not crashes.
+        bad = svc.submit({"op": "rewrite", "id": 2, "path": src_bam,
+                          "out": out, "deflate": "mode=bogus"}
+                         ).result(timeout=30)
+        assert not bad["ok"] and bad["error"] == "ProtocolError"
+        noout = svc.submit({"op": "rewrite", "id": 3, "path": src_bam}
+                           ).result(timeout=30)
+        assert not noout["ok"] and noout["error"] == "ProtocolError"
+    finally:
+        svc.close()
+
+
+# ------------------------------------------------- zlib streams/columnar
+
+
+@pytest.mark.parametrize("name", ["empty", "text", "binary", "boundary"])
+def test_zlib_stream_roundtrip_and_parity(name):
+    raw = PAYLOADS[name] * (3 if name != "empty" else 1)
+    host = zlib_stream(raw)
+    assert zlib.decompress(host) == raw
+    assert encode_zlib_stream(raw, spec="mode=fixed") == host
+    assert encode_zlib_stream(raw, spec="mode=fixed,device=off") == host
+    assert encode_zlib_stream(raw, spec="") == host
+
+
+def test_columnar_deflate_codec_roundtrip():
+    from spark_bam_tpu.columnar.config import ColumnarConfig
+    from spark_bam_tpu.columnar.native import _decode_buffer, _encode_buffer
+
+    assert ColumnarConfig.parse("codec=deflate").codec == "deflate"
+    with pytest.raises(ValueError):
+        ColumnarConfig.parse("codec=lz4")
+    for raw in (b"", PAYLOADS["text"].ljust(200_000, b"n"),
+                PAYLOADS["binary"]):
+        buf = _encode_buffer(raw, "deflate", 6)
+        got, p = _decode_buffer(memoryview(buf), 0)
+        assert got == raw and p == len(buf)
