@@ -139,6 +139,15 @@ class Config:
     # counts, native-container compression, and the default projection
     # for the export sinks and the serve ``batch`` op.
     columnar: str = ""
+    # --- read-path device inflate (tpu/inflate.py; docs/design.md) ---
+    # Compact InflateConfig spec ("tokenize=device,kernel=auto,
+    # donate=on"; "" = defaults: tokenize=auto). Same string-spec
+    # pattern; ``inflate_config`` parses it (cached). Governs where the
+    # DEFLATE entropy phase runs (host native tokenizer vs the device
+    # bit-reader kernel), the device kernel engine (pallas/xla), and
+    # window-ring buffer donation. Orthogonal to ``device_inflate``
+    # (whether the two-phase device path runs at all).
+    inflate: str = ""
     # --- write-path compression (compress/; docs/design.md) ---
     # Compact DeflateConfig spec ("mode=fixed,level=6,lanes=16,
     # device=auto"; "" = defaults: host zlib). Same string-spec pattern;
@@ -233,6 +242,13 @@ class Config:
         from spark_bam_tpu.columnar.config import ColumnarConfig
 
         return ColumnarConfig.parse(self.columnar)
+
+    @property
+    def inflate_config(self):
+        """The parsed ``InflateConfig`` for this config's ``inflate`` spec."""
+        from spark_bam_tpu.core.inflate_config import InflateConfig
+
+        return InflateConfig.parse(self.inflate)
 
     @property
     def deflate_config(self):

@@ -78,7 +78,10 @@ NAMES = frozenset({
     "inflate.device_kernel", "inflate.device_ms", "inflate.device_windows",
     "inflate.h2d", "inflate.h2d_bytes", "inflate.h2d_ms", "inflate.host_ms",
     "inflate.pack", "inflate.rounds", "inflate.stall_ms", "inflate.stalls",
-    "inflate.tokenize", "inflate.window", "inflate.windows",
+    "inflate.tokenize", "inflate.tokenize_blocks",
+    "inflate.tokenize_demotions", "inflate.tokenize_device",
+    "inflate.tokenize_device_ms", "inflate.tokenize_host_ms",
+    "inflate.window", "inflate.windows",
     # load — partition execution
     "load.count", "load.fleet_files", "load.parse", "load.partition",
     "load.partitions", "load.record_starts", "load.records",

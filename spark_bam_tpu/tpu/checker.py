@@ -989,11 +989,30 @@ def count_window_tokens(
     ``val[own : own+halo]`` (zeros beyond ``n``), exactly the
     ``halo_windows`` carry discipline.
     """
-    from spark_bam_tpu.tpu.inflate import STRIDE, _resolve_body, _unpack_tokens
+    from spark_bam_tpu.tpu.inflate import _resolve_body, _unpack_tokens
 
     lit, dist = _unpack_tokens(packed)
     resolved, rounds = _resolve_body(lit, dist)
-    b = lit.shape[0]
+    return _count_from_planes(
+        resolved, rounds, out_lens, carry, lengths, num_contigs, carry_len,
+        n, at_eof, lo, own, window=window, halo=halo,
+        reads_to_check=reads_to_check, flags_impl=flags_impl,
+        pallas_interpret=pallas_interpret, funnel=funnel,
+    )
+
+
+def _count_from_planes(
+    resolved, rounds, out_lens, carry, lengths, num_contigs, carry_len, n,
+    at_eof, lo, own, *, window, halo, reads_to_check, flags_impl,
+    pallas_interpret, funnel,
+):
+    """Shared back half of the fused count kernels: gather-assemble the
+    logical window from resolved block rows + the halo carry, run the
+    count, slice the next carry. Traced inside both the packed-token and
+    raw-payload entry points."""
+    from spark_bam_tpu.tpu.inflate import STRIDE
+
+    b = resolved.shape[0]
     cum = jnp.concatenate(
         [jnp.zeros(1, _I32), jnp.cumsum(out_lens.astype(_I32))]
     )
@@ -1017,6 +1036,89 @@ def count_window_tokens(
     ext = jnp.concatenate([val, jnp.zeros(halo, jnp.uint8)])
     new_carry = lax.dynamic_slice(ext, (own,), (halo,))
     return {**r, "carry": new_carry, "rounds": rounds}
+
+
+def count_window_raw(
+    staged,       # (B_pad, C_pad) uint8 staged raw-DEFLATE payload rows
+    clens,        # (B_pad,) int32 compressed length per row (0 ⇒ pad row)
+    exp_lens,     # (B_pad,) int32 footer ISIZE per row (0 ⇒ pad row)
+    carry,        # (halo,) uint8 previous window's tail (valid ≤ carry_len)
+    lengths,      # (Cmax,) int32
+    num_contigs,  # () int32
+    carry_len,    # () int32
+    n,            # () int32 = carry_len + Σ exp_lens
+    at_eof,       # () bool
+    lo,           # () int32 owned-span start
+    own,          # () int32 owned-span end
+    *,
+    window: int,
+    halo: int,
+    reads_to_check: int = 10,
+    flags_impl: str = "xla",
+    pallas_interpret: bool = False,
+    funnel: bool = False,
+    tok_impl: str = "xla",
+):
+    """``count_window_tokens`` one step deeper: the H2D operand is the RAW
+    compressed payload matrix — the device bit-reader runs the entropy
+    phase in the same program as resolve + assemble + count, so the host
+    never touches DEFLATE bits at all and the wire carries compressed
+    bytes (≈3× less than packed token planes, ≈window-size less than
+    inflated bytes).
+
+    Returns the ``count_window_tokens`` dict plus ``tok_ok``: a scalar
+    bool, True iff every real row decoded cleanly AND produced exactly its
+    footer's ISIZE. The stream driver checks it at each sync and demotes
+    the whole count run to the host-tokenize path on the first False —
+    window counts from a failed decode are never trusted (the assembly
+    below uses the footer lengths, so a lying row cannot shift its
+    neighbors' bytes even transiently).
+    """
+    if tok_impl == "pallas":
+        from spark_bam_tpu.tpu.pallas_kernels import tokenize_pallas
+
+        lit, dist, olens, ok = tokenize_pallas(staged, clens)
+    else:
+        from spark_bam_tpu.tpu.tokenize_device import tokenize_planes
+
+        lit, dist, olens, ok = tokenize_planes(staged, clens)
+    from spark_bam_tpu.tpu.inflate import _resolve_body
+
+    pad = clens == 0
+    tok_ok = jnp.all((ok | pad) & ((olens == exp_lens) | pad))
+    resolved, rounds = _resolve_body(lit, dist)
+    out = _count_from_planes(
+        resolved, rounds, exp_lens, carry, lengths, num_contigs, carry_len,
+        n, at_eof, lo, own, window=window, halo=halo,
+        reads_to_check=reads_to_check, flags_impl=flags_impl,
+        pallas_interpret=pallas_interpret, funnel=funnel,
+    )
+    return {**out, "tok_ok": tok_ok}
+
+
+def make_count_window_raw(
+    window: int, halo: int, reads_to_check: int = 10,
+    flags_impl: str = "xla", funnel: bool = False, tok_impl: str = "xla",
+    donate: bool = True,
+):
+    """A jit-compiled fused tokenize→resolve→assemble→count kernel for
+    fixed window/halo geometry (the ``tokenize=device`` count path of
+    stream_check.StreamChecker.count_reads). With ``donate`` the (halo,)
+    carry operand aliases the returned carry — the inter-window state ring
+    reuses its HBM instead of allocating per window."""
+    pallas_interpret = _pallas_interpret_for(flags_impl)
+
+    def run(staged, clens, exp_lens, carry, lengths, num_contigs,
+            carry_len, n, at_eof, lo, own):
+        return count_window_raw(
+            staged, clens, exp_lens, carry, lengths, num_contigs,
+            carry_len, n, at_eof, lo, own,
+            window=window, halo=halo, reads_to_check=reads_to_check,
+            flags_impl=flags_impl, pallas_interpret=pallas_interpret,
+            funnel=funnel, tok_impl=tok_impl,
+        )
+
+    return jax.jit(run, donate_argnums=(3,)) if donate else jax.jit(run)
 
 
 def make_count_window_tokens(

@@ -51,6 +51,7 @@ checker consumes identical flat windows from either producer.
 from __future__ import annotations
 
 import contextlib
+import functools
 import logging
 import os
 import time
@@ -68,7 +69,9 @@ import jax.numpy as jnp
 from jax import lax
 
 from spark_bam_tpu.bgzf.block import MAX_BLOCK_SIZE, Metadata
-from spark_bam_tpu.bgzf.flat import FlatView, inflate_blocks, read_run_payloads
+from spark_bam_tpu.bgzf.flat import (
+    FlatView, inflate_blocks, read_run_payloads, stage_run_payloads,
+)
 from spark_bam_tpu.core.channel import open_channel
 
 # Fixed token-row width: one BGZF block inflates to ≤ MAX_BLOCK_SIZE
@@ -147,6 +150,17 @@ def _resolve_packed(packed: jnp.ndarray):
     return _resolve_body(lit, dist)
 
 
+# Resolve straight from unpacked token planes (the device-tokenizer path:
+# the planes were BORN on device, there is nothing to unpack). The donated
+# variant aliases the lit plane into the resolved output — same (B, STRIDE)
+# u8 shape — so the window ring's steady state reuses HBM instead of
+# allocating a fresh output plane per window (``Config.inflate`` donate=off
+# is the debugging escape hatch; tests/test_tokenize_device.py pins the
+# flat-allocation regression).
+_resolve_planes = jax.jit(_resolve_body)
+_resolve_planes_donated = jax.jit(_resolve_body, donate_argnums=(0,))
+
+
 # Fused-Pallas LZ77 engine selection. "auto" uses the Pallas kernel on the
 # TPU backend (per-block VMEM rows, in-kernel early exit) and the XLA
 # while_loop elsewhere; a Mosaic lowering/compile failure demotes to XLA
@@ -187,6 +201,56 @@ def _dispatch_resolve(packed: np.ndarray):
     return _resolve_packed(jnp.asarray(packed))
 
 
+# Device-tokenizer engine selection: same demote policy as the LZ77 engine
+# above — "auto" tries the Pallas bit-reader on the TPU backend and falls
+# back to the XLA vmap form permanently for the process on Mosaic refusal.
+# ``Config.inflate``'s kernel= knob pins either engine explicitly.
+_tok_engine: str | None = None
+
+
+def _tok_impl(kernel: str = "auto") -> str:
+    global _tok_engine
+    if kernel in ("xla", "pallas"):
+        return kernel
+    if _tok_engine is None:
+        _tok_engine = "pallas" if jax.default_backend() == "tpu" else "xla"
+    return _tok_engine
+
+
+def _dispatch_tokenize(staged_dev, clens_dev, kernel: str = "auto"):
+    """Device entropy phase dispatch (async; nothing synced). Takes the
+    staged raw-payload matrix + per-row compressed lengths already on
+    device; returns ``(lit, dist, out_lens_dev, ok_dev)`` token planes plus
+    the per-row produced length and well-formedness flag the materialize
+    sync validates against the block footers."""
+    global _tok_engine
+    if _tok_impl(kernel) == "pallas":
+        try:
+            from spark_bam_tpu.tpu.pallas_kernels import tokenize_pallas
+
+            return tokenize_pallas(staged_dev, clens_dev)
+        except Exception:
+            _tok_engine = "xla"
+            log.warning(
+                "Pallas tokenize kernel unavailable; using the XLA "
+                "bit-reader (reported once per process)", exc_info=True,
+            )
+    from spark_bam_tpu.tpu.tokenize_device import tokenize_planes
+
+    return tokenize_planes(staged_dev, clens_dev)
+
+
+def _inflate_cfg(spec: str | None = None):
+    """The effective ``InflateConfig``: an explicit spec (``Config.inflate``
+    threaded down by callers that hold a Config) or the ``SPARK_BAM_INFLATE``
+    env var (bench children, ad-hoc scripts)."""
+    from spark_bam_tpu.core.inflate_config import InflateConfig
+
+    if spec is None:
+        spec = os.environ.get("SPARK_BAM_INFLATE", "")
+    return InflateConfig.parse(spec)
+
+
 def tokenize_pack(
     comp: np.ndarray,
     offsets: np.ndarray,
@@ -225,8 +289,10 @@ def tokenize_pack(
         packed = pack_tokens(lit, dist)
     # The host entropy phase IS tokenize+pack — both device-inflate
     # consumers (two-phase resolve and the fused count kernel) route
-    # through here, so the per-window host-ms attribution lives here too.
-    attribute_ms(host_ms=(time.perf_counter() - t_host) * 1e3)
+    # through here. Attributed under its own name so the device-tokenizer
+    # A/B compares like with like; ``inflate.host_ms`` is only the residual
+    # read/boundary-scan work either mode must do on host.
+    attribute_ms(tokenize_host_ms=(time.perf_counter() - t_host) * 1e3)
     return packed, out_lens, b
 
 
@@ -240,18 +306,26 @@ def _record_rounds(rounds_dev) -> None:
             pass
 
 
-def attribute_ms(host_ms=None, h2d_ms=None, device_ms=None) -> None:
+def attribute_ms(host_ms=None, h2d_ms=None, device_ms=None,
+                 tokenize_host_ms=None, tokenize_device_ms=None) -> None:
     """Per-window host-vs-device attribution (ROADMAP item 1's missing
     evidence): each phase lands as BOTH a gauge (last window + peak, the
     ``top``/Prometheus view) and an ms-unit histogram (the stage digest
     bench attaches to BENCH_HISTORY rows). No-op without a live registry.
+
+    ``host_ms`` is ONLY the residual host work every mode shares (bulk
+    read + boundary scan + staging); the entropy phase reports under the
+    tokenize_* names so the host-vs-device tokenizer A/B reads directly
+    off the attribution split.
     """
     r = obs.registry()
     if r is None:
         return
     for name, v in (("inflate.host_ms", host_ms),
                     ("inflate.h2d_ms", h2d_ms),
-                    ("inflate.device_ms", device_ms)):
+                    ("inflate.device_ms", device_ms),
+                    ("inflate.tokenize_host_ms", tokenize_host_ms),
+                    ("inflate.tokenize_device_ms", tokenize_device_ms)):
         if v is not None:
             r.gauge(name).set(round(v, 3))
             r.histogram(name, unit="ms").observe(v)
@@ -350,21 +424,56 @@ def tokenize_group(ch, metas: list[Metadata]):
     ``(packed, out_lens, b)`` or None (tokenizer unavailable); raises
     IOError on footer disagreement. This is the host half the fully
     device-resident count path feeds to ``checker.count_window_tokens``."""
+    t0 = time.perf_counter()
     comp, offs, lens = _read_group_payloads(ch, metas)
+    # Residual host work (read + boundary slices) — the part that stays on
+    # host no matter where the entropy phase runs.
+    attribute_ms(host_ms=(time.perf_counter() - t0) * 1e3)
     usizes = np.array([m.uncompressed_size for m in metas], dtype=np.int64)
     return tokenize_pack(comp, offs, lens, usizes)
+
+
+def stage_group_device(ch, metas: list[Metadata]):
+    """Read + stage + H2D one window group's RAW payloads — the worker-
+    thread half of the device-tokenize path. Because this runs on the
+    pipeline's producer threads (and the fused count's prefetch pool),
+    window k+1's H2D overlaps window k's kernel: ``inflate.h2d_ms`` comes
+    off the critical path entirely. Returns
+    ``(staged_dev (B_pad, C_pad) u8, clens_dev (B_pad,) i32, usizes)``."""
+    t0 = time.perf_counter()
+    staged, clens = stage_run_payloads(ch, metas)
+    attribute_ms(host_ms=(time.perf_counter() - t0) * 1e3)
+    usizes = np.array([m.uncompressed_size for m in metas], dtype=np.int64)
+    if obs.enabled():
+        t0 = time.perf_counter()
+        with obs.span("inflate.h2d", blocks=len(metas), bytes=staged.nbytes):
+            staged_dev = jnp.asarray(staged)
+            clens_dev = jnp.asarray(clens)
+            staged_dev.block_until_ready()
+        attribute_ms(h2d_ms=(time.perf_counter() - t0) * 1e3)
+        obs.count("inflate.h2d_bytes", int(staged.nbytes))
+    else:
+        staged_dev = jnp.asarray(staged)
+        clens_dev = jnp.asarray(clens)
+    return staged_dev, clens_dev, usizes
 
 
 class _PendingDeviceView:
     """A window group whose resolve dispatch is in flight: the device
     arrays plus everything needed to materialize a FlatView later (the
-    double-buffering seam — workers dispatch, the consumer materializes)."""
+    double-buffering seam — workers dispatch, the consumer materializes).
+
+    In device-tokenize mode ``tok_ok``/``tok_lens`` carry the bit-reader's
+    per-row well-formedness flags and produced lengths; ``materialize``
+    validates them against the block footers and raises IOError on any
+    disagreement, so a malformed member demotes that window to host zlib —
+    the device tokenizer can refuse bytes but never deliver wrong ones."""
 
     __slots__ = ("resolved_dev", "rounds_dev", "out_lens", "b", "metas",
-                 "file_total", "at_eof")
+                 "file_total", "at_eof", "tok_ok", "tok_lens")
 
     def __init__(self, resolved_dev, rounds_dev, out_lens, b, metas,
-                 file_total, at_eof):
+                 file_total, at_eof, tok_ok=None, tok_lens=None):
         self.resolved_dev = resolved_dev
         self.rounds_dev = rounds_dev
         self.out_lens = out_lens
@@ -372,6 +481,8 @@ class _PendingDeviceView:
         self.metas = metas
         self.file_total = file_total
         self.at_eof = at_eof
+        self.tok_ok = tok_ok
+        self.tok_lens = tok_lens
 
     def materialize(self) -> FlatView:
         t0 = time.perf_counter()
@@ -381,6 +492,19 @@ class _PendingDeviceView:
         # the materialize sync — that wait is the window's device_ms.
         if obs.enabled():
             attribute_ms(device_ms=(time.perf_counter() - t0) * 1e3)
+        if self.tok_ok is not None:
+            ok = np.asarray(self.tok_ok)[: self.b]
+            lens = np.asarray(self.tok_lens)[: self.b]
+            expected = np.asarray(self.out_lens, dtype=np.int64)
+            if not (ok.all() and np.array_equal(lens.astype(np.int64),
+                                                expected)):
+                obs.count("inflate.tokenize_demotions")
+                bad = int(np.argmax(~ok | (lens.astype(np.int64) != expected)))
+                raise IOError(
+                    f"device tokenizer disagreed with block footers "
+                    f"(first bad row {bad}: ok={bool(ok[bad])}, "
+                    f"produced={int(lens[bad])}, footer={int(expected[bad])})"
+                )
         _record_rounds(self.rounds_dev)
         obs.count("inflate.device_windows")
         data = np.concatenate(
@@ -411,10 +535,19 @@ def dispatch_group_device(
     metas: list[Metadata],
     file_total: int | None = None,
     at_eof: bool = False,
+    inflate_spec: str | None = None,
 ) -> _PendingDeviceView | None:
     """Host phases + async device dispatch for one group; no sync. Returns
-    None when the native tokenizer is unavailable."""
+    None when the entropy phase is unavailable (host mode without the
+    native tokenizer). ``inflate_spec`` is ``Config.inflate`` — its
+    tokenize= knob routes the entropy phase (host tokenize+pack vs the
+    device bit-reader over raw payload bytes)."""
+    icfg = _inflate_cfg(inflate_spec)
+    if icfg.resolve_tokenize() == "device":
+        return _dispatch_group_raw(ch, metas, file_total, at_eof, icfg)
+    t0 = time.perf_counter()
     comp, offs, lens = _read_group_payloads(ch, metas)
+    attribute_ms(host_ms=(time.perf_counter() - t0) * 1e3)
     usizes = np.array([m.uncompressed_size for m in metas], dtype=np.int64)
     tp = tokenize_pack(comp, offs, lens, usizes)
     if tp is None:
@@ -435,15 +568,51 @@ def dispatch_group_device(
     )
 
 
+def _dispatch_group_raw(
+    ch, metas, file_total, at_eof, icfg
+) -> _PendingDeviceView:
+    """Device-tokenize dispatch: raw payload bytes ship (≈1/3 the H2D
+    traffic of packed token planes), the bit-reader kernel runs the entropy
+    phase, and the LZ77 resolve consumes its planes in place — with
+    donation on, the lit plane's HBM is reused as the resolved output, so
+    steady state holds one staged matrix + two planes per in-flight window
+    instead of growing per window. All dispatches are async; the footer
+    validation happens at the materialize sync (never wrong bytes)."""
+    staged_dev, clens_dev, usizes = stage_group_device(ch, metas)
+    b = len(metas)
+    if obs.enabled():
+        t0 = time.perf_counter()
+        with obs.span("inflate.tokenize_device", blocks=b):
+            lit, dist, lens_dev, ok_dev = _dispatch_tokenize(
+                staged_dev, clens_dev, icfg.kernel
+            )
+            ok_dev.block_until_ready()
+        attribute_ms(tokenize_device_ms=(time.perf_counter() - t0) * 1e3)
+    else:
+        lit, dist, lens_dev, ok_dev = _dispatch_tokenize(
+            staged_dev, clens_dev, icfg.kernel
+        )
+    obs.count("inflate.tokenize_blocks", b)
+    resolve = _resolve_planes_donated if icfg.donate_enabled else _resolve_planes
+    resolved_dev, rounds_dev = resolve(lit, dist)
+    return _PendingDeviceView(
+        resolved_dev, rounds_dev, usizes, b, metas, file_total, at_eof,
+        tok_ok=ok_dev, tok_lens=lens_dev,
+    )
+
+
 def inflate_group_device(
     ch,
     metas: list[Metadata],
     file_total: int | None = None,
     at_eof: bool = False,
+    inflate_spec: str | None = None,
 ) -> FlatView | None:
     """Two-phase device inflate of a run of blocks → FlatView (the device
     producer counterpart of bgzf/flat.py inflate_blocks; synchronous)."""
-    pending = dispatch_group_device(ch, metas, file_total, at_eof)
+    pending = dispatch_group_device(
+        ch, metas, file_total, at_eof, inflate_spec
+    )
     if pending is None:
         return None
     return pending.materialize()
@@ -520,10 +689,14 @@ class InflatePipeline:
         device_copy: bool = False,
         depth: int = 2,
         metas: list | None = None,
+        inflate_spec: str | None = None,
     ):
         from spark_bam_tpu.bgzf.index_blocks import blocks_metadata
 
         self.path = path
+        # ``Config.inflate`` spec (tokenize=/kernel=/donate=); None reads
+        # SPARK_BAM_INFLATE at dispatch time.
+        self.inflate_spec = inflate_spec
         # ``metas``: reuse a prior metadata scan (whole-file header walk)
         # when the caller already has one.
         if metas is None:
@@ -567,7 +740,8 @@ class InflatePipeline:
                 # the window, never kills the pipeline.
                 try:
                     pending = dispatch_group_device(
-                        ch, group, file_total=self.total
+                        ch, group, file_total=self.total,
+                        inflate_spec=self.inflate_spec,
                     )
                 except Exception:
                     self._demote_warn()

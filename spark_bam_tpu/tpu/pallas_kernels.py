@@ -277,6 +277,74 @@ def lz77_resolve_pallas(
     return out, jnp.max(rounds)
 
 
+# --------------------------------------------------- tokenize bit-reader
+
+
+def _tokenize_kernel(comp_ref, clen_ref, *refs):
+    # refs = 9 table refs (tokenize_device.TABLES order) + 4 output refs.
+    # pallas_call refuses captured array constants, so the RFC tables
+    # arrive as operands and thread back in through ``tabs``.
+    from spark_bam_tpu.tpu.tokenize_device import _tokenize_row
+
+    tabs = tuple(r[...] for r in refs[:9])
+    lit_ref, dist_ref, olen_ref, ok_ref = refs[9:]
+    lit, dist, o, ok = _tokenize_row(comp_ref[0, :], clen_ref[0, 0], tabs)
+    lit_ref[0, :] = lit
+    dist_ref[0, :] = dist
+    olen_ref[0, 0] = o
+    ok_ref[0, 0] = ok.astype(_I32)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def tokenize_pallas(
+    staged: jnp.ndarray,  # (B, C_pad) uint8 zero-padded raw-DEFLATE payloads
+    clens: jnp.ndarray,   # (B,) int32 real payload byte lengths
+    interpret: bool = False,
+):
+    """The device entropy phase as a Pallas grid: one lane per BGZF
+    block walking its raw-DEFLATE bitstream in VMEM — Huffman table
+    decode, run expansion, symbol emission — producing the same packed
+    lit/dist token planes the host tokenizer does (see
+    tpu/tokenize_device.py for the row math and its error model).
+
+    Returns ``(lit (B, S) u8, dist (B, S) u16, out_lens (B,) i32,
+    ok (B,) bool)``. Bit-serial control flow leans hard on Mosaic
+    (nested ``while_loop``, dynamic 1-D slices); any lowering refusal is
+    a *demotion*, not an error — the inflate dispatcher falls back to
+    the identical-math XLA vmap (``tokenize_device.tokenize_planes``)
+    and logs once, mirroring ``lz77_resolve_pallas``. Parity is pinned
+    in interpret mode by tests/test_tokenize_device.py."""
+    from spark_bam_tpu.tpu.tokenize_device import STRIDE as _TOK_S
+    from spark_bam_tpu.tpu.tokenize_device import TABLES
+
+    b, c_pad = staged.shape
+    lit, dist, olens, ok = pl.pallas_call(
+        _tokenize_kernel,
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((1, c_pad), lambda i: (i, 0)),
+            pl.BlockSpec((1, 1), lambda i: (i, 0)),
+        ] + [
+            # Broadcast tables: every grid lane reads block 0 whole.
+            pl.BlockSpec(t.shape, lambda i: (0,)) for t in TABLES
+        ],
+        out_specs=[
+            pl.BlockSpec((1, _TOK_S), lambda i: (i, 0)),
+            pl.BlockSpec((1, _TOK_S), lambda i: (i, 0)),
+            pl.BlockSpec((1, 1), lambda i: (i, 0)),
+            pl.BlockSpec((1, 1), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, _TOK_S), jnp.uint8),
+            jax.ShapeDtypeStruct((b, _TOK_S), jnp.uint16),
+            jax.ShapeDtypeStruct((b, 1), jnp.int32),
+            jax.ShapeDtypeStruct((b, 1), jnp.int32),
+        ],
+        interpret=interpret,
+    )(staged, clens.reshape(b, 1), *TABLES)
+    return lit, dist, olens[:, 0], ok[:, 0] != 0
+
+
 # --------------------------------------------------- funnel stage-0 kernel
 
 # The prefilter only reads the fixed block (bytes [l, l+36)); one 1 KiB

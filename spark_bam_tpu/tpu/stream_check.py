@@ -163,7 +163,7 @@ class StreamChecker:
         self.pipeline = InflatePipeline(
             path, window_uncompressed=fresh,
             device_copy=resolve_device_inflate(config, use_device),
-            metas=metas, **pipe_kw,
+            metas=metas, inflate_spec=config.inflate, **pipe_kw,
         )
         self.total = self.pipeline.total
         # Kernel shape: one power of two covering carry + window, clamped to
@@ -590,14 +590,20 @@ class StreamChecker:
 
         from spark_bam_tpu.native.build import load_native
         from spark_bam_tpu.core.channel import open_channel
-        from spark_bam_tpu.tpu.checker import make_count_window_tokens
+        from spark_bam_tpu.tpu.checker import (
+            make_count_window_raw, make_count_window_tokens,
+        )
         from spark_bam_tpu.tpu.inflate import (
-            attribute_ms, maybe_profile_window, tokenize_group,
+            _tok_impl, attribute_ms, maybe_profile_window,
+            stage_group_device, tokenize_group,
         )
 
-        lib = load_native()
-        if lib is None or not hasattr(lib, "sbt_tokenize_deflate"):
-            return None
+        icfg = self.config.inflate_config
+        device_tok = icfg.resolve_tokenize() == "device"
+        if not device_tok:
+            lib = load_native()
+            if lib is None or not hasattr(lib, "sbt_tokenize_deflate"):
+                return None
         groups = self.pipeline.groups
         if not groups:
             return None
@@ -609,12 +615,25 @@ class StreamChecker:
         ) + halo > w:
             return None
 
-        kernel = make_count_window_tokens(
-            w, halo, self.config.reads_to_check,
-            flags_impl=self._flags_impl(),
-            funnel=self.config.funnel_enabled(),
-        )
         funnel = self.config.funnel_enabled()
+        if device_tok:
+            # tokenize=device: workers stage + H2D the RAW payload matrix
+            # (overlapping the kernel), and the entropy phase runs inside
+            # the fused program. Any row the bit-reader rejects — or whose
+            # produced length disagrees with its footer — flips the
+            # kernel's tok_ok scalar and demotes the whole count to the
+            # host-tokenize path; bad decodes never reach the total.
+            kernel = make_count_window_raw(
+                w, halo, self.config.reads_to_check,
+                flags_impl=self._flags_impl(), funnel=funnel,
+                tok_impl=_tok_impl(icfg.kernel),
+                donate=icfg.donate_enabled,
+            )
+        else:
+            kernel = make_count_window_tokens(
+                w, halo, self.config.reads_to_check,
+                flags_impl=self._flags_impl(), funnel=funnel,
+            )
         lens_dev, nc = self._device_inputs()
 
         total = 0
@@ -626,15 +645,17 @@ class StreamChecker:
         escaped = False
         demoted = False
         ring: list = []
+        ok_ring: list = []
         carry_dev = jnp.zeros(halo, dtype=jnp.uint8)
         carry_len = 0
         base = 0
+        produce = stage_group_device if device_tok else tokenize_group
 
         ch = open_channel(self.path)
         pool = ThreadPoolExecutor(max_workers=self.pipeline.depth)
         try:
             pending = [
-                pool.submit(tokenize_group, ch, g)
+                pool.submit(produce, ch, g)
                 for g in groups[: self.pipeline.depth]
             ]
             for gi in range(len(groups)):
@@ -658,39 +679,60 @@ class StreamChecker:
                 nxt = gi + self.pipeline.depth
                 if nxt < len(groups):
                     pending.append(
-                        pool.submit(tokenize_group, ch, groups[nxt])
+                        pool.submit(produce, ch, groups[nxt])
                     )
-                packed, out_lens, _b = tp
-                n = carry_len + int(out_lens.sum())
+                if device_tok:
+                    staged_dev, clens_dev, usizes = tp
+                    n = carry_len + int(usizes.sum())
+                else:
+                    packed, out_lens, _b = tp
+                    n = carry_len + int(out_lens.sum())
                 at_eof = gi == len(groups) - 1
                 own_end = n if at_eof else max(n - halo, 0)
                 lo = min(max(self.header_end_abs - base, 0), own_end)
-                obs.count("inflate.h2d_bytes", int(packed.nbytes))
                 with contextlib.ExitStack() as stack:
                     if gi == 0:
                         # --profile: one-shot capture of the first fused
                         # window (H2D + count kernel + the rounds sync).
                         stack.enter_context(maybe_profile_window(
                             label="count_window"))
-                    if obs.enabled():
-                        # H2D split: sync the packed transfer alone before
-                        # the kernel dispatch. Only under a live registry —
-                        # the production path stays fully async.
-                        t_h2d = time.perf_counter()
-                        packed_dev = jnp.asarray(packed)
-                        packed_dev.block_until_ready()
-                        attribute_ms(
-                            h2d_ms=(time.perf_counter() - t_h2d) * 1e3
+                    if device_tok:
+                        # H2D happened on the producer thread
+                        # (stage_group_device) — off this critical path.
+                        exp = np.zeros(staged_dev.shape[0], dtype=np.int32)
+                        exp[: len(usizes)] = usizes
+                        out = kernel(
+                            staged_dev, clens_dev, jnp.asarray(exp),
+                            carry_dev, lens_dev, nc,
+                            jnp.int32(carry_len), jnp.int32(n),
+                            jnp.bool_(at_eof), jnp.int32(lo),
+                            jnp.int32(own_end),
                         )
+                        ok_ring.append(out["tok_ok"])
+                        obs.count("inflate.tokenize_blocks", len(usizes))
                     else:
-                        packed_dev = jnp.asarray(packed)
-                    out = kernel(
-                        packed_dev,
-                        jnp.asarray(out_lens.astype(np.int32)),
-                        carry_dev, lens_dev, nc,
-                        jnp.int32(carry_len), jnp.int32(n),
-                        jnp.bool_(at_eof), jnp.int32(lo), jnp.int32(own_end),
-                    )
+                        obs.count("inflate.h2d_bytes", int(packed.nbytes))
+                        if obs.enabled():
+                            # H2D split: sync the packed transfer alone
+                            # before the kernel dispatch. Only under a live
+                            # registry — the production path stays fully
+                            # async.
+                            t_h2d = time.perf_counter()
+                            packed_dev = jnp.asarray(packed)
+                            packed_dev.block_until_ready()
+                            attribute_ms(
+                                h2d_ms=(time.perf_counter() - t_h2d) * 1e3
+                            )
+                        else:
+                            packed_dev = jnp.asarray(packed)
+                        out = kernel(
+                            packed_dev,
+                            jnp.asarray(out_lens.astype(np.int32)),
+                            carry_dev, lens_dev, nc,
+                            jnp.int32(carry_len), jnp.int32(n),
+                            jnp.bool_(at_eof), jnp.int32(lo),
+                            jnp.int32(own_end),
+                        )
                     carry_dev = out["carry"]
                     carry_len = n - own_end
                     base += own_end
@@ -721,6 +763,14 @@ class StreamChecker:
                 ring.append(out["count"])
                 if len(ring) > self.ring_depth:
                     ring.pop(0).block_until_ready()
+                    # Validate the bit-reader verdicts lazily, at the same
+                    # pacing sync: a rejected row anywhere demotes the
+                    # whole count (the classic loop restarts from scratch;
+                    # nothing was consumed from self.pipeline).
+                    if ok_ring and not bool(ok_ring.pop(0)):
+                        obs.count("inflate.tokenize_demotions")
+                        demoted = True
+                        break
                 windows += 1
                 chunk += 1
                 obs.count("check.windows")
@@ -745,6 +795,9 @@ class StreamChecker:
         finally:
             pool.shutdown(wait=True, cancel_futures=True)
             ch.close()
+        if not demoted and ok_ring and not all(bool(ok) for ok in ok_ring):
+            obs.count("inflate.tokenize_demotions")
+            demoted = True
         if demoted:
             return None
         if not escaped and dev_total is not None:

@@ -1,0 +1,460 @@
+"""Device-resident DEFLATE tokenization: the bit-reader that replaces
+the host entropy phase.
+
+The two-phase device inflate (tpu/inflate.py) splits DEFLATE into an
+entropy phase (bitstream → per-output-byte lit/dist tokens) and a copy
+phase (LZ77 pointer-chain resolution). Until now the entropy phase ran
+on host (``sbt_tokenize_deflate``) and every window shipped 3 bytes of
+tokens per output byte over the bus. This module moves the entropy
+phase onto the device: ``_tokenize_row`` walks ONE raw-DEFLATE
+bitstream — dynamic/fixed Huffman table decode (canonical-code build
+from the HLIT/HDIST/HCLEN header, code-length run expansion 16/17/18),
+stored blocks, and symbol emission — producing token planes
+**bit-identical** to the native tokenizer's, so the downstream resolve/
+count kernels are unchanged. vmapped over a window's blocks, only the
+*compressed* payload bytes cross the bus (~3-6x less H2D traffic than
+token planes, and none of the host tokenize wall time).
+
+Decoding untrusted bytes in fixed-shape SIMD code means every error is
+a flag, not an exception: each row carries an ``ok`` lane that goes
+False on any malformation the native tokenizer rejects (oversubscribed
+code, bad stored-block LEN/~NLEN, distance beyond output, truncated
+stream, symbol 286/287, missing end-of-block code). The driver
+(tpu/inflate.py) checks ``ok`` and the produced lengths against the
+BGZF footers at materialize time and demotes failing windows to host —
+**never wrong bytes**.
+
+Loop shape: the symbol loop is bit-serial by nature (each code's length
+is only known after decoding it), so one row is a ``while_loop`` whose
+trip count is bounded by the payload bit length. Parallelism comes from
+the batch dim — one lane per BGZF block — which is exactly the Pallas
+grid mapping in ``pallas_kernels.tokenize_pallas``; this module's XLA
+``vmap`` form is the portable fallback the dispatch demotes to.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from spark_bam_tpu.bgzf.block import MAX_BLOCK_SIZE
+
+#: Token-row width (one BGZF block inflates to ≤ 64 KiB) — must match
+#: the resolve kernels' STRIDE.
+STRIDE = MAX_BLOCK_SIZE
+_S = STRIDE
+#: Windowed-write width: ≥ 258 (DEFLATE's max match) so any single
+#: symbol lands in one masked write; 512 keeps stored-block copies to
+#: a few iterations per block.
+_WIN = 512
+#: Plane slack so windowed writes at o near STRIDE never clamp.
+_SP = _S + _WIN
+#: Code-length scratch width: 286+30 lens + 144 run-write slack
+#: (a 138-max run written 144 wide can start at index tot-1).
+_LENS_W = 464
+
+# RFC 1951 3.2.5 length/distance base+extra tables. Built under
+# ensure_compile_time_eval: this module's first import may happen INSIDE a
+# jit trace (the fused count kernel defers the import), and a device_put
+# under tracing would bake tracers into module globals.
+with jax.ensure_compile_time_eval():
+    _LEN_BASE = jnp.array(
+        [3, 4, 5, 6, 7, 8, 9, 10, 11, 13, 15, 17, 19, 23, 27, 31, 35, 43,
+         51, 59, 67, 83, 99, 115, 131, 163, 195, 227, 258], jnp.int32)
+    _LEN_EXTRA = jnp.array(
+        [0, 0, 0, 0, 0, 0, 0, 0, 1, 1, 1, 1, 2, 2, 2, 2, 3, 3, 3, 3, 4, 4,
+         4, 4, 5, 5, 5, 5, 0], jnp.int32)
+    _DIST_BASE = jnp.array(
+        [1, 2, 3, 4, 5, 7, 9, 13, 17, 25, 33, 49, 65, 97, 129, 193, 257,
+         385, 513, 769, 1025, 1537, 2049, 3073, 4097, 6145, 8193, 12289,
+         16385, 24577], jnp.int32)
+    _DIST_EXTRA = jnp.array(
+        [0, 0, 0, 0, 1, 1, 2, 2, 3, 3, 4, 4, 5, 5, 6, 6, 7, 7, 8, 8, 9, 9,
+         10, 10, 11, 11, 12, 12, 13, 13], jnp.int32)
+    # RFC 1951 3.2.7: the order code-length-code lengths appear in.
+    _CL_ORDER = jnp.array(
+        [16, 17, 18, 0, 8, 7, 9, 6, 10, 5, 11, 4, 12, 3, 13, 2, 14, 1, 15],
+        jnp.int32)
+
+
+def _bits(comp, clen8, bp, n, ok):
+    """Read ``n`` (traced, ≤ 16) LSB-first bits at bit offset ``bp``.
+
+    One aligned-enough 4-byte dynamic_slice covers any 16-bit read at
+    any bit phase; ``ok`` goes False when the read runs past the
+    payload's ``clen8`` bit length (truncated stream)."""
+    byte = bp >> 3
+    w = lax.dynamic_slice(comp, (byte,), (4,)).astype(jnp.uint32)
+    v = w[0] | (w[1] << 8) | (w[2] << 16) | (w[3] << 24)
+    v = v >> (bp & 7).astype(jnp.uint32)
+    nn = n.astype(jnp.uint32) if hasattr(n, "astype") else jnp.uint32(n)
+    v = jnp.where(nn >= 32, v, v & ((jnp.uint32(1) << nn) - 1))
+    return v.astype(jnp.int32), bp + n, ok & (bp + n <= clen8)
+
+
+def _huff_build(lens, nc, valid_n):
+    """Canonical-code table build (RFC 1951 3.2.2): per-length counts
+    plus the (length, symbol)-ordered symbol list — the same two arrays
+    the native decoder peels codes against. ``lens`` is a fixed-width
+    i32 vector; entries at index ≥ ``nc`` are masked out. Returns
+    ``(count (16,), symbol (N,), ok)``; ok False on over-subscription
+    (the all-zero table is legal — decode then fails on first use)."""
+    n = lens.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    active = idx < nc
+    l = jnp.where(active, jnp.clip(lens, 0, 15), 0)
+    nz = active & (l > 0)
+    count = jnp.zeros(16, jnp.int32).at[l].add(nz.astype(jnp.int32))
+
+    def left_body(ln, st):
+        left, bad = st
+        left = left * 2 - count[ln]
+        return left, bad | (left < 0)
+
+    _, oversub = lax.fori_loop(
+        1, 16, left_body, (jnp.int32(1), jnp.bool_(False))
+    )
+    ok = (count[1:].sum() == 0) | ~oversub
+    # Stable (length, index) sort via one integer key; zero-length
+    # symbols sink past every real code so ``symbol[index + code -
+    # first]`` only ever reads coded symbols.
+    key = jnp.where(nz, l, 16) * jnp.int32(valid_n) + idx
+    symbol = jnp.argsort(key).astype(jnp.int32)
+    return count, symbol, ok
+
+
+def _huff_decode(comp, clen8, bp, ok, count, symbol):
+    """Decode one canonical-Huffman symbol, peeling bits LSB-first
+    against the running first-code-of-length (the native decoder's
+    exact loop). Returns ``(sym or -1, bp, ok)`` — no code of length
+    ≤ 15 matching means a corrupt stream."""
+    n = symbol.shape[0]
+
+    def body(ln, st):
+        code, first, index, bpos, res, found, okk = st
+        bit, bp_n, ok_n = _bits(comp, clen8, bpos, jnp.int32(1), okk)
+        take = ~found & okk
+        valid = take & ok_n
+        code = code | jnp.where(valid, bit, 0)
+        cnt = count[ln]
+        hit = valid & (code - cnt < first)
+        res = jnp.where(
+            hit, symbol[jnp.clip(index + code - first, 0, n - 1)], res
+        )
+        adv = valid & ~hit
+        return (
+            jnp.where(adv, code << 1, code),
+            jnp.where(adv, (first + cnt) << 1, first),
+            jnp.where(adv, index + cnt, index),
+            jnp.where(take, bp_n, bpos),
+            res,
+            found | hit,
+            jnp.where(take, ok_n, okk),
+        )
+
+    code, first, index, bp, res, found, ok = lax.fori_loop(
+        1, 16, body,
+        (jnp.int32(0), jnp.int32(0), jnp.int32(0), bp, jnp.int32(-1),
+         jnp.bool_(False), ok),
+    )
+    return jnp.where(found & ok, res, -1), bp, ok & found
+
+
+def _fixed_tables_np():
+    """The BTYPE=01 fixed litlen/dist tables (RFC 1951 3.2.6), built
+    once in numpy so tracing only sees constants."""
+    lens = np.zeros(288, np.int32)
+    lens[:144] = 8
+    lens[144:256] = 9
+    lens[256:280] = 7
+    lens[280:] = 8
+    dlens = np.full(30, 5, np.int32)
+
+    def build(ls, valid_n):
+        count = np.zeros(16, np.int64)
+        for v in ls:
+            count[v] += 1
+        key = np.where(ls > 0, ls, 16) * valid_n + np.arange(len(ls))
+        return count.astype(np.int32), np.argsort(key).astype(np.int32)
+
+    lc, lsym = build(lens, 288)
+    dc, dsym = build(dlens, 30)
+    return lc, lsym, dc, dsym
+
+
+with jax.ensure_compile_time_eval():
+    _F_LC, _F_LSYM, _F_DC, _F_DSYM = (
+        jnp.asarray(a) for a in _fixed_tables_np()
+    )
+
+#: Every bitstream-constant table, in the Pallas operand order. The XLA
+#: vmap form closes over these as compile-time constants, but
+#: ``pallas_call`` refuses captured array constants — its kernel gets
+#: them as explicit inputs (pallas_kernels.tokenize_pallas) and threads
+#: them back in through ``_tokenize_row``'s ``tabs`` parameter.
+TABLES = (_CL_ORDER, _LEN_BASE, _LEN_EXTRA, _DIST_BASE, _DIST_EXTRA,
+          _F_LC, _F_LSYM, _F_DC, _F_DSYM)
+
+
+def _window_write(buf, start, values, mask):
+    """Masked windowed write: ``buf[start + k] = values[k]`` where
+    ``mask[k]`` — a read-modify-write slice pair, the fixed-shape form
+    of a variable-length emit."""
+    win = lax.dynamic_slice(buf, (start,), (values.shape[0],))
+    return lax.dynamic_update_slice(
+        buf, jnp.where(mask, values, win), (start,)
+    )
+
+
+def _dynamic_tables(comp, clen8, bp, ok, cl_order):
+    """Decode a BTYPE=10 header: HLIT/HDIST/HCLEN, the code-length code,
+    then the run-expanded (16=repeat-prev, 17/18=zero-run) code lengths;
+    build both canonical tables. Mirrors the native decoder's checks:
+    HLIT ≤ 286, HDIST ≤ 30, no repeat-prev at index 0, runs may not
+    overflow HLIT+HDIST, and the litlen table must code symbol 256."""
+    hlit, bp, ok = _bits(comp, clen8, bp, jnp.int32(5), ok)
+    hlit = hlit + 257
+    hdist, bp, ok = _bits(comp, clen8, bp, jnp.int32(5), ok)
+    hdist = hdist + 1
+    hclen, bp, ok = _bits(comp, clen8, bp, jnp.int32(4), ok)
+    hclen = hclen + 4
+    ok = ok & (hlit <= 286) & (hdist <= 30)
+
+    def cl_body(i, st):
+        cl_lens, bpos, okk = st
+        v, bp_n, ok_n = _bits(comp, clen8, bpos, jnp.int32(3), okk)
+        use = i < hclen
+        cl_lens = cl_lens.at[cl_order[i]].set(jnp.where(use, v, 0))
+        return (
+            cl_lens,
+            jnp.where(use, bp_n, bpos),
+            jnp.where(use, ok_n, okk),
+        )
+
+    cl_lens, bp, ok = lax.fori_loop(
+        0, 19, cl_body, (jnp.zeros(19, jnp.int32), bp, ok)
+    )
+    cl_count, cl_sym, cl_ok = _huff_build(cl_lens, jnp.int32(19), 19)
+    ok = ok & cl_ok
+
+    tot = hlit + hdist
+    lens0 = jnp.zeros(_LENS_W, jnp.int32)
+    run_iota = jnp.arange(144, dtype=jnp.int32)
+
+    def run_cond(st):
+        _, cl_i, _, okk = st
+        return okk & (cl_i < tot)
+
+    def run_body(st):
+        lens, cl_i, bpos, okk = st
+        sym, bp1, ok1 = _huff_decode(comp, clen8, bpos, okk, cl_count, cl_sym)
+        ok1 = ok1 & (sym >= 0)
+        # Decode all three extra-bit widths from bp1 and select — cheaper
+        # than a branch, and the unused reads can't fail harder than the
+        # selected one.
+        v2, bp2, ok2 = _bits(comp, clen8, bp1, jnp.int32(2), ok1)
+        v3, bp3, ok3 = _bits(comp, clen8, bp1, jnp.int32(3), ok1)
+        v7, bp7, ok7 = _bits(comp, clen8, bp1, jnp.int32(7), ok1)
+        prev = lens[jnp.clip(cl_i - 1, 0, _LENS_W - 1)]
+        is16 = sym == 16
+        is17 = sym == 17
+        is18 = sym == 18
+        lit_sym = (sym >= 0) & (sym < 16)
+        repeat = jnp.where(
+            lit_sym, 1,
+            jnp.where(is16, 3 + v2, jnp.where(is17, 3 + v3, 11 + v7)),
+        )
+        value = jnp.where(lit_sym, sym, jnp.where(is16, prev, 0))
+        bp_n = jnp.where(
+            lit_sym, bp1, jnp.where(is16, bp2, jnp.where(is17, bp3, bp7))
+        )
+        ok_n = jnp.where(
+            lit_sym, ok1, jnp.where(is16, ok2, jnp.where(is17, ok3, ok7))
+        )
+        ok_n = ok_n & ~(is16 & (cl_i == 0))
+        ok_n = ok_n & (cl_i + repeat <= tot)
+        rep_eff = jnp.where(ok_n, repeat, 0)
+        lens = _window_write(
+            lens, cl_i, jnp.full(144, 1, jnp.int32) * value,
+            run_iota < rep_eff,
+        )
+        return lens, cl_i + rep_eff, bp_n, ok_n
+
+    lens, cl_i, bp, ok = lax.while_loop(
+        run_cond, run_body, (lens0, jnp.int32(0), bp, ok)
+    )
+    ok = ok & (lens[256] > 0)
+    lit_count, lit_sym, lok = _huff_build(lens[:288], hlit, 288)
+    didx = jnp.arange(30, dtype=jnp.int32)
+    dlens = lens[jnp.clip(hlit + didx, 0, _LENS_W - 1)]
+    dist_count, dist_sym, dok = _huff_build(dlens, hdist, 30)
+    return lit_count, lit_sym, dist_count, dist_sym, bp, ok & lok & dok
+
+
+def _tokenize_row(comp, clen, tabs=None):
+    """Tokenize ONE raw-DEFLATE stream.
+
+    ``comp`` is the zero-padded (C_pad,) u8 payload (``bgzf.flat.
+    stage_run_payloads`` staging convention: C_pad ≥ clen + 8 so the
+    4-byte bit reads never leave the row), ``clen`` its real byte
+    length. Returns ``(lit (S,) u8, dist (S,) u16, out_len i32, ok
+    bool)`` — token planes bit-identical to native ``tokenize_one``:
+    ``lit[i]`` is the byte where position ``i`` came from a literal
+    (dist 0), else ``dist[i]`` the back-reference distance; tails
+    beyond ``out_len`` are zero. ``ok`` False ⇔ the native tokenizer
+    would reject the stream (callers demote those rows to host).
+    ``tabs`` overrides the module ``TABLES`` (the Pallas kernel passes
+    its VMEM copies; everyone else closes over the constants)."""
+    (cl_order, len_base, len_extra, dist_base, dist_extra,
+     f_lc, f_lsym, f_dc, f_dsym) = TABLES if tabs is None else tabs
+    clen8 = clen * 8
+    c_pad = comp.shape[0]
+    win_iota = jnp.arange(_WIN, dtype=jnp.int32)
+
+    def stored_block(bp, o, ok, lit_buf, dist_buf):
+        bp = (bp + 7) & ~7
+        ln, bp, ok = _bits(comp, clen8, bp, jnp.int32(16), ok)
+        nln, bp, ok = _bits(comp, clen8, bp, jnp.int32(16), ok)
+        ok = ok & ((ln ^ 0xFFFF) == nln)
+
+        def cond(st):
+            left, _, _, okk, _, _ = st
+            return okk & (left > 0)
+
+        def body(st):
+            left, bpos, oo, okk, lbuf, dbuf = st
+            src = bpos >> 3
+            chunk = jnp.minimum(left, _WIN)
+            okk = okk & (src + chunk <= clen) & (oo + chunk <= _S)
+            chunk = jnp.where(okk, chunk, 0)
+            # Element-clipped gather, NOT a dynamic_slice: a 512-wide
+            # slice near the row's end would clamp its *start* and
+            # silently misread; per-element clipping only pins the
+            # masked-out tail lanes.
+            vals = comp[jnp.clip(src + win_iota, 0, c_pad - 1)]
+            mask = win_iota < chunk
+            lbuf = _window_write(lbuf, oo, vals, mask)
+            dbuf = _window_write(dbuf, oo, jnp.zeros(_WIN, jnp.uint16), mask)
+            return left - chunk, bpos + chunk * 8, oo + chunk, okk, lbuf, dbuf
+
+        left0 = jnp.where(ok, ln, 0)
+        _, bp, o, ok, lit_buf, dist_buf = lax.while_loop(
+            cond, body, (left0, bp, o, ok, lit_buf, dist_buf)
+        )
+        return bp, o, ok, lit_buf, dist_buf
+
+    def huff_block(btype, bp, ok, o, lit_buf, dist_buf):
+        dyn = _dynamic_tables(comp, clen8, bp, ok & (btype == 2), cl_order)
+        is_dyn = btype == 2
+        lit_count = jnp.where(is_dyn, dyn[0], f_lc)
+        lit_sym = jnp.where(is_dyn, dyn[1], f_lsym)
+        dist_count = jnp.where(is_dyn, dyn[2], f_dc)
+        dist_sym = jnp.where(is_dyn, dyn[3], f_dsym)
+        bp = jnp.where(is_dyn, dyn[4], bp)
+        ok = jnp.where(is_dyn, dyn[5], ok)
+        # A symbol consumes ≥ 1 bit, so clen8 + slack bounds the trip
+        # count — the backstop that keeps a corrupt stream from looping.
+        cap_steps = clen8 + 64
+
+        def cond(st):
+            _, _, okk, fin, _, _, steps = st
+            return okk & ~fin & (steps < cap_steps)
+
+        def body(st):
+            bpos, oo, okk, fin, lbuf, dbuf, steps = st
+            sym, bp1, ok1 = _huff_decode(
+                comp, clen8, bpos, okk, lit_count, lit_sym
+            )
+            is_lit = (sym >= 0) & (sym < 256)
+            is_eob = sym == 256
+            is_match = sym > 256
+            ok1 = ok1 & (sym >= 0)
+            sym2 = jnp.clip(sym - 257, 0, 28)
+            # 286/287 are coded-but-invalid litlen symbols.
+            okm = ok1 & ~(is_match & (sym - 257 >= 29))
+            lext = len_extra[sym2]
+            vl, bp2, okm = _bits(comp, clen8, bp1, lext, okm)
+            mlen = len_base[sym2] + vl
+            dsym, bp3, okm = _huff_decode(
+                comp, clen8, bp2, okm, dist_count, dist_sym
+            )
+            okm = okm & (dsym >= 0) & (dsym < 30)
+            dext = dist_extra[jnp.clip(dsym, 0, 29)]
+            vd, bp4, okm = _bits(comp, clen8, bp3, dext, okm)
+            mdist = dist_base[jnp.clip(dsym, 0, 29)] + vd
+            # Distance may not reach before the stream; output may not
+            # overflow the 64 KiB row (BGZF guarantees it fits).
+            okm = okm & (mdist <= oo) & (oo + mlen <= _S)
+            okl = ok1 & (oo < _S)
+            step_ok = jnp.where(is_lit, okl, jnp.where(is_match, okm, ok1))
+            count = jnp.where(
+                step_ok & is_lit, 1, jnp.where(step_ok & is_match, mlen, 0)
+            )
+            lval = jnp.where(is_lit, sym, 0).astype(jnp.uint8)
+            dval = jnp.where(is_match, mdist, 0).astype(jnp.uint16)
+            mask = win_iota < count
+            lbuf = _window_write(
+                lbuf, oo, jnp.full(_WIN, 1, jnp.uint8) * lval, mask
+            )
+            dbuf = _window_write(
+                dbuf, oo, jnp.full(_WIN, 1, jnp.uint16) * dval, mask
+            )
+            bp_n = jnp.where(is_lit | is_eob, bp1, bp4)
+            return (
+                bp_n, oo + count, step_ok, fin | (is_eob & ok1),
+                lbuf, dbuf, steps + 1,
+            )
+
+        bp, o, ok, fin, lit_buf, dist_buf, _ = lax.while_loop(
+            cond, body,
+            (bp, o, ok, jnp.bool_(False), lit_buf, dist_buf, jnp.int32(0)),
+        )
+        # No end-of-block code before the bits ran out ⇒ corrupt.
+        ok = ok & fin
+        return bp, o, ok, lit_buf, dist_buf
+
+    def outer_cond(st):
+        _, _, ok, done, _, _ = st
+        return ~done
+
+    def outer_body(st):
+        bp, o, ok, _, lit_buf, dist_buf = st
+        bfinal, bp, ok = _bits(comp, clen8, bp, jnp.int32(1), ok)
+        btype, bp, ok = _bits(comp, clen8, bp, jnp.int32(2), ok)
+        ok = ok & (btype != 3)
+        is_stored = ok & (btype == 0)
+        s_bp, s_o, s_ok, s_lit, s_dist = stored_block(
+            bp, o, ok & is_stored, lit_buf, dist_buf
+        )
+        h_bp, h_o, h_ok, h_lit, h_dist = huff_block(
+            btype, bp, ok & ~is_stored, o, lit_buf, dist_buf
+        )
+        bp = jnp.where(is_stored, s_bp, h_bp)
+        o = jnp.where(is_stored, s_o, h_o)
+        ok = ok & jnp.where(is_stored, s_ok, h_ok)
+        lit_buf = jnp.where(is_stored, s_lit, h_lit)
+        dist_buf = jnp.where(is_stored, s_dist, h_dist)
+        done = ~ok | (bfinal == 1)
+        return bp, o, ok, done, lit_buf, dist_buf
+
+    bp, o, ok, _, lit_buf, dist_buf = lax.while_loop(
+        outer_cond, outer_body,
+        (jnp.int32(0), jnp.int32(0), jnp.bool_(True), jnp.bool_(False),
+         jnp.zeros(_SP, jnp.uint8), jnp.zeros(_SP, jnp.uint16)),
+    )
+    return lit_buf[:_S], dist_buf[:_S], o, ok
+
+
+@jax.jit
+def tokenize_planes(staged, clens):
+    """XLA form of the device tokenizer: one lane per staged payload row.
+
+    ``staged`` is (B, C_pad) u8 (``stage_run_payloads`` convention),
+    ``clens`` (B,) i32. Returns ``(lit (B, S) u8, dist (B, S) u16,
+    out_lens (B,) i32, ok (B,) bool)``. Zero-length rows (batch pad)
+    come back ``ok=False`` with ``out_len=0`` — callers treat
+    ``clen == 0`` rows as vacuously fine."""
+    return jax.vmap(_tokenize_row)(staged, clens)

@@ -100,7 +100,7 @@ def test_headline_is_e2e_on_device_runs(tmp_path, monkeypatch):
         "e2e_quick": _leg(2.9e9, "host", file_bytes=64 << 20),
     }
     monkeypatch.setattr(
-        bench, "_device_ladder", lambda *a: (results, [], [])
+        bench, "_device_ladder", lambda *a: (results, [], [], [])
     )
     record = {"value": 0, "vs_baseline": 0}
     bench._main_measure(record, [], [])
@@ -120,7 +120,7 @@ def test_headline_quick_leg_stands_in(tmp_path, monkeypatch):
     _fake_synth(tmp_path, monkeypatch)
     results = {"e2e_quick": _leg(2.0e9, "host", file_bytes=64 << 20)}
     monkeypatch.setattr(
-        bench, "_device_ladder", lambda *a: (results, [], [])
+        bench, "_device_ladder", lambda *a: (results, [], [], [])
     )
     record = {"value": 0, "vs_baseline": 0}
     errors = []
@@ -135,7 +135,7 @@ def test_headline_cpu_fallback_stays_steady(tmp_path, monkeypatch):
     """The CPU-backend fallback keeps the steady kernel number as value
     (no device e2e exists) and never claims an e2e source."""
     _fake_synth(tmp_path, monkeypatch)
-    monkeypatch.setattr(bench, "_device_ladder", lambda *a: ({}, [], ["window=32MB: timeout"]))
+    monkeypatch.setattr(bench, "_device_ladder", lambda *a: ({}, [], [], [{"window_mb": 32, "skipped": "timeout", "last_stage": None}]))
     cpu_results = {
         "steady": {
             "steady_pps": 1.25e7, "steady_fused_pps": 1.38e7,
@@ -151,6 +151,9 @@ def test_headline_cpu_fallback_stays_steady(tmp_path, monkeypatch):
     assert record["value"] == round(1.25e7)
     assert record["value_source"] == "steady_kernel"
     assert any("TPU unavailable" in e for e in errors)
+    assert record["ladder_skips"] == [
+        {"window_mb": 32, "skipped": "timeout", "last_stage": None}
+    ]
 
 
 def test_inflate_child_merges_legs(tmp_path, monkeypatch):
@@ -164,7 +167,7 @@ def test_inflate_child_merges_legs(tmp_path, monkeypatch):
         },
         "e2e": _leg(2.5e9, "host"),
     }
-    monkeypatch.setattr(bench, "_device_ladder", lambda *a: (results, [], []))
+    monkeypatch.setattr(bench, "_device_ladder", lambda *a: (results, [], [], []))
 
     def fake_extra(mode, *a, **kw):
         if mode == "inflate":
@@ -186,7 +189,7 @@ def test_headline_resident_leg_competes(tmp_path, monkeypatch):
     its own decomposition fields recorded."""
     _fake_synth(tmp_path, monkeypatch)
     results = {"e2e": _leg(2.5e9, "host")}
-    monkeypatch.setattr(bench, "_device_ladder", lambda *a: (results, [], []))
+    monkeypatch.setattr(bench, "_device_ladder", lambda *a: (results, [], [], []))
 
     def fake_extra(mode, *a, **kw):
         if mode == "resident":
@@ -235,7 +238,7 @@ def test_ladder_skips_when_probe_dead(monkeypatch):
         return {}, ["start"], "timed out after 240s (last stage: start)"
 
     monkeypatch.setattr(bench, "_run_child", fake_child)
-    results, stages, errors = bench._device_ladder("big.bam", 1, "q.bam", 1)
+    results, stages, errors, _skips = bench._device_ladder("big.bam", 1, "q.bam", 1)
     assert results == {}
     assert len(calls) == 1  # probe only, no --child-all rungs
     assert any("skipping device window ladder" in e for e in errors)
@@ -254,11 +257,39 @@ def test_ladder_proceeds_past_healthy_probe(monkeypatch):
         return {"steady": {"pps": 1.0}}, ["start", "backend_ok:tpu"], None
 
     monkeypatch.setattr(bench, "_run_child", fake_child)
-    results, _, errors = bench._device_ladder("big.bam", 1, "q.bam", 1)
+    results, _, errors, _skips = bench._device_ladder("big.bam", 1, "q.bam", 1)
     assert "steady" in results
     assert calls[0] == ["--child-probe"]
     assert calls[1][0] == "--child-all"
     assert not errors
+
+
+def test_ladder_timeout_rungs_become_structured_skips(monkeypatch):
+    """A rung that times out without landing a leg is a ladder fact, not a
+    warning: it lands in the structured ``skips`` list (and from there in
+    the record's ``ladder_skips``), keeping the errors field reserved for
+    evidence someone must read."""
+
+    def fake_child(args, timeout_s):
+        if args == ["--child-probe"]:
+            return (
+                {"probe": {"backend": "tpu"}},
+                ["start", "backend_ok:tpu"], None,
+            )
+        return {}, ["start", "backend_ok:tpu", "steady:warmup"], (
+            "timeout after stages=['start', 'backend_ok:tpu']: wedged"
+        )
+
+    monkeypatch.setattr(bench, "_run_child", fake_child)
+    results, _, errors, skips = bench._device_ladder("big.bam", 1, "q.bam", 1)
+    assert results == {}
+    assert len(skips) == len(bench.WINDOW_LADDER_MB)
+    assert skips[0] == {
+        "window_mb": bench.WINDOW_LADDER_MB[0], "skipped": "timeout",
+        "last_stage": "steady:warmup",
+    }
+    # no free-text timeout warnings duplicate the structured record
+    assert not any("timeout" in e for e in errors)
 
 
 def test_ladder_probe_disabled_by_env(monkeypatch):
@@ -272,6 +303,6 @@ def test_ladder_probe_disabled_by_env(monkeypatch):
         return {"steady": {"pps": 1.0}}, ["start", "backend_ok:tpu"], None
 
     monkeypatch.setattr(bench, "_run_child", fake_child)
-    results, _, _ = bench._device_ladder("big.bam", 1, "q.bam", 1)
+    results, _, _, _skips = bench._device_ladder("big.bam", 1, "q.bam", 1)
     assert "steady" in results
     assert calls[0][0] == "--child-all"
