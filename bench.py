@@ -1137,6 +1137,10 @@ def _child_serve(clients: int = 8, per_client: int = 3, seq_shots: int = 3):
                 # Small windows so one whole-file count spans many rows —
                 # rows from concurrent clients must share dispatches.
                 serve="window=64KB,halo=8KB,batch=8,tick=2",
+                # Knob-only SLO spec: no objectives, but it opts the
+                # service into the tail sampler, so the telemetry "on"
+                # side carries the full stage-2 stack.
+                slo="sample=0.1",
             )
             obs.shutdown()
             obs.configure()
@@ -1185,13 +1189,17 @@ def _child_serve(clients: int = 8, per_client: int = 3, seq_shots: int = 3):
                 # Telemetry A/B on the SAME warm service: identical burst
                 # with the obs registry off (the no-op fast path) vs on
                 # (clients minting trace carriers, worker spans + tick
-                # attribution live). Overhead must stay ≤2% — the "off by
-                # default costs nothing, on costs almost nothing" claim
-                # (docs/observability.md).
+                # attribution live, PLUS the stage-2 stack — ring
+                # scraper, cost accountant rollups and tail sampler all
+                # re-attached to the fresh registry). Overhead must stay
+                # ≤2% — the "off by default costs nothing, on costs
+                # almost nothing" claim (docs/observability.md).
+                ab_per = per_client * 4
+
                 def _burst() -> float:
                     def one(_i):
                         with ServeClient(addr) as c:
-                            for _ in range(per_client):
+                            for _ in range(ab_per):
                                 c.request("count", path=path)
 
                     t0 = time.perf_counter()
@@ -1199,14 +1207,37 @@ def _child_serve(clients: int = 8, per_client: int = 3, seq_shots: int = 3):
                         for f in [ex.submit(one, i)
                                   for i in range(clients)]:
                             f.result()
-                    return clients * per_client / (
+                    return clients * ab_per / (
                         time.perf_counter() - t0
                     )
 
-                obs.shutdown()
-                telemetry_rps_off = _burst()
-                obs.configure()
-                telemetry_rps_on = _burst()
+                # Interleaved A/B pairs, trimmed mean of the per-pair
+                # deltas: a count burst's wall clock is quantized by
+                # the ~250ms device ticks (±1 tick alignment is ±8% on
+                # one burst), so no single burst resolves the
+                # microsecond-per-request telemetry cost — adjacent
+                # off/on pairs cancel machine drift and dropping the
+                # extreme pairs cancels the tick jitter. The
+                # stop/start pair around each flip rebinds ring +
+                # engine + sampler to the CURRENT registry — without
+                # it the service would keep scraping the pre-flip
+                # registry and the "on" side would under-report the
+                # full telemetry cost.
+                offs, ons = [], []
+                for _ in range(4):
+                    service.stop_observability()
+                    obs.shutdown()
+                    offs.append(_burst())
+                    obs.configure()
+                    service.start_observability()
+                    ons.append(_burst())
+                telemetry_rps_off = max(offs)
+                telemetry_rps_on = max(ons)
+                deltas = sorted(
+                    (off - on) / max(off, 1e-9) * 100.0
+                    for off, on in zip(offs, ons)
+                )
+                telemetry_overhead_pct = sum(deltas[1:-1]) / 2.0
                 _emit_stage("serve_telemetry_ab")
             finally:
                 srv.stop()
@@ -1275,10 +1306,7 @@ def _child_serve(clients: int = 8, per_client: int = 3, seq_shots: int = 3):
         "serve_warm_plan_split_resolutions": warm_plan_res,
         "serve_telemetry_rps_off": round(telemetry_rps_off, 1),
         "serve_telemetry_rps_on": round(telemetry_rps_on, 1),
-        "serve_telemetry_overhead_pct": round(
-            (telemetry_rps_off - telemetry_rps_on)
-            / max(telemetry_rps_off, 1e-9) * 100.0, 2
-        ),
+        "serve_telemetry_overhead_pct": round(telemetry_overhead_pct, 2),
     })
 
 
@@ -1296,11 +1324,17 @@ def _child_fabric(clients: int = 16, per_client: int = 4):
        reference every later phase gates against byte-for-byte;
     2. **fabric** — 3 workers behind the router, same load → fabric
        RPS (equal-count + equal-bytes gated);
-    3. **SLO** — seeded latency injection (broadcast ``tune`` of the
-       batcher tick far above the fabric ceiling) pushes client p99
-       over ``slo_p99_ms``; the per-worker autoscaler must pull it
-       back under the SLO within the run (windowed client p99
-       before/after, plus the ``autoscale_moves`` counter);
+    3. **SLO chaos** — the fabric workers run a real burn-rate SLO
+       engine (``--slo``, obs/slo.py); a seeded latency injection
+       (broadcast ``tune`` of the batcher tick far above the fabric
+       ceiling) pushes client p99 over ``slo_p99_ms``. Gates: the
+       fast-window alert fires within one evaluation window of the
+       storm, the autoscaler's FIRST corrective move cites the firing
+       objective in the router's move ledger (``slo_alert:...``), the
+       client p99 recovers under the SLO within the run, and the
+       per-request cost vectors (obs/account.py) sum back to the
+       fleet's global counters within rounding — queue/h2d exact,
+       device share against the ``serve.tick`` histogram;
     4. **failover** — SIGKILL the rendezvous-affinity worker mid-load:
        zero lost requests (every client call must answer — the load
        loop re-raises), equal counts, byte-identical frames, and a
@@ -1407,10 +1441,19 @@ def _child_fabric(clients: int = 16, per_client: int = 4):
             "batch_floor=2,batch_ceil=8,tick_ceil=2,"
             "scanq_floor=8,scanq_ceil=64,planq_floor=8,planq_ceil=64"
         )
+        # The fabric workers run the burn-rate engine on the measured
+        # SLO: a 15s fast window keeps post-storm memory short, 250ms
+        # evaluation cadence bounds alert latency, and the tail sampler
+        # rides along so the chaos leg exercises the full telemetry
+        # stack (ring + engine + accountant + sampler) under load.
+        wslo = (
+            f"serve.latency:p99<{slo:.0f}ms@15s;"
+            "fast=15s;slow=60s;every=250ms;sample=0.1"
+        )
 
         # --- phases 2-4: the fabric --------------------------------------
-        with WorkerPool(workers=3, devices=wdev, serve=spec, env=wenv,
-                        stderr=subprocess.DEVNULL) as pool3:
+        with WorkerPool(workers=3, devices=wdev, serve=spec, slo=wslo,
+                        env=wenv, stderr=subprocess.DEVNULL) as pool3:
             # Sequential warm-up: worker 0 compiles the serve step into
             # the persistent cache, the others disk-hit it; every warm
             # tier is hot before any routed traffic, so affinity AND
@@ -1435,6 +1478,7 @@ def _child_fabric(clients: int = 16, per_client: int = 4):
                 _emit_stage(f"fabric_routed:{rps3:.1f}rps")
 
                 # --- phase 3: latency injection + autoscaler recovery ----
+                t_inject = time.time()
                 with ServeClient(raddr) as c:
                     c.request("tune", tick_ms=inj_tick)
                 windows = []
@@ -1450,14 +1494,96 @@ def _child_fabric(clients: int = 16, per_client: int = 4):
                         c.request("stats")["counters"]
                         .get("autoscale_moves", 0)
                     )
+                    alerts = c.request("alerts")
+                    tel = c.request("telemetry")
                     # Operator restore: workers the windows never
                     # touched hold position (control-loop hysteresis);
                     # reset every knob for the failover phase.
                     c.request("tune", tick_ms=2.0, batch_rows=8,
                               scan_queue=64, plan_queue=64)
+
+                # Alert gate: the storm must show up in the fleet alert
+                # ledger as a firing transition within one fast window
+                # of the injection, and the first corrective move the
+                # autoscaler took must cite the firing objective — the
+                # "why did the fleet downscale" answer is in the ledger,
+                # not in this harness.
+                fired = [
+                    e for e in (alerts.get("ledger") or [])
+                    if e.get("state") == "firing"
+                    and e.get("t", 0.0) >= t_inject - 0.5
+                ]
+                if not fired:
+                    raise AssertionError(
+                        "latency storm never fired the SLO alert: "
+                        f"ledger={alerts.get('ledger')!r}"
+                    )
+                alert_latency_s = fired[0]["t"] - t_inject
+                if alert_latency_s > 15.0:
+                    raise AssertionError(
+                        "SLO alert fired outside the fast window: "
+                        f"{alert_latency_s:.1f}s after injection"
+                    )
+                storm_moves = [
+                    m for m in (alerts.get("moves") or [])
+                    if m.get("t", 0.0) >= t_inject
+                ]
+                first_reason = str(
+                    (storm_moves[0].get("reason") if storm_moves else "")
+                    or ""
+                )
+                if not first_reason.startswith("slo_alert:"):
+                    raise AssertionError(
+                        "first post-injection autoscale move does not "
+                        f"cite the alert: {storm_moves[:3]!r}"
+                    )
+
+                # Cost conservation gate (obs/account.py): the fleet's
+                # per-request vectors must sum back to the global
+                # series. h2d bytes are counted once per row in both
+                # places (exact); queue_ms differs only by per-request
+                # rounding; the device share re-times the tick outside
+                # the obs span, so it gets a small tolerance.
+                totals = (tel.get("accounting") or {}).get("totals") or {}
+                fleet = tel.get("fleet") or {}
+                h2d_ctr = sum(
+                    int(x.get("value") or 0)
+                    for x in fleet.get("counters", [])
+                    if x.get("name") == "serve.h2d_bytes"
+                )
+                queue_hist = sum(
+                    float(h.get("sum") or 0.0)
+                    for h in fleet.get("hists", [])
+                    if h.get("name") == "serve.queue_ms"
+                )
+                tick_hist = sum(
+                    float(h.get("sum") or 0.0)
+                    for h in fleet.get("hists", [])
+                    if h.get("name") == "serve.tick"
+                )
+                acc_h2d = int(totals.get("h2d_bytes") or 0)
+                acc_queue = float(totals.get("queue_ms") or 0.0)
+                acc_device = float(totals.get("device_ms") or 0.0)
+                queue_drift = abs(acc_queue - queue_hist)
+                device_drift = abs(acc_device - tick_hist)
+                if acc_h2d != h2d_ctr:
+                    raise AssertionError(
+                        "cost h2d_bytes diverged from the counter: "
+                        f"{acc_h2d} != {h2d_ctr}"
+                    )
+                if queue_drift > max(1.0, 1e-3 * queue_hist):
+                    raise AssertionError(
+                        "cost queue_ms diverged from the histogram: "
+                        f"{acc_queue} vs {queue_hist}"
+                    )
+                if device_drift > max(5.0, 0.02 * tick_hist):
+                    raise AssertionError(
+                        "cost device_ms diverged from serve.tick: "
+                        f"{acc_device} vs {tick_hist}"
+                    )
                 _emit_stage(
                     f"fabric_slo:{p99_before:.0f}->{p99_after:.0f}ms"
-                    f"/{moves}moves"
+                    f"/{moves}moves/alert@{alert_latency_s:.1f}s"
                 )
 
                 # --- phase 4: SIGKILL the affinity worker mid-load -------
@@ -1509,6 +1635,13 @@ def _child_fabric(clients: int = 16, per_client: int = 4):
         "fabric_p99_after_ms": round(p99_after, 1),
         "fabric_slo_recovered": bool(p99_before > slo > p99_after),
         "fabric_autoscale_moves": moves,
+        "fabric_slo_alert_latency_s": round(alert_latency_s, 2),
+        "fabric_slo_first_move_reason": first_reason,
+        "fabric_slo_move_cites_alert": True,   # gated above
+        "fabric_cost_h2d_bytes": acc_h2d,
+        "fabric_cost_queue_drift_ms": round(queue_drift, 3),
+        "fabric_cost_device_drift_ms": round(device_drift, 3),
+        "fabric_cost_conserved": True,         # gated above
         "fabric_killed_worker": f"w{doomed}",
         "fabric_failovers": failovers,
         "fabric_lost": 0,   # the load loop re-raises; reaching here proves it

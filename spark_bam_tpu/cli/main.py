@@ -113,6 +113,22 @@ def _add_columnar(sub):
     )
 
 
+def _add_slo(sub):
+    sub.add_argument(
+        "--slo", default=None, metavar="SPEC",
+        help="SLO objectives + burn-rate alerting, e.g. "
+             "'serve.latency:p99<1500ms@5m;serve.errors:ratio<0.1%%@1h;"
+             "sample=0.1' (SPARK_BAM_SLO env var works too; "
+             "docs/observability.md)",
+    )
+    sub.add_argument(
+        "--dashboard", default=None, metavar="ADDR",
+        help="serve the zero-dependency live dashboard on host:port — "
+             "HTML sparklines at /, Prometheus text at /metrics, SLO "
+             "burn rates + accounting at /slo (docs/observability.md)",
+    )
+
+
 def _add_deflate(sub):
     sub.add_argument(
         "--deflate", default=None, metavar="SPEC",
@@ -348,6 +364,7 @@ def build_parser() -> argparse.ArgumentParser:
     _add_funnel(sub)
     _add_columnar(sub)
     _add_deflate(sub)
+    _add_slo(sub)
     sub.add_argument(
         "--serve", default=None, metavar="SPEC",
         help="serving knobs, e.g. 'batch=16,tick=2,plan_queue=64,"
@@ -368,6 +385,7 @@ def build_parser() -> argparse.ArgumentParser:
     sub = sp.add_parser("fabric")
     _add_metrics(sub)
     _add_faults(sub)
+    _add_slo(sub)
     sub.add_argument(
         "--fabric", default=None, metavar="SPEC",
         help="fabric knobs, e.g. 'workers=3,slo=200,probe=500,spill=8,"
@@ -421,6 +439,15 @@ def build_parser() -> argparse.ArgumentParser:
              "instead of the human view",
     )
     sub.add_argument(
+        "--watch", action="store_true",
+        help="live mode: clear and re-render every --interval seconds "
+             "(Ctrl-C to stop)",
+    )
+    sub.add_argument(
+        "--interval", type=float, default=2.0, metavar="S",
+        help="--watch refresh cadence in seconds (default 2)",
+    )
+    sub.add_argument(
         "address",
         help="serve worker or fabric router address "
              "(tcp:host:port or unix:path)",
@@ -465,6 +492,75 @@ def build_parser() -> argparse.ArgumentParser:
     )
 
     return ap
+
+
+def _service_dashboard(service, listen: str):
+    """Start a :class:`~spark_bam_tpu.obs.dashboard.DashboardServer`
+    reading one worker's local registry/engine/accountant."""
+    from spark_bam_tpu import obs
+    from spark_bam_tpu.obs import flight
+    from spark_bam_tpu.obs.dashboard import DashboardServer
+
+    def provider():
+        reg = obs.registry()
+        return {
+            "snapshot": reg.snapshot() if reg is not None else {},
+            "series": service.rings.snapshot() if service.rings else None,
+            "slo": (service.slo_engine.status()
+                    if service.slo_engine is not None
+                    else {"enabled": False, "objectives": []}),
+            "accounting": service.accountant.snapshot(),
+            "flight": flight.recorder().events(),
+        }
+
+    return DashboardServer(listen, provider).start()
+
+
+def _router_dashboard(router, listen: str):
+    """Start a dashboard over a fabric router: each request crosses into
+    the router's event loop (``run_coroutine_threadsafe``) and reads the
+    same ``telemetry``/``alerts`` fan-outs clients get. Before the loop
+    runs (no request yet), render the router-local flight ring only."""
+    import asyncio
+
+    from spark_bam_tpu.obs import flight
+    from spark_bam_tpu.obs.dashboard import DashboardServer
+
+    def provider():
+        loop = router._loop
+        if loop is None or not loop.is_running():
+            return {"snapshot": {}, "flight": flight.recorder().events()}
+        tel = asyncio.run_coroutine_threadsafe(
+            router.submit({"op": "telemetry"}), loop
+        ).result(timeout=10)
+        al = asyncio.run_coroutine_threadsafe(
+            router.submit({"op": "alerts"}), loop
+        ).result(timeout=10)
+        # Fleet SLO view: per objective, the worst worker's status.
+        objs: dict = {}
+        for r in (al.get("workers") or {}).values():
+            for st in (r.get("slo") or {}).get("objectives", ()):
+                cur = objs.get(st.get("objective"))
+                if cur is None or (st.get("burn_fast") or 0) > (
+                        cur.get("burn_fast") or 0):
+                    objs[st.get("objective")] = st
+        return {
+            "snapshot": tel.get("fleet") or {},
+            "series": tel.get("series"),
+            "slo": {
+                "enabled": bool(objs),
+                "objectives": sorted(
+                    objs.values(), key=lambda s: s.get("objective") or ""
+                ),
+                "firing": al.get("firing") or [],
+                "ledger": al.get("ledger") or [],
+                "moves": al.get("moves") or [],
+            },
+            "accounting": tel.get("accounting"),
+            "flight": tel.get("flight"),
+        }
+
+    return DashboardServer(listen, provider).start()
 
 
 def main(argv=None) -> int:
@@ -551,6 +647,15 @@ def main(argv=None) -> int:
 
             FabricConfig.parse(args.fabric)  # fail before any work starts
             config = config.replace(fabric=args.fabric)
+        if getattr(args, "slo", None) is not None:
+            from spark_bam_tpu.obs.slo import SloConfig
+
+            SloConfig.parse(args.slo)  # fail before any work starts
+            config = config.replace(slo=args.slo)
+        if getattr(args, "dashboard", None):
+            from spark_bam_tpu.obs.dashboard import parse_listen
+
+            parse_listen(args.dashboard)  # fail before any work starts
         if getattr(args, "listen", None) is not None:
             from spark_bam_tpu.serve import ServeAddress
 
@@ -577,6 +682,11 @@ def main(argv=None) -> int:
     if metrics_out:
         obs.configure()
         metrics_out = obs.resolve_metrics_path(metrics_out)
+    elif config.slo or getattr(args, "dashboard", None):
+        # The SLO engine evaluates against the live registry's ring and
+        # the dashboard scrapes it — both need metrics on even without a
+        # trace file to write.
+        obs.configure()
     # --profile rides the env var so the inflate pipeline (and any
     # fabric worker subprocess inheriting the environment) sees it.
     profile_set = getattr(args, "profile", None)
@@ -742,47 +852,81 @@ def main(argv=None) -> int:
                 f"{service.mesh.devices.size} devices) — Ctrl-C to stop",
                 file=sys.stderr,
             )
+            dash = None
+            if args.dashboard:
+                dash = _service_dashboard(service, args.dashboard)
+                print(f"dashboard on http://{dash.address}/ "
+                      "(/metrics, /slo, /series)", file=sys.stderr)
             try:
                 serve_forever(service, args.listen)
             except KeyboardInterrupt:
                 pass
             finally:
+                if dash is not None:
+                    dash.stop()
                 service.close()
         elif cmd == "fabric":
             import signal as _signal
 
             from spark_bam_tpu.fabric import Router, WorkerPool
+            from spark_bam_tpu.obs import flight
             from spark_bam_tpu.serve import serve_forever
 
             fcfg = config.fabric_config
             pool = WorkerPool(
                 workers=fcfg.workers, devices=args.worker_devices,
                 serve=config.serve, columnar=config.columnar,
-                attach=args.attach,
+                slo=config.slo, attach=args.attach,
             )
             addresses = pool.start()
             router = Router(addresses, config=config, pool=pool)
-            print(
-                f"fabric: routing on {args.listen} over "
-                f"{len(addresses)} workers "
-                f"({'attached' if args.attach else 'launched'}: "
-                f"{', '.join(addresses)}) — Ctrl-C to stop",
-                file=sys.stderr,
-            )
 
             def _graceful(signum, frame):
                 # Drain: stop routing new work; workers get SIGTERM in
                 # the finally and finish their in-flight ticks unshed.
+                flight.record("sigterm", signum=int(signum), who="router")
                 router.draining = True
                 raise KeyboardInterrupt
 
+            # Handler installed BEFORE the announce: a supervisor that
+            # SIGTERMs on seeing the line must still get a clean drain.
             _signal.signal(_signal.SIGTERM, _graceful)
+            dash = None
             try:
+                print(
+                    f"fabric: routing on {args.listen} over "
+                    f"{len(addresses)} workers "
+                    f"({'attached' if args.attach else 'launched'}: "
+                    f"{', '.join(addresses)}) — Ctrl-C to stop",
+                    file=sys.stderr,
+                )
+                if args.dashboard:
+                    dash = _router_dashboard(router, args.dashboard)
+                    print(f"dashboard on http://{dash.address}/ "
+                          "(/metrics, /slo, /series)", file=sys.stderr)
                 serve_forever(router, args.listen)
             except KeyboardInterrupt:
                 pass
+            except BaseException as exc:
+                # The router's own postmortem (satellite of the worker
+                # dumps from PR 11): narrate the crash before unwinding —
+                # a dead router otherwise leaves no artifact naming what
+                # was in flight at the fleet edge.
+                flight.dump_auto("crash", who="router",
+                                 extra={"error": repr(exc),
+                                        "workers": addresses})
+                raise
             finally:
+                if dash is not None:
+                    dash.stop()
                 pool.terminate()
+                # Graceful-path artifact: the drain dump records the
+                # router's routing counters + move ledger tail.
+                flight.dump_auto(
+                    "drain", who="router",
+                    extra={"counters": dict(router.counters),
+                           "moves": list(router.moves)[-32:]},
+                )
         elif cmd == "metrics-report":
             from spark_bam_tpu.cli import metrics_report
 
@@ -790,7 +934,8 @@ def main(argv=None) -> int:
         elif cmd == "top":
             from spark_bam_tpu.cli import top
 
-            top.run(args.address, p, prometheus=args.prometheus)
+            top.run(args.address, p, prometheus=args.prometheus,
+                    watch=args.watch, interval_s=args.interval)
         elif cmd == "lint":
             import spark_bam_tpu as _pkg
             from spark_bam_tpu.analysis import Baseline, render_report, run_lint

@@ -1,13 +1,21 @@
-"""spark-bam-tpu top: one-shot fleet telemetry view.
+"""spark-bam-tpu top: fleet telemetry view (one-shot or ``--watch``).
 
 Scrapes the ``telemetry`` op from a serve worker or fabric router and
 renders the operator's glance view: per-worker health, queue depth,
-per-op p50/p99, and the host/H2D/device ms split the inflate attribution
-gauges carry. Point it at the same address clients use — the op is an
-admin op, so it bypasses admission control and works mid-overload.
+per-op p50/p99, the host/H2D/device ms split the inflate attribution
+gauges carry, SLO burn rates + firing alerts, per-op/per-tenant cost
+rollups, latency exemplars (trace ids of the slowest kept traces —
+feed them to ``metrics-report`` to see the offending tree), and the
+router's autoscale move ledger with each move's cited reason. Point it
+at the same address clients use — the op is an admin op, so it bypasses
+admission control and works mid-overload. ``--watch`` re-scrapes every
+``--interval`` seconds (Ctrl-C to stop).
 """
 
 from __future__ import annotations
+
+import sys
+import time
 
 from spark_bam_tpu.cli.output import Printer
 
@@ -30,6 +38,50 @@ def _hd_split(snapshot) -> str:
     )
 
 
+def _slo_lines(p: Printer, slo: "dict | None", indent: str = "") -> None:
+    """Per-objective burn rates + the firing set (obs/slo.py status)."""
+    if not slo or not slo.get("objectives"):
+        return
+    for st in slo["objectives"]:
+        if not isinstance(st, dict):
+            continue
+        mark = "FIRING" if st.get("firing") else "ok"
+        p.echo(
+            f"{indent}slo {st.get('objective')}: "
+            f"burn={st.get('burn_fast')}x/{st.get('burn_slow')}x "
+            f"value={st.get('value_fast')} [{mark}]"
+        )
+
+
+def _accounting_lines(p: Printer, acc: "dict | None",
+                      indent: str = "") -> None:
+    """Per-tenant cost rollups (obs/account.py snapshot)."""
+    tenants = (acc or {}).get("tenants") or {}
+    if not tenants:
+        return
+    for tenant, a in sorted(tenants.items()):
+        p.echo(
+            f"{indent}tenant {tenant}: n={a.get('requests', 0)} "
+            f"queue={_ms(a.get('queue_ms'))}ms "
+            f"host={_ms(a.get('host_ms'))}ms "
+            f"dev={_ms(a.get('device_ms'))}ms "
+            f"h2d={a.get('h2d_bytes', 0)}B "
+            f"out={a.get('bytes_served', 0)}B"
+        )
+
+
+def _exemplar_lines(p: Printer, snapshot: "dict | None",
+                    indent: str = "") -> None:
+    """Latency exemplars: trace ids of the slowest kept traces — the
+    jump from "p99 is burning" to ``metrics-report``'s trace tree."""
+    for h in (snapshot or {}).get("hists", []):
+        for e in (h.get("exemplars") or [])[:3]:
+            p.echo(
+                f"{indent}exemplar {h['name']}: {_ms(e[0])}ms "
+                f"trace={e[1]}"
+            )
+
+
 def _worker_lines(p: Printer, label: str, tel: dict, indent: str = "") -> None:
     stats = tel.get("stats") or {}
     snap = tel.get("snapshot")
@@ -49,6 +101,9 @@ def _worker_lines(p: Printer, label: str, tel: dict, indent: str = "") -> None:
             f"rows={s.get('rows', 0)} "
             f"p50={_ms(s.get('p50_ms'))}ms p99={_ms(s.get('p99_ms'))}ms"
         )
+    _slo_lines(p, tel.get("slo"), indent=indent + "  ")
+    _accounting_lines(p, tel.get("accounting"), indent=indent + "  ")
+    _exemplar_lines(p, snap, indent=indent + "  ")
 
 
 def _render_fabric(p: Printer, resp: dict) -> None:
@@ -75,6 +130,15 @@ def _render_fabric(p: Printer, resp: dict) -> None:
             continue
         p.echo(head)
         _worker_lines(p, "worker", tel, indent="  ")
+    _accounting_lines(p, resp.get("accounting"), indent="")
+    moves = (resp.get("moves") or [])[-5:]
+    if moves:
+        p.echo("autoscale moves:")
+        for m in moves:
+            fields = " ".join(
+                f"{k}={v}" for k, v in sorted((m.get("move") or {}).items())
+            )
+            p.echo(f"  {m.get('worker')}: {fields} ({m.get('reason')})")
     flight_tail = (resp.get("flight") or [])[-5:]
     if flight_tail:
         p.echo("recent flight events:")
@@ -87,12 +151,7 @@ def _render_fabric(p: Printer, resp: dict) -> None:
             p.echo(f"  {kind} {rest}")
 
 
-def run(address: str, p: Printer, prometheus: bool = False) -> None:
-    from spark_bam_tpu.serve.client import ServeClient
-
-    fields = {"prometheus": True} if prometheus else {}
-    with ServeClient(address) as client:
-        resp = client.request("telemetry", **fields)
+def _render_once(p: Printer, resp: dict, prometheus: bool) -> None:
     if prometheus:
         if resp.get("prometheus") is not None:
             p.echo(resp["prometheus"].rstrip("\n"))
@@ -106,3 +165,27 @@ def run(address: str, p: Printer, prometheus: bool = False) -> None:
         _render_fabric(p, resp)
     else:
         _worker_lines(p, "worker", resp)
+
+
+def run(address: str, p: Printer, prometheus: bool = False,
+        watch: bool = False, interval_s: float = 2.0) -> None:
+    from spark_bam_tpu.serve.client import ServeClient
+
+    fields = {"prometheus": True} if prometheus else {}
+    with ServeClient(address) as client:
+        resp = client.request("telemetry", **fields)
+        if not watch:
+            _render_once(p, resp, prometheus)
+            return
+        try:
+            while True:
+                # ANSI clear + home, straight to the terminal (the
+                # Printer may be teed to a file; the control codes are
+                # display-only).
+                sys.stderr.write("\x1b[2J\x1b[H")
+                sys.stderr.flush()
+                _render_once(p, resp, prometheus)
+                time.sleep(max(0.1, float(interval_s)))
+                resp = client.request("telemetry", **fields)
+        except KeyboardInterrupt:
+            pass

@@ -163,6 +163,13 @@ class Config:
     # health probe/eject pacing, and the SLO autoscaler's target and
     # actuation floors/ceilings.
     fabric: str = ""
+    # --- SLO objectives + burn-rate alerting (obs/slo.py) ---
+    # Compact SloConfig spec ("serve.latency:p99<1500ms@5m;
+    # serve.errors:ratio<0.1%@1h;sample=0.1"; "" = disabled). Same
+    # string-spec pattern; ``slo_config`` parses it (cached). Governs the
+    # serve-side SLO engine's objectives, alerting windows/threshold, and
+    # the tail sampler's keep fraction/seed (docs/observability.md).
+    slo: str = ""
     # --- candidate funnel (tpu/checker.py; docs/design.md) ---
     # Two-stage checker hot path: cheap fixed-block prefilter over every
     # position, full 19-flag pass only on survivors. "auto" (default)
@@ -263,6 +270,13 @@ class Config:
         from spark_bam_tpu.fabric.config import FabricConfig
 
         return FabricConfig.parse(self.fabric)
+
+    @property
+    def slo_config(self):
+        """The parsed ``SloConfig`` for this config's ``slo`` spec."""
+        from spark_bam_tpu.obs.slo import SloConfig
+
+        return SloConfig.parse(self.slo)
 
     def funnel_enabled(self, full_masks: bool = False) -> bool:
         """Whether a projection should run the two-stage candidate funnel.

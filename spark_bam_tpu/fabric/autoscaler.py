@@ -1,21 +1,30 @@
-"""SLO autoscaler: per-worker control loop holding a target p99.
+"""SLO autoscaler: per-worker control loop steering on burn rate.
 
 Every ``autoscale_ms`` the loop reads the worker's ``stats`` op — the
-SAME per-op p50/p99 ledger operators read, not a private side channel —
-and compares the overall ``latency_p99_ms`` against
-``FabricConfig.slo_p99_ms``:
+SAME per-op p50/p99 ledger operators read, not a private side channel.
+When the worker runs an SLO engine (``--slo``, obs/slo.py), its stats
+carry a compact ``slo`` block (``max_burn_fast`` + firing objective
+names) and the loop steers on THAT — a windowed burn rate is a far
+steadier signal than one p99 sample, and a move taken during an alert
+cites the firing objective in the router's move ledger (the operator can
+answer "why did the fleet downscale" from the ``alerts`` op alone):
 
-- p99 ABOVE the SLO → step every knob toward its floor (halve
-  ``batch_rows`` and ``tick_ms``, halve the scan/plan admission caps):
-  smaller ticks finish sooner, lower caps shed earlier so queue wait
-  stops compounding the tail.
-- p99 under HALF the SLO → step gently toward the ceilings (+25%):
-  reclaim batching throughput when latency headroom is back.
+- burn ≥ 1 on the fast window (or any objective firing) → step every
+  knob toward its floor (halve ``batch_rows`` and ``tick_ms``, halve the
+  scan/plan admission caps): smaller ticks finish sooner, lower caps
+  shed earlier so queue wait stops compounding the tail.
+- burn under 0.5 → step gently toward the ceilings (+25%): reclaim
+  batching throughput when the budget has headroom.
 - otherwise, or when no new requests were served since the last look
   (no fresh samples), hold — hysteresis against flapping on stale tails.
 
-Decisions are pure (:func:`decide` — unit-testable); actuation is one
-``tune`` op per move (counted ``autoscale_moves``). Floors/ceilings live
+Without an SLO engine the loop falls back to the PR 13 behavior:
+``latency_p99_ms`` against ``FabricConfig.slo_p99_ms`` with the same
+above/half thresholds.
+
+Decisions are pure (:func:`decide_with_reason` — unit-testable);
+actuation is one ``tune`` op per move (counted ``autoscale_moves``, each
+reported to the router's ledger via ``note_move``). Floors/ceilings live
 in :class:`~spark_bam_tpu.fabric.config.FabricConfig`; the worker
 applies whatever it is told (serve/service.py ``tune``).
 """
@@ -33,33 +42,63 @@ def _up(value, ceil):
     return min(ceil, max(value + 1, value * 1.25))
 
 
-def decide(stats: dict, fcfg) -> "dict | None":
-    """The tune fields (if any) for one worker given its ``stats`` payload.
+def _direction(stats: dict, fcfg) -> "tuple[int, str | None]":
+    """(+1 scale up, -1 scale down, 0 hold) plus the cited reason.
 
-    Returns None to hold. Values are already clamped to the config's
-    floors/ceilings; ints stay ints (batch_rows/caps), tick stays float.
-    """
+    Burn rate wins when the worker reports an SLO block with data (any
+    measured value burns > 0, so burn == 0 means "no samples yet" and
+    falls through to the p99 path)."""
+    slo = stats.get("slo") or {}
+    burn = float(slo.get("max_burn_fast") or 0.0)
+    firing = list(slo.get("firing") or ())
+    if firing:
+        return -1, f"slo_alert:{firing[0]} burn={round(burn, 2)}"
+    if burn > 0.0:
+        worst = slo.get("worst")
+        if burn >= 1.0:
+            return -1, f"burn={round(burn, 2)} worst={worst}"
+        if burn < 0.5:
+            return 1, f"burn={round(burn, 2)}<0.5"
+        return 0, None
     p99 = stats.get("latency_p99_ms")
     if p99 is None:
-        return None
+        return 0, None
+    if p99 > fcfg.slo_p99_ms:
+        return -1, f"p99={p99}ms>slo={fcfg.slo_p99_ms}ms"
+    if p99 < 0.5 * fcfg.slo_p99_ms:
+        return 1, f"p99={p99}ms<0.5*slo"
+    return 0, None
+
+
+def decide_with_reason(stats: dict,
+                       fcfg) -> "tuple[dict | None, str | None]":
+    """The tune fields (if any) for one worker given its ``stats``
+    payload, plus the human-readable reason the move cites (the router's
+    move ledger / flight entries).
+
+    Returns (None, None) to hold. Values are already clamped to the
+    config's floors/ceilings; ints stay ints (batch_rows/caps), tick
+    stays float.
+    """
+    direction, reason = _direction(stats, fcfg)
+    if direction == 0:
+        return None, None
     batch = int(stats.get("batch_rows") or 1)
     tick = float(stats.get("tick_ms") or 0.0)
     limits = stats.get("limits") or {}
     scanq = int(limits.get("scan") or fcfg.scanq_ceil)
     planq = int(limits.get("plan") or fcfg.planq_ceil)
     move: dict = {}
-    if p99 > fcfg.slo_p99_ms:
+    if direction < 0:
         new_batch = int(_down(min(batch, fcfg.batch_ceil), fcfg.batch_floor))
         new_tick = float(_down(min(tick, fcfg.tick_ceil), fcfg.tick_floor))
         new_scanq = int(_down(min(scanq, fcfg.scanq_ceil), fcfg.scanq_floor))
         new_planq = int(_down(min(planq, fcfg.planq_ceil), fcfg.planq_floor))
-    elif p99 < 0.5 * fcfg.slo_p99_ms:
+    else:
         new_batch = int(_up(batch, fcfg.batch_ceil))
         new_tick = min(float(_up(tick, fcfg.tick_ceil)), fcfg.tick_ceil)
         new_scanq = int(_up(scanq, fcfg.scanq_ceil))
         new_planq = int(_up(planq, fcfg.planq_ceil))
-    else:
-        return None
     if new_batch != batch:
         move["batch_rows"] = new_batch
     if abs(new_tick - tick) > 1e-9:
@@ -68,12 +107,19 @@ def decide(stats: dict, fcfg) -> "dict | None":
         move["scan_queue"] = new_scanq
     if new_planq != planq:
         move["plan_queue"] = new_planq
-    return move or None
+    return (move, reason) if move else (None, None)
 
 
-async def autoscale_worker(link, fcfg, count) -> None:
+def decide(stats: dict, fcfg) -> "dict | None":
+    """Back-compat wrapper: just the move dict (or None to hold)."""
+    move, _ = decide_with_reason(stats, fcfg)
+    return move
+
+
+async def autoscale_worker(link, fcfg, count, note_move=None) -> None:
     """Control loop for one worker link; ``count`` is the router's
-    counter hook (``autoscale_moves``)."""
+    counter hook (``autoscale_moves``), ``note_move`` its move-ledger
+    hook — called with ``{worker, move, reason}`` per actuated move."""
     prev_served = None
     while True:
         await asyncio.sleep(fcfg.autoscale_ms / 1000.0)
@@ -89,12 +135,15 @@ async def autoscale_worker(link, fcfg, count) -> None:
         if prev_served is not None and served == prev_served:
             continue                 # no fresh samples → hold
         prev_served = served
-        move = decide(stats, fcfg)
+        move, reason = decide_with_reason(stats, fcfg)
         if not move:
             continue
         try:
             await link.request({"op": "tune", **move})
             count("autoscale_moves")
+            if note_move is not None:
+                note_move({"worker": link.wid, "move": move,
+                           "reason": reason})
         except asyncio.CancelledError:
             raise
         except Exception:

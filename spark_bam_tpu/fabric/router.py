@@ -34,6 +34,8 @@ import asyncio
 import hashlib
 import json
 import struct
+import time
+from collections import deque
 
 from spark_bam_tpu import obs
 from spark_bam_tpu.core.config import Config
@@ -230,8 +232,13 @@ class Router:
         self.pool = pool            # optional WorkerPool (drain → terminate)
         self.draining = False
         self.counters: "dict[str, int]" = {}
+        # Autoscale move ledger: {t, worker, move, reason} — the reason
+        # cites the firing SLO objective when one drove the move, so the
+        # ``alerts`` op answers "why did the fleet downscale" by itself.
+        self.moves: "deque[dict]" = deque(maxlen=256)
         self._tasks: "list[asyncio.Task]" = []
         self._start_task: "asyncio.Task | None" = None
+        self._loop: "asyncio.AbstractEventLoop | None" = None
 
     # ------------------------------------------------------------ lifecycle
     async def ensure_started(self) -> None:
@@ -245,6 +252,9 @@ class Router:
         await self._start_task
 
     async def _start(self) -> None:
+        # Captured for cross-thread read-side callers (the dashboard's
+        # provider thread schedules coroutines onto this loop).
+        self._loop = asyncio.get_running_loop()
         for link in self.links:
             try:
                 await link.connect()
@@ -258,7 +268,8 @@ class Router:
                 monitor_worker(link, self.fcfg, self._count)
             ))
             self._tasks.append(asyncio.ensure_future(
-                autoscale_worker(link, self.fcfg, self._count)
+                autoscale_worker(link, self.fcfg, self._count,
+                                 note_move=self._note_move)
             ))
 
     async def aclose(self) -> None:
@@ -275,6 +286,14 @@ class Router:
         # lint: allow[obs-contract] name bounded by Router's literal
         # _count call sites, all enumerated in obs/names.py
         obs.count(f"fabric.{name}", n)
+
+    def _note_move(self, entry: dict) -> None:
+        """Autoscaler move-ledger hook: stamp and retain the move (with
+        its cited reason — the firing objective when an alert drove it)
+        and mirror it into the flight recorder."""
+        entry = dict(entry, t=round(time.time(), 3))
+        self.moves.append(entry)
+        flight.record("autoscale_move", **entry)
 
     # ------------------------------------------------------------ placement
     def healthy_links(self, exclude=()) -> "list[WorkerLink]":
@@ -319,6 +338,8 @@ class Router:
             return await self._tune(req)
         if op == "telemetry":
             return await self._telemetry(req)
+        if op == "alerts":
+            return await self._alerts(req)
         if self.draining:
             return error_response(
                 req, "Draining", "fabric is draining; route elsewhere",
@@ -483,7 +504,31 @@ class Router:
         return ok_response(
             req, fabric=True, draining=bool(self.draining),
             counters=dict(sorted(self.counters.items())),
+            moves=list(self.moves),
             workers=workers,
+        )
+
+    async def _alerts(self, req: dict) -> dict:
+        """Fleet alert view: every healthy worker's SLO status plus the
+        router's autoscale move ledger — the one payload that answers
+        "what is firing and what did the fleet do about it" (the CI
+        failure artifact and the dashboard's /slo both read this)."""
+        links = [l for l in self.links if l.healthy]
+        per_worker = await self._forward_admin({"op": "alerts"}, links)
+        firing = sorted({
+            name
+            for r in per_worker.values()
+            for name in (r.get("slo") or {}).get("firing", ())
+        })
+        ledger = sorted(
+            (dict(e, worker=w)
+             for w, r in per_worker.items()
+             for e in (r.get("slo") or {}).get("ledger", ())),
+            key=lambda e: e.get("t", 0.0),
+        )
+        return ok_response(
+            req, fabric=True, firing=firing, ledger=ledger,
+            moves=list(self.moves), workers=per_worker,
         )
 
     async def _telemetry(self, req: dict) -> dict:
@@ -493,10 +538,12 @@ class Router:
         ``prometheus: true`` the merged snapshot is also rendered in the
         exposition text format (one scrape endpoint for the whole
         fabric)."""
+        from spark_bam_tpu.obs.account import merge_accounting
         from spark_bam_tpu.obs.exporters import (
             merge_snapshots,
             prometheus_text,
         )
+        from spark_bam_tpu.obs.timeseries import merge_series
 
         links = list(self.links)
         fwd = {"op": "telemetry"}
@@ -533,8 +580,17 @@ class Router:
             "fabric": True,
             "draining": bool(self.draining),
             "counters": dict(sorted(self.counters.items())),
+            "moves": list(self.moves),
             "workers": workers,
             "fleet": merged,
+            # Fleet-wide time-series rings (cadence-bucketed sums) and
+            # per-op/per-tenant cost rollups, merged across workers.
+            "series": merge_series([
+                t["series"] for t in upstream if t and t.get("series")
+            ]),
+            "accounting": merge_accounting([
+                t.get("accounting") for t in upstream if t
+            ]),
             "flight": flight.recorder().events(),
         }
         if req.get("prometheus"):

@@ -40,6 +40,7 @@ def serve_worker(
     devices: int = 0,
     serve: str = "",
     columnar: str = "",
+    slo: str = "",
     coordinator: "str | None" = None,
     num_processes: int = 1,
     process_id: int = 0,
@@ -86,6 +87,8 @@ def serve_worker(
         config = config.replace(serve=serve)
     if columnar:
         config = config.replace(columnar=columnar)
+    if slo:
+        config = config.replace(slo=slo)
     service = SplitService(config, mesh=local_mesh())
 
     stop = threading.Event()
@@ -155,12 +158,14 @@ class WorkerPool:
     """
 
     def __init__(self, workers: int = 3, devices: int = 1, serve: str = "",
-                 columnar: str = "", attach: "list[str] | None" = None,
+                 columnar: str = "", slo: str = "",
+                 attach: "list[str] | None" = None,
                  env: "dict | None" = None, stderr=None):
         self.workers = int(workers)
         self.devices = int(devices)
         self.serve = serve
         self.columnar = columnar
+        self.slo = slo
         self.attach = list(attach or [])
         self.env = env
         self.stderr = stderr
@@ -187,6 +192,8 @@ class WorkerPool:
                 cmd += ["--serve", self.serve]
             if self.columnar:
                 cmd += ["--columnar", self.columnar]
+            if self.slo:
+                cmd += ["--slo", self.slo]
             self.procs.append(subprocess.Popen(
                 cmd, stdout=subprocess.PIPE, stderr=self.stderr,
                 env=env, text=True,
@@ -258,13 +265,16 @@ def main(argv=None) -> int:
     ap.add_argument("--serve", default="", help="ServeConfig spec override")
     ap.add_argument("--columnar", default="",
                     help="ColumnarConfig spec override")
+    ap.add_argument("--slo", default="",
+                    help="SloConfig spec override (objectives + burn-rate "
+                         "alerting, obs/slo.py)")
     ap.add_argument("--coordinator", default=None)
     ap.add_argument("--num-processes", type=int, default=1)
     ap.add_argument("--process-id", type=int, default=0)
     a = ap.parse_args(argv)
     return serve_worker(
         listen=a.listen, devices=a.devices, serve=a.serve,
-        columnar=a.columnar, coordinator=a.coordinator,
+        columnar=a.columnar, slo=a.slo, coordinator=a.coordinator,
         num_processes=a.num_processes, process_id=a.process_id,
     )
 

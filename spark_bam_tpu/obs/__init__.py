@@ -14,7 +14,7 @@ Everything is a shared no-op until ``obs.configure()`` runs (the CLI's
 ``--metrics-out`` / the ``SPARK_BAM_METRICS_OUT`` env var does this).
 """
 
-from spark_bam_tpu.obs import flight, trace
+from spark_bam_tpu.obs import account, flight, sampler, slo, timeseries, trace
 from spark_bam_tpu.obs.noise import install_noise_filter
 from spark_bam_tpu.obs.registry import (
     NOOP,
@@ -45,6 +45,7 @@ __all__ = [
     "Histogram",
     "Registry",
     "Span",
+    "account",
     "configure",
     "count",
     "counter",
@@ -58,7 +59,10 @@ __all__ = [
     "read_jsonl",
     "registry",
     "resolve_metrics_path",
+    "sampler",
     "shutdown",
+    "slo",
     "span",
+    "timeseries",
     "trace",
 ]

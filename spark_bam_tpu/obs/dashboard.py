@@ -1,0 +1,192 @@
+"""Zero-dependency HTTP dashboard: /metrics, /slo, /series, and HTML.
+
+``--dashboard host:port`` on ``serve``/``fabric`` starts one of these
+next to the accept loop (stdlib ``http.server`` on a daemon thread — no
+web framework, no static assets):
+
+- ``GET /metrics`` — Prometheus text exposition of the current (fleet-
+  merged, on a router) snapshot, exemplar comments included;
+- ``GET /slo``     — JSON: SLO statuses, burn rates, the alert ledger,
+  and the accounting rollups (the CI failure artifact grabs this);
+- ``GET /series``  — JSON time-series ring snapshot (sparkline feed);
+- ``GET /``        — a self-contained HTML page (inline JS/SVG, no CDN)
+  polling /series + /slo and drawing per-series sparklines with burn
+  badges — the "is the fleet ok" page (docs/observability.md).
+
+The server owns no state: a ``provider`` callable assembles the payload
+per request — a worker's provider reads its local registry/engine, the
+router's crosses the event-loop boundary via
+``asyncio.run_coroutine_threadsafe`` (cli/main.py wires both).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+_PAGE = """<!doctype html>
+<html><head><meta charset="utf-8"><title>spark-bam-tpu fleet</title>
+<style>
+ body{font-family:ui-monospace,monospace;background:#111;color:#ddd;
+      margin:1.5em}
+ h1{font-size:1.1em} h2{font-size:1em;color:#9ad;margin:1.2em 0 .3em}
+ .slo{display:inline-block;margin:.2em .6em .2em 0;padding:.25em .6em;
+      border-radius:4px;background:#263}
+ .slo.firing{background:#a33}
+ .row{display:flex;align-items:center;gap:.8em;margin:.15em 0}
+ .name{width:22em;overflow:hidden;text-overflow:ellipsis;color:#aaa}
+ .val{width:8em;text-align:right}
+ svg{background:#181818;border-radius:3px}
+ #err{color:#f88}
+</style></head><body>
+<h1>spark-bam-tpu fleet dashboard</h1>
+<div id="slo"></div><div id="err"></div>
+<h2>series</h2><div id="series"></div>
+<script>
+function spark(pts){
+  if(!pts.length) return '';
+  const W=220,H=26,vs=pts.map(p=>p[1]);
+  const lo=Math.min(...vs),hi=Math.max(...vs),span=(hi-lo)||1;
+  const t0=pts[0][0],t1=pts[pts.length-1][0],dt=(t1-t0)||1;
+  const d=pts.map((p,i)=>(i?'L':'M')+((p[0]-t0)/dt*W).toFixed(1)+','+
+    (H-2-(p[1]-lo)/span*(H-4)).toFixed(1)).join(' ');
+  return '<svg width="'+W+'" height="'+H+'"><path d="'+d+
+    '" fill="none" stroke="#6cf" stroke-width="1.2"/></svg>';
+}
+function fmt(v){
+  if(v==null) return '-';
+  if(Math.abs(v)>=1e9) return (v/1e9).toFixed(1)+'G';
+  if(Math.abs(v)>=1e6) return (v/1e6).toFixed(1)+'M';
+  if(Math.abs(v)>=1e3) return (v/1e3).toFixed(1)+'k';
+  return (Math.round(v*100)/100).toString();
+}
+async function tick(){
+  try{
+    const slo=await (await fetch('slo')).json();
+    const ser=await (await fetch('series')).json();
+    document.getElementById('err').textContent='';
+    const objs=(slo.slo&&slo.slo.objectives)||[];
+    document.getElementById('slo').innerHTML=objs.length?
+      objs.map(o=>'<span class="slo'+(o.firing?' firing':'')+'">'+
+        o.objective+' burn '+fmt(o.burn_fast)+'×</span>').join(''):
+      '<span class="name">no SLO objectives configured</span>';
+    const rows=(ser.series||[]).filter(s=>s.points.length>1)
+      .sort((a,b)=>a.name<b.name?-1:1).map(s=>{
+        const pts=s.kind==='hist'?s.points.map(p=>[p[0],p[1]]):s.points;
+        const last=pts[pts.length-1][1];
+        return '<div class="row"><span class="name">'+s.name+
+          (s.kind==='hist'?' (count)':'')+'</span>'+spark(pts)+
+          '<span class="val">'+fmt(last)+'</span></div>';
+      });
+    document.getElementById('series').innerHTML=rows.join('');
+  }catch(e){document.getElementById('err').textContent='scrape: '+e;}
+}
+tick();setInterval(tick,2000);
+</script></body></html>
+"""
+
+
+def parse_listen(spec: str) -> "tuple[str, int]":
+    """``"host:port"`` (or ``":port"`` / bare ``"port"``) → (host, port);
+    port 0 binds an ephemeral port (tests)."""
+    spec = str(spec).strip()
+    if ":" in spec:
+        host, _, port = spec.rpartition(":")
+        host = host or "127.0.0.1"
+    else:
+        host, port = "127.0.0.1", spec
+    try:
+        return host, int(port)
+    except ValueError as exc:
+        raise ValueError(
+            f"Bad dashboard address {spec!r}: expected host:port"
+        ) from exc
+
+
+class DashboardServer:
+    """The HTTP surface over one ``provider()`` payload assembler.
+
+    ``provider()`` returns a dict with (any of) ``snapshot``, ``slo``,
+    ``series``, ``accounting``, ``flight`` — missing keys render empty,
+    a raising provider answers 503, and the accept loop is never
+    touched: this is a *read-side* plane.
+    """
+
+    def __init__(self, listen: str, provider):
+        self.host, self.port = parse_listen(listen)
+        self.provider = provider
+        self._httpd: "ThreadingHTTPServer | None" = None
+        self._thread: "threading.Thread | None" = None
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def start(self) -> "DashboardServer":
+        from spark_bam_tpu.obs.exporters import prometheus_text
+
+        provider = self.provider
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):    # no per-request stderr noise
+                pass
+
+            def _send(self, code: int, ctype: str, body: bytes) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0].rstrip("/") or "/"
+                if path == "/":
+                    self._send(200, "text/html; charset=utf-8",
+                               _PAGE.encode())
+                    return
+                try:
+                    payload = provider() or {}
+                except Exception as exc:
+                    self._send(503, "text/plain",
+                               f"provider error: {exc}".encode())
+                    return
+                if path == "/metrics":
+                    snap = payload.get("snapshot") or {}
+                    self._send(200, "text/plain; version=0.0.4",
+                               prometheus_text(snap).encode())
+                elif path == "/slo":
+                    body = json.dumps({
+                        "slo": payload.get("slo"),
+                        "accounting": payload.get("accounting"),
+                        "flight": payload.get("flight"),
+                    }, sort_keys=True).encode()
+                    self._send(200, "application/json", body)
+                elif path == "/series":
+                    body = json.dumps(
+                        payload.get("series")
+                        or {"cadence_ms": 0, "series": []},
+                        sort_keys=True,
+                    ).encode()
+                    self._send(200, "application/json", body)
+                else:
+                    self._send(404, "text/plain", b"not found\n")
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="obs-dashboard",
+            daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        httpd, self._httpd = self._httpd, None
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5)

@@ -96,6 +96,15 @@ def prometheus_text(snapshot: dict) -> str:
                 out.append(f"{name}{_prom_labels(ql)} {values[idx]}")
         out.append(f"{name}_sum{_prom_labels(labels)} {h['sum']}")
         out.append(f"{name}_count{_prom_labels(labels)} {h['count']}")
+        # Tail-sampler exemplars as comment lines: the classic text
+        # format has no exemplar syntax (that's OpenMetrics), and a
+        # comment keeps every scraper happy while still shipping the
+        # trace ids next to the series they explain.
+        for e in h.get("exemplars", []) or ():
+            out.append(
+                f"# exemplar {name}"
+                f'{{trace_id="{_prom_escape(e[1])}"}} {e[0]}'
+            )
     return "\n".join(out) + "\n"
 
 
@@ -199,9 +208,15 @@ def merge_snapshots(snapshots: list[dict]) -> dict:
                 key(h), {"name": h["name"],
                          "labels": dict(h.get("labels", {})),
                          "count": 0, "sum": 0.0, "min": None, "max": None,
-                         "values": []})
+                         "values": [], "exemplars": []})
             cur["count"] += h["count"]
             cur["sum"] += h["sum"]
+            if h.get("exemplars"):
+                from spark_bam_tpu.obs.sampler import merge_exemplars
+
+                cur["exemplars"] = merge_exemplars(
+                    [cur["exemplars"], h["exemplars"]]
+                )
             for bound, better in (("min", lambda a, b: b < a),
                                   ("max", lambda a, b: b > a)):
                 v = h.get(bound)
@@ -211,6 +226,9 @@ def merge_snapshots(snapshots: list[dict]) -> dict:
             room = _HIST_SAMPLE_CAP - len(cur["values"])
             if room > 0:
                 cur["values"].extend(h.get("values", [])[:room])
+    for cur in hists.values():
+        if not cur["exemplars"]:
+            del cur["exemplars"]
     return {
         "counters": list(counters.values()),
         "gauges": list(gauges.values()),

@@ -22,12 +22,15 @@ from __future__ import annotations
 #: Layer prefixes (the segment before the first dot). A new layer means
 #: a new subsystem — add it here alongside its names.
 LAYERS = frozenset({
-    "bgzf", "cache", "chaos", "check", "cli", "columnar", "compress",
-    "deflate", "fabric", "faults", "funnel", "guard", "inflate", "load",
-    "mesh", "progress", "remote", "serve", "timer",
+    "account", "bgzf", "cache", "chaos", "check", "cli", "columnar",
+    "compress", "deflate", "fabric", "faults", "funnel", "guard",
+    "inflate", "load", "mesh", "progress", "remote", "sampler", "serve",
+    "slo", "timer", "ts",
 })
 
 NAMES = frozenset({
+    # account — per-request cost accounting (obs/account.py)
+    "account.requests", "account.tenants",
     # bgzf — block streaming (docs/design.md)
     "bgzf.blocks_read", "bgzf.blocks_scanned", "bgzf.bytes_inflated",
     "bgzf.bytes_read", "bgzf.read",
@@ -97,12 +100,19 @@ NAMES = frozenset({
     "remote.evictions", "remote.get_ms", "remote.gets", "remote.hedge_wins",
     "remote.hedges", "remote.plan_segments", "remote.quota_wait_ms",
     "remote.stalls", "remote.unplanned_gets",
+    # sampler — tail-based trace sampling (obs/sampler.py)
+    "sampler.dropped", "sampler.exemplars", "sampler.kept",
     # serve — split-service daemon (docs/serving.md)
     "serve.batch_encode", "serve.batch_rows", "serve.batches",
-    "serve.connections", "serve.device_dispatch", "serve.latency_ms",
-    "serve.overloaded", "serve.parse", "serve.queue_depth", "serve.queue_ms",
-    "serve.request", "serve.requests", "serve.rewrite", "serve.shed",
-    "serve.tick", "serve.tuned",
+    "serve.connections", "serve.device_dispatch", "serve.errors",
+    "serve.h2d_bytes", "serve.latency_ms", "serve.overloaded",
+    "serve.parse", "serve.queue_depth", "serve.queue_ms", "serve.request",
+    "serve.requests", "serve.rewrite", "serve.shed", "serve.tick",
+    "serve.tuned",
+    # slo — burn-rate objective engine (obs/slo.py)
+    "slo.alerts", "slo.burn_rate", "slo.evals", "slo.firing",
+    # ts — time-series ring scraper (obs/timeseries.py)
+    "ts.scrapes", "ts.series",
 })
 
 

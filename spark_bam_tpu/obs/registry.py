@@ -90,10 +90,21 @@ class Histogram:
     up to ``_HIST_SAMPLE_CAP``, then reservoir-downsampled (algorithm R)
     so hot serve paths never grow memory while quantiles stay a uniform
     sample of the full stream. The RNG is seeded from the series name so
-    quantile renders are reproducible run-to-run."""
+    quantile renders are reproducible run-to-run.
+
+    Two optional attachments (both None until something asks for them,
+    so the default observe path pays nothing):
+
+    - ``ring``: a bounded deque of ``(t, value)`` recent observations,
+      installed when a time-series RingStore attaches to the registry —
+      the source for quantile-over-window queries (obs/timeseries.py).
+    - ``exemplars``: top-K ``[value, trace_id, t]`` triples pinned by
+      the tail sampler (obs/sampler.py), linking a burning percentile
+      to the exact trace that burned it.
+    """
 
     __slots__ = ("name", "labels", "count", "sum", "min", "max", "values",
-                 "_rng")
+                 "_rng", "ring", "exemplars")
 
     def __init__(self, name: str, labels: dict):
         self.name = name
@@ -104,6 +115,8 @@ class Histogram:
         self.max = None
         self.values: list[float] = []
         self._rng = None
+        self.ring = None
+        self.exemplars = None
 
     def observe(self, v: float) -> None:
         self.count += 1
@@ -112,6 +125,9 @@ class Histogram:
             self.min = v
         if self.max is None or v > self.max:
             self.max = v
+        r = self.ring
+        if r is not None:
+            r.append((time.time(), v))
         if len(self.values) < _HIST_SAMPLE_CAP:
             self.values.append(v)
         else:
@@ -122,6 +138,17 @@ class Histogram:
             j = rng.randrange(self.count)
             if j < _HIST_SAMPLE_CAP:
                 self.values[j] = v
+
+    def add_exemplar(self, v: float, trace_id: str,
+                     cap: int = 8) -> None:
+        """Pin ``(v, trace_id)``, keeping the top-``cap`` by value."""
+        ex = self.exemplars
+        if ex is None:
+            ex = self.exemplars = []
+        ex.append([round(float(v), 3), trace_id, round(time.time(), 3)])
+        if len(ex) > cap:
+            ex.sort(key=lambda e: -e[0])
+            del ex[cap:]
 
 
 class Span:
@@ -231,6 +258,15 @@ class Registry:
         self._max_events = max_events
         self._tls = threading.local()
         self.t_start = time.time()
+        # Time-series attachment (obs/timeseries.py): once a RingStore
+        # attaches, new and existing histograms grow an observation ring
+        # so quantile-over-window queries have raw samples to read.
+        self.rings = None
+        self._ring_obs_cap = 0
+        # Tail-sampled trace drops are batched: ids land in this set and
+        # the event buffer compacts once the set is large enough, so a
+        # dropped request costs one set-add, not an O(events) sweep.
+        self._dropped_traces: set = set()
 
     # ------------------------------------------------------------- metrics
     def _get(self, table: dict, cls, name: str, labels: dict):
@@ -239,7 +275,25 @@ class Registry:
         if m is None:
             with self._lock:
                 m = table.setdefault(key, cls(name, labels))
+            if (cls is Histogram and self._ring_obs_cap
+                    and m.ring is None):
+                from collections import deque
+
+                m.ring = deque(maxlen=self._ring_obs_cap)
         return m
+
+    def attach_rings(self, store) -> None:
+        """Install a time-series RingStore: existing and future
+        histograms get bounded ``(t, value)`` observation rings."""
+        from collections import deque
+
+        self.rings = store
+        with self._lock:
+            self._ring_obs_cap = int(store.obs_cap)
+            hists = list(self._hists.values())
+        for h in hists:
+            if h.ring is None:
+                h.ring = deque(maxlen=self._ring_obs_cap)
 
     def counter(self, name: str, **labels) -> Counter:
         return self._get(self._counters, Counter, name, labels)
@@ -345,7 +399,9 @@ class Registry:
                 "hists": [
                     {"name": h.name, "labels": h.labels, "count": h.count,
                      "sum": h.sum, "min": h.min, "max": h.max,
-                     "values": list(h.values)}
+                     "values": list(h.values),
+                     **({"exemplars": [list(e) for e in h.exemplars]}
+                        if h.exemplars else {})}
                     for h in self._hists.values()
                 ],
                 "dropped_events": self._dropped,
@@ -353,7 +409,30 @@ class Registry:
 
     def events(self) -> list[dict]:
         with self._lock:
-            return list(self._events)
+            if not self._dropped_traces:
+                return list(self._events)
+            dropped = self._dropped_traces
+            return [e for e in self._events
+                    if e.get("trace") not in dropped]
+
+    # -------------------------------------------------- tail-sample pruning
+    #: pending trace drops before the event buffer compacts.
+    _DROP_COMPACT = 64
+
+    def drop_trace(self, trace_id: str) -> None:
+        """Prune one trace's span events (tail sampling's drop verdict,
+        obs/sampler.py). Batched: the id is noted now, the buffer
+        compacts every ``_DROP_COMPACT`` drops; ``events()`` filters
+        pending ids so readers never see a half-dropped state."""
+        with self._lock:
+            self._dropped_traces.add(trace_id)
+            if len(self._dropped_traces) >= self._DROP_COMPACT:
+                dropped = self._dropped_traces
+                self._events = [
+                    e for e in self._events
+                    if e.get("trace") not in dropped
+                ]
+                self._dropped_traces = set()
 
 
 # ------------------------------------------------------- module-level state

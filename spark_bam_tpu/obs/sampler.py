@@ -1,0 +1,114 @@
+"""Tail-based trace sampling with histogram exemplars.
+
+Head sampling (decide at request start) throws away exactly the traces
+you wanted: the slow ones, the failed ones, the ones during an incident.
+This sampler decides at request *completion* — Dapper-style tail
+sampling:
+
+- keep 100% of slow traces (latency ≥ the bar — by default the
+  tightest latency objective's threshold, see ``SloConfig``),
+- keep 100% of errored traces,
+- keep 100% while any SLO alert is firing (the incident window),
+- keep a seeded hash fraction of everything else (deterministic per
+  trace id — the same trace is kept or dropped on every worker it
+  touched, so cross-process merges never see half a tree).
+
+Dropped traces have their span events pruned from the registry's trace
+buffer (``Registry.drop_trace`` — lazily compacted, so the per-request
+cost is one set-add); histograms, counters and the time-series ring are
+untouched — sampling thins *traces*, never metrics.
+
+Kept slow/errored traces additionally pin an **exemplar** on the
+request-latency histogram: ``(latency_ms, trace_id)`` pairs, top-K by
+latency, carried through snapshots, fleet merges and the Prometheus
+exposition — so ``top``/``metrics-report`` can jump straight from "p99
+is burning" to the offending trace tree (docs/observability.md).
+"""
+
+from __future__ import annotations
+
+import zlib
+
+#: exemplars retained per histogram series (top-K by value).
+EXEMPLAR_CAP = 8
+
+
+def keep_fraction_hash(seed: int, trace_id: str) -> float:
+    """Deterministic [0,1) hash of (seed, trace_id): the same trace gets
+    the same verdict on every process that saw it."""
+    return zlib.crc32(f"{seed}:{trace_id}".encode()) / 2**32
+
+
+class TailSampler:
+    """Completion-time keep/drop decisions + exemplar pinning."""
+
+    def __init__(self, fraction: float = 0.1, seed: int = 0,
+                 slow_ms: float = 1000.0, alerting=None,
+                 hist_name: str = "serve.latency_ms"):
+        if not (0.0 <= fraction <= 1.0):
+            raise ValueError(f"sampler fraction must be in [0,1]: {fraction}")
+        self.fraction = float(fraction)
+        self.seed = int(seed)
+        self.slow_ms = float(slow_ms)
+        self.alerting = alerting        # () -> bool; the SLO engine's flag
+        self.hist_name = hist_name
+        self.kept = 0
+        self.dropped = 0
+
+    def decide(self, trace_id: str, ms: float,
+               error: bool = False) -> "tuple[bool, str]":
+        """(keep?, reason) — pure; ``note`` applies the side effects."""
+        if error:
+            return True, "error"
+        if ms >= self.slow_ms:
+            return True, "slow"
+        if self.alerting is not None and self.alerting():
+            return True, "alert_window"
+        if keep_fraction_hash(self.seed, trace_id) < self.fraction:
+            return True, "sampled"
+        return False, "unsampled"
+
+    def note(self, trace_id: "str | None", ms: float,
+             error: bool = False) -> bool:
+        """Apply the tail decision for one finished request: prune the
+        trace on drop, pin an exemplar on slow/errored keeps. Returns
+        whether the trace was kept (no-op True without a trace id)."""
+        from spark_bam_tpu import obs
+
+        if trace_id is None:
+            return True
+        keep, reason = self.decide(trace_id, ms, error=error)
+        reg = obs.registry()
+        if not keep:
+            self.dropped += 1
+            obs.count("sampler.dropped")
+            if reg is not None:
+                reg.drop_trace(trace_id)
+            return False
+        self.kept += 1
+        obs.count("sampler.kept")
+        if reg is not None and reason in ("error", "slow", "alert_window"):
+            # Label-less on purpose: this is the hist obs.observe() writes
+            # (only span-derived hists carry unit="ms").
+            reg.histogram(self.hist_name).add_exemplar(ms, trace_id)
+            obs.count("sampler.exemplars")
+        return True
+
+    def stats(self) -> dict:
+        return {
+            "fraction": self.fraction,
+            "slow_ms": self.slow_ms,
+            "kept": int(self.kept),
+            "dropped": int(self.dropped),
+        }
+
+
+def merge_exemplars(lists, cap: int = EXEMPLAR_CAP) -> "list[list]":
+    """Fold per-worker exemplar lists (``[value_ms, trace_id, t]``) into
+    the fleet's top-``cap`` by value — ``merge_snapshots``' helper."""
+    out: "list[tuple]" = []
+    for lst in lists:
+        for e in lst or ():
+            out.append(tuple(e))
+    out.sort(key=lambda e: -float(e[0]))
+    return [list(e) for e in out[:cap]]

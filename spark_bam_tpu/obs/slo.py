@@ -1,0 +1,367 @@
+"""Declarative SLOs with multi-window burn-rate alerting.
+
+Objectives are compact strings — ``serve.latency:p99<1500ms@5m``,
+``serve.errors:ratio<0.1%@1h`` — threaded through ``Config.slo`` /
+``SPARK_BAM_SLO`` / ``--slo`` like every other knob surface
+(core/config.py string-spec pattern). Grammar::
+
+    <metric>:<agg><cmp><threshold>[@<window>]
+
+- ``metric``: a registered obs series, with two friendly aliases —
+  ``<layer>.latency`` reads the ``<layer>.latency_ms`` histogram, and a
+  ``ratio`` objective on ``<layer>.errors`` divides by
+  ``<layer>.requests`` (error-budget ratio).
+- ``agg``: ``p50``/``p90``/``p99`` (quantile over the window, from the
+  time-series ring's observation tail), ``mean``, ``rate`` (per second),
+  ``ratio``.
+- ``cmp``: ``<`` (budget objectives: latency, error ratio) or ``>``
+  (floor objectives: throughput).
+- ``threshold``: ``1500ms``/``1.5s`` (normalized to ms), ``0.1%``
+  (normalized to a fraction), or a bare number.
+- ``window``: ``30s``/``5m``/``1h`` — the objective's *fast* window.
+
+Evaluation is Prometheus-style multi-window burn rate: each objective is
+measured over its fast window AND a slow confirmation window
+(``slow=1h`` by default, degrading to available history on fresh
+processes), and ``burn = measured/threshold`` (inverted for ``>``
+objectives). An alert FIRES when both windows burn at ≥ the ``burn``
+threshold (default 1.0) — the fast window catches the storm, the slow
+window keeps one spiky scrape from paging. Alert transitions land in the
+flight recorder (``slo_alert`` events), the ``slo.*`` metric family, and
+a bounded ledger the ``alerts`` serve op (and the CI failure artifact)
+serializes. The fabric autoscaler steers on the resulting burn rate
+instead of the raw p99 (fabric/autoscaler.py).
+
+Non-objective ``k=v`` entries in the spec configure the engine itself
+and the tail sampler (obs/sampler.py): ``fast``/``slow`` windows,
+``every`` (evaluation cadence = ring scrape cadence), ``burn``
+(alerting threshold), ``sample`` (tail-sampler keep fraction) and
+``seed``. Example full spec::
+
+    serve.latency:p99<1500ms@5m;serve.errors:ratio<0.1%@1h;sample=0.1
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from functools import lru_cache
+
+_AGGS = ("p50", "p90", "p99", "mean", "rate", "ratio")
+_WINDOW_RE = re.compile(r"^(\d+(?:\.\d+)?)(ms|s|m|h)$")
+_OBJ_RE = re.compile(
+    r"^(?P<metric>[a-z_][a-z0-9_.]*):(?P<agg>[a-z0-9]+)"
+    r"(?P<cmp><|>)(?P<threshold>[^@]+)(?:@(?P<window>.+))?$"
+)
+#: alert-ledger ring capacity (the ``alerts`` op / CI artifact tail).
+_LEDGER_CAP = 256
+
+
+def parse_window_s(text: str) -> float:
+    """``"90s"``/``"5m"``/``"1h"``/``"500ms"`` → seconds."""
+    m = _WINDOW_RE.match(text.strip())
+    if not m:
+        raise ValueError(
+            f"Bad SLO window {text!r}: expected e.g. 30s, 5m, 1h"
+        )
+    mult = {"ms": 1e-3, "s": 1.0, "m": 60.0, "h": 3600.0}[m.group(2)]
+    return float(m.group(1)) * mult
+
+
+def _parse_threshold(text: str) -> "tuple[float, str]":
+    """Threshold with unit → (normalized value, unit tag)."""
+    text = text.strip()
+    if text.endswith("%"):
+        return float(text[:-1]) / 100.0, "ratio"
+    if text.endswith("ms"):
+        return float(text[:-2]), "ms"
+    if text.endswith("s"):
+        return float(text[:-1]) * 1000.0, "ms"
+    return float(text), ""
+
+
+@dataclass(frozen=True)
+class Objective:
+    """One parsed objective. ``name`` is the canonical spec string —
+    it is the alert identity (ledger entries, ``slo.*`` labels, the
+    autoscaler's cited reason)."""
+
+    name: str
+    metric: str          # resolved series name (aliases expanded)
+    agg: str             # one of _AGGS
+    cmp: str             # "<" | ">"
+    threshold: float     # ms for latency-like, fraction for ratio
+    window_s: float      # the objective's fast window
+    denominator: str = ""  # ratio objectives: the traffic counter
+
+    @staticmethod
+    def parse(text: str, default_window_s: float = 300.0) -> "Objective":
+        m = _OBJ_RE.match(text.strip())
+        if not m:
+            raise ValueError(
+                f"Bad SLO objective {text!r}: expected "
+                "<metric>:<agg><cmp><threshold>[@<window>], e.g. "
+                "serve.latency:p99<1500ms@5m"
+            )
+        metric, agg = m.group("metric"), m.group("agg")
+        if agg not in _AGGS:
+            raise ValueError(
+                f"Bad SLO aggregation {agg!r} in {text!r}: expected one of "
+                f"{', '.join(_AGGS)}"
+            )
+        threshold, unit = _parse_threshold(m.group("threshold"))
+        window_s = (parse_window_s(m.group("window"))
+                    if m.group("window") else default_window_s)
+        denominator = ""
+        if agg == "ratio":
+            layer, _, stage = metric.rpartition(".")
+            denominator = f"{layer}.requests" if layer else ""
+            if stage != "errors" or not denominator:
+                raise ValueError(
+                    f"Bad ratio objective {text!r}: ratio is defined for "
+                    "<layer>.errors (divided by <layer>.requests)"
+                )
+        elif metric.endswith(".latency"):
+            metric = metric + "_ms"
+        if threshold <= 0:
+            raise ValueError(f"SLO threshold must be > 0 in {text!r}")
+        if unit == "ratio" and agg != "ratio":
+            raise ValueError(
+                f"Percent threshold needs a ratio aggregation in {text!r}"
+            )
+        return Objective(
+            name=text.strip(), metric=metric, agg=agg, cmp=m.group("cmp"),
+            threshold=threshold, window_s=window_s, denominator=denominator,
+        )
+
+
+@dataclass(frozen=True)
+class SloConfig:
+    """Parsed ``Config.slo`` spec: objectives + engine/sampler knobs."""
+
+    objectives: "tuple[Objective, ...]" = ()
+    fast_s: float = 300.0        # default objective window (5m)
+    slow_s: float = 3600.0       # confirmation window (1h)
+    every_ms: float = 1000.0     # scrape + evaluation cadence
+    burn: float = 1.0            # alert when both windows burn ≥ this
+    sample: float = 0.1          # tail-sampler keep fraction (fast traces)
+    seed: int = 0                # tail-sampler hash seed
+    slow_trace_ms: float = 0.0   # sampler slow-trace bar; 0 ⇒ derive from
+                                 # the tightest latency objective
+
+    def __post_init__(self):
+        if not (0.0 <= self.sample <= 1.0):
+            raise ValueError(f"slo sample must be in [0,1]: {self.sample}")
+        if self.every_ms <= 0 or self.fast_s <= 0 or self.slow_s <= 0:
+            raise ValueError("slo windows/cadence must be > 0")
+        if self.burn <= 0:
+            raise ValueError(f"slo burn threshold must be > 0: {self.burn}")
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.objectives)
+
+    def sampler_slow_ms(self) -> float:
+        """The tail sampler's always-keep latency bar: explicit
+        ``slow_ms`` wins, else the tightest latency objective's
+        threshold, else 1000 ms."""
+        if self.slow_trace_ms > 0:
+            return self.slow_trace_ms
+        lat = [o.threshold for o in self.objectives
+               if o.agg.startswith("p") or o.agg in ("mean",)]
+        return min(lat) if lat else 1000.0
+
+    _KNOBS = ("fast", "slow", "every", "burn", "sample", "seed", "slow_ms")
+
+    @staticmethod
+    @lru_cache(maxsize=64)
+    def parse(spec: str) -> "SloConfig":
+        """``"serve.latency:p99<1500ms@5m;serve.errors:ratio<0.1%@1h;
+        sample=0.1,seed=7"`` (``""`` ⇒ disabled). ``;``-separated;
+        entries with a comparator are objectives, ``k=v`` entries are
+        engine/sampler knobs (comma-separated within one entry)."""
+        kw: dict = {}
+        texts: "list[str]" = []
+        for part in (spec or "").split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            if "<" in part or ">" in part:
+                texts.append(part)
+                continue
+            for entry in part.split(","):
+                entry = entry.strip()
+                if not entry:
+                    continue
+                if "=" not in entry:
+                    raise ValueError(
+                        f"Bad SLO entry {entry!r} in {spec!r}: neither an "
+                        "objective nor a k=v knob"
+                    )
+                key, value = (t.strip() for t in entry.split("=", 1))
+                key = key.replace("-", "_")
+                if key not in SloConfig._KNOBS:
+                    raise ValueError(
+                        f"Unknown SLO knob {key!r}: expected one of "
+                        f"{', '.join(SloConfig._KNOBS)}"
+                    )
+                if key in ("fast", "slow"):
+                    kw[f"{key}_s"] = parse_window_s(value)
+                elif key == "every":
+                    kw["every_ms"] = parse_window_s(value) * 1000.0
+                elif key == "seed":
+                    kw["seed"] = int(value)
+                elif key == "slow_ms":
+                    kw["slow_trace_ms"] = float(value)
+                else:
+                    kw[key] = float(value)
+        fast = kw.get("fast_s", 300.0)
+        objectives = tuple(
+            Objective.parse(t, default_window_s=fast) for t in texts
+        )
+        return SloConfig(objectives=objectives, **kw)
+
+    @staticmethod
+    def from_env(env=None) -> "SloConfig":
+        import os
+
+        return SloConfig.parse((env or os.environ).get("SPARK_BAM_SLO", ""))
+
+
+# ----------------------------------------------------------------- engine
+
+def _measure(view, obj: Objective, window_s: float) -> "float | None":
+    """One objective's measured value over one window, against any
+    delta/rate/ratio/quantile view (live RingStore or SeriesView)."""
+    if obj.agg == "ratio":
+        return view.ratio(obj.metric, obj.denominator, window_s)
+    if obj.agg == "rate":
+        return view.rate(obj.metric, window_s)
+    if obj.agg in ("p50", "p90", "p99"):
+        return view.quantile(obj.metric, int(obj.agg[1:]) / 100.0, window_s)
+    if obj.agg == "mean":
+        return view.hist_mean(obj.metric, window_s)
+    return None
+
+
+def burn_rate(obj: Objective, value: "float | None") -> float:
+    """How fast the objective's budget is burning: 1.0 = exactly at
+    target. ``<`` objectives burn as measured/threshold; ``>`` floor
+    objectives invert. No data burns nothing."""
+    if value is None:
+        return 0.0
+    if obj.cmp == "<":
+        return value / obj.threshold
+    return obj.threshold / value if value > 0 else float("inf")
+
+
+class SloEngine:
+    """Evaluate objectives against a ring view; own the alert state.
+
+    ``view_fn`` returns the query surface each evaluation reads
+    (normally the worker's live :class:`RingStore`); statuses, a bounded
+    alert ledger, and firing flags are kept here and serialized by
+    ``status()`` — the payload behind the ``alerts`` op, the stats
+    ``slo`` block the autoscaler steers on, and the dashboard ``/slo``
+    endpoint.
+    """
+
+    def __init__(self, scfg: SloConfig, view_fn):
+        self.scfg = scfg
+        self._view_fn = view_fn
+        self._lock = threading.Lock()
+        self._statuses: "list[dict]" = []
+        self._firing: "set[str]" = set()
+        self.ledger: "deque[dict]" = deque(maxlen=_LEDGER_CAP)
+
+    def evaluate(self) -> "list[dict]":
+        """One evaluation pass; returns the per-objective statuses."""
+        from spark_bam_tpu import obs
+        from spark_bam_tpu.obs import flight
+
+        view = self._view_fn()
+        obs.count("slo.evals")
+        statuses: "list[dict]" = []
+        now = round(time.time(), 3)
+        for obj in self.scfg.objectives:
+            fast_w = obj.window_s
+            slow_w = max(self.scfg.slow_s, fast_w)
+            value_fast = _measure(view, obj, fast_w)
+            value_slow = _measure(view, obj, slow_w)
+            bf = burn_rate(obj, value_fast)
+            bs = burn_rate(obj, value_slow)
+            firing = bf >= self.scfg.burn and bs >= self.scfg.burn
+            st = {
+                "objective": obj.name,
+                "metric": obj.metric,
+                "window_s": fast_w,
+                "value_fast": value_fast,
+                "value_slow": value_slow,
+                "burn_fast": round(bf, 4),
+                "burn_slow": round(bs, 4),
+                "threshold": obj.threshold,
+                "firing": firing,
+                "t": now,
+            }
+            statuses.append(st)
+            obs.gauge("slo.burn_rate", objective=obj.name).set(round(bf, 4))
+            obs.gauge("slo.firing", objective=obj.name).set(int(firing))
+            with self._lock:
+                was = obj.name in self._firing
+                if firing and not was:
+                    self._firing.add(obj.name)
+                    entry = dict(st, state="firing")
+                    self.ledger.append(entry)
+                    obs.count("slo.alerts")
+                    flight.record("slo_alert", **entry)
+                elif was and not firing:
+                    self._firing.discard(obj.name)
+                    entry = dict(st, state="resolved")
+                    self.ledger.append(entry)
+                    flight.record("slo_alert", **entry)
+        with self._lock:
+            self._statuses = statuses
+        return statuses
+
+    # ------------------------------------------------------------- readers
+    @property
+    def alerting(self) -> bool:
+        """Any objective currently firing — the tail sampler's
+        keep-everything window."""
+        with self._lock:
+            return bool(self._firing)
+
+    def firing(self) -> "list[str]":
+        with self._lock:
+            return sorted(self._firing)
+
+    def summary(self) -> dict:
+        """The compact block ``stats`` embeds (what the autoscaler
+        reads): max fast burn + the firing objective names."""
+        with self._lock:
+            statuses = list(self._statuses)
+            firing = sorted(self._firing)
+        max_burn = max((s["burn_fast"] for s in statuses), default=0.0)
+        worst = max(statuses, key=lambda s: s["burn_fast"], default=None)
+        return {
+            "objectives": len(self.scfg.objectives),
+            "max_burn_fast": max_burn,
+            "worst": worst["objective"] if worst else None,
+            "firing": firing,
+        }
+
+    def status(self) -> dict:
+        """The full ``alerts`` op / ``/slo`` payload."""
+        with self._lock:
+            return {
+                "enabled": True,
+                "burn_threshold": self.scfg.burn,
+                "fast_s": self.scfg.fast_s,
+                "slow_s": self.scfg.slow_s,
+                "objectives": list(self._statuses),
+                "firing": sorted(self._firing),
+                "ledger": list(self.ledger),
+            }

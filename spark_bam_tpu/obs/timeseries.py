@@ -1,0 +1,385 @@
+"""Time-series ring: bounded (t, value) sample history per metric series.
+
+The registry's counters/gauges/histograms are *cumulative* — a snapshot
+says where a series is, never how fast it is moving. This module adds
+the missing time axis: a :class:`RingStore` scrapes the live registry at
+a fixed cadence into one bounded ring of ``(t, value)`` points per
+series, plus a timestamped ring of recent raw observations per
+histogram (attached at the :class:`~spark_bam_tpu.obs.registry.Histogram`
+itself — see ``Registry.attach_rings``), so windowed queries become
+possible:
+
+- ``rate(name, window_s)`` / ``delta(name, window_s)`` — counter slope
+  over the trailing window (requests/s, error deltas);
+- ``quantile(name, q, window_s)`` — p50/p99 *of the last N seconds*,
+  from the histogram's observation ring, not the lifetime reservoir;
+- ``ratio(num, den, window_s)`` — delta/delta (error ratios).
+
+These are exactly the primitives burn-rate SLO evaluation needs
+(obs/slo.py); the sparkline dashboard (obs/dashboard.py) renders the
+same rings. ``snapshot()`` serializes a store for the wire — the fabric
+router collects per-worker ring snapshots through the ``telemetry`` op
+and :func:`merge_series` folds them into one fleet view, bucketing
+timestamps to the scrape cadence so unaligned workers still sum.
+
+Everything here is stdlib + the registry: no numpy on the scrape path,
+one daemon thread per store, and the store is inert (zero hot-path
+cost) until ``start()``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+#: ring capacity per series — at the 1 s default cadence, 10 minutes of
+#: history, comfortably beyond the slow SLO window's needs (the slow
+#: window degrades to available history on fresh processes, by design).
+_POINT_CAP = 600
+#: raw observations retained per histogram for windowed quantiles.
+_OBS_CAP = 2048
+#: observation points shipped per series in a wire snapshot (tail).
+_WIRE_OBS_CAP = 512
+
+
+def _label_key(labels: dict) -> tuple:
+    return tuple(sorted(labels.items()))
+
+
+class RingStore:
+    """Scrape-cadence sample rings over one live registry (thread-safe).
+
+    ``scrape()`` takes one sample pass; ``start()`` spawns the cadence
+    thread (optionally invoking ``on_scrape`` after each pass — the SLO
+    engine's evaluation hook rides this, so alert latency is one scrape,
+    not a second timer).
+    """
+
+    def __init__(self, registry, cadence_ms: float = 1000.0,
+                 cap: int = _POINT_CAP, obs_cap: int = _OBS_CAP):
+        self.registry = registry
+        self.cadence_ms = float(cadence_ms)
+        self.cap = int(cap)
+        self.obs_cap = int(obs_cap)
+        self._series: "dict[tuple, dict]" = {}
+        self._lock = threading.Lock()
+        self._thread: "threading.Thread | None" = None
+        self._stop = threading.Event()
+        registry.attach_rings(self)
+
+    # ------------------------------------------------------------- lifecycle
+    def start(self, on_scrape=None) -> "RingStore":
+        def _loop():
+            while not self._stop.wait(self.cadence_ms / 1000.0):
+                try:
+                    self.scrape()
+                    if on_scrape is not None:
+                        on_scrape()
+                except Exception:
+                    # A scrape must never kill the daemon thread; the
+                    # next tick retries.
+                    pass
+
+        with self._lock:
+            if self._thread is not None:
+                return self
+            self._thread = t = threading.Thread(
+                target=_loop, name="obs-ringstore", daemon=True
+            )
+        t.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._lock:
+            t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5)
+
+    # --------------------------------------------------------------- scrape
+    def _ring(self, name: str, labels: dict, kind: str) -> dict:
+        key = (name, _label_key(labels), kind)
+        s = self._series.get(key)
+        if s is None:
+            s = self._series[key] = {
+                "name": name, "labels": dict(labels), "kind": kind,
+                "points": deque(maxlen=self.cap),
+            }
+        return s
+
+    def scrape(self, now: "float | None" = None) -> None:
+        """One sample pass over every live series."""
+        from spark_bam_tpu import obs
+
+        snap = self.registry.snapshot()
+        t = round(time.time() if now is None else now, 3)
+        with self._lock:
+            for c in snap["counters"]:
+                self._ring(c["name"], c["labels"], "counter")[
+                    "points"].append((t, c["value"]))
+            for g in snap["gauges"]:
+                self._ring(g["name"], g["labels"], "gauge")[
+                    "points"].append((t, g["value"]))
+            for h in snap["hists"]:
+                self._ring(h["name"], h["labels"], "hist")[
+                    "points"].append((t, h["count"], h["sum"]))
+            n_series = len(self._series)
+        obs.count("ts.scrapes")
+        obs.gauge("ts.series").set(n_series)
+
+    # -------------------------------------------------------------- queries
+    def _points(self, name: str, kind: str, labels: "dict | None"):
+        lk = _label_key(labels) if labels is not None else None
+        with self._lock:
+            for (n, k, kd), s in self._series.items():
+                if n == name and kd == kind and (lk is None or k == lk):
+                    return list(s["points"])
+        return []
+
+    def delta(self, name: str, window_s: float,
+              labels: "dict | None" = None) -> "float | None":
+        """Counter increase over the trailing window (None: no samples)."""
+        pts = self._points(name, "counter", labels)
+        return _delta(pts, window_s)
+
+    def rate(self, name: str, window_s: float,
+             labels: "dict | None" = None) -> "float | None":
+        """Counter increase per second over the trailing window."""
+        pts = self._points(name, "counter", labels)
+        return _rate(pts, window_s)
+
+    def ratio(self, num: str, den: str, window_s: float) -> "float | None":
+        """delta(num)/delta(den) over the window; None until the
+        denominator moved (no traffic ⇒ no error-budget spend)."""
+        dn = self.delta(num, window_s)
+        dd = self.delta(den, window_s)
+        if dd is None or dd <= 0:
+            return None
+        return (dn or 0.0) / dd
+
+    def _hist_rings(self, name: str) -> list:
+        """Every same-name histogram's observation ring (label sets pool:
+        spans record under ``unit="ms"``, ``obs.observe`` under none)."""
+        with self.registry._lock:
+            hists = list(self.registry._hists.values())
+        return [h.ring for h in hists if h.name == name and h.ring]
+
+    def quantile(self, name: str, q: float, window_s: float,
+                 labels: "dict | None" = None) -> "float | None":
+        """Nearest-rank quantile of the histogram's raw observations in
+        the trailing window (the obs ring lives on the Histogram)."""
+        lo = time.time() - window_s
+        vals: "list[float]" = []
+        for ring in self._hist_rings(name):
+            vals.extend(v for (t, v) in list(ring) if t >= lo)
+        vals.sort()
+        return _nearest_rank(vals, q)
+
+    def hist_mean(self, name: str, window_s: float,
+                  labels: "dict | None" = None) -> "float | None":
+        """Mean observation over the window, from hist count/sum deltas
+        (same-name label sets pool, as in :meth:`quantile`)."""
+        with self._lock:
+            all_pts = [
+                list(s["points"])
+                for (n, k, kd), s in self._series.items()
+                if n == name and kd == "hist"
+                and (labels is None or k == _label_key(labels))
+            ]
+        return _pooled_hist_mean(all_pts, window_s)
+
+    def gauge_last(self, name: str,
+                   labels: "dict | None" = None) -> "float | None":
+        pts = self._points(name, "gauge", labels)
+        return pts[-1][1] if pts else None
+
+    # ----------------------------------------------------------------- wire
+    def snapshot(self) -> dict:
+        """Serializable store state (the ``telemetry`` op's ``series``
+        payload). Histogram observation rings ship a bounded tail so the
+        router can answer fleet quantile-over-window."""
+        out: list[dict] = []
+        with self._lock:
+            series = [
+                {"name": s["name"], "labels": dict(s["labels"]),
+                 "kind": s["kind"],
+                 "points": [list(p) for p in s["points"]]}
+                for s in self._series.values()
+            ]
+        for s in series:
+            if s["kind"] == "hist":
+                h = self.registry.histogram(s["name"], **s["labels"])
+                ring = getattr(h, "ring", None)
+                if ring:
+                    s["obs"] = [
+                        [round(t, 3), v]
+                        for (t, v) in list(ring)[-_WIRE_OBS_CAP:]
+                    ]
+            out.append(s)
+        return {"cadence_ms": self.cadence_ms, "series": out}
+
+
+# -------------------------------------------------------- snapshot algebra
+
+def _delta(points, window_s: float) -> "float | None":
+    if not points:
+        return None
+    now = points[-1][0]
+    lo = now - window_s
+    base = points[0]
+    for p in points:
+        if p[0] >= lo:
+            base = p
+            break
+    return points[-1][1] - base[1]
+
+
+def _rate(points, window_s: float) -> "float | None":
+    if len(points) < 2:
+        return None
+    now = points[-1][0]
+    lo = now - window_s
+    base = points[0]
+    for p in points:
+        if p[0] >= lo:
+            base = p
+            break
+    dt = points[-1][0] - base[0]
+    if dt <= 0:
+        return None
+    return (points[-1][1] - base[1]) / dt
+
+
+def _pooled_hist_mean(series_points, window_s: float) -> "float | None":
+    """Mean over the window from hist (t, count, sum) deltas, pooled
+    across series. A window that saw no new observations falls back to
+    the lifetime mean (fresh processes, idle tails)."""
+    dc = ds = 0.0
+    life_c = life_s = 0.0
+    for points in series_points:
+        if not points:
+            continue
+        now = points[-1][0]
+        lo = now - window_s
+        base = points[0]
+        for p in points:
+            if p[0] >= lo:
+                base = p
+                break
+        dc += points[-1][1] - base[1]
+        ds += points[-1][2] - base[2]
+        life_c += points[-1][1]
+        life_s += points[-1][2]
+    if dc > 0:
+        return ds / dc
+    return life_s / life_c if life_c else None
+
+
+def _nearest_rank(sorted_vals, q: float) -> "float | None":
+    if not sorted_vals:
+        return None
+    i = min(len(sorted_vals) - 1,
+            max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return float(sorted_vals[i])
+
+
+class SeriesView:
+    """Query facade over a *serialized* ring snapshot (a worker's wire
+    payload or :func:`merge_series` output) with the same delta/rate/
+    ratio/quantile surface as a live :class:`RingStore` — the router,
+    the dashboard, and tests all read series through this one shape."""
+
+    def __init__(self, snapshot: "dict | None"):
+        self.snapshot = snapshot or {"cadence_ms": 1000.0, "series": []}
+
+    def _find(self, name: str, kind: str):
+        for s in self.snapshot.get("series", []):
+            if s["name"] == name and s["kind"] == kind:
+                return s
+        return None
+
+    def _find_all(self, name: str, kind: str) -> list:
+        return [s for s in self.snapshot.get("series", [])
+                if s["name"] == name and s["kind"] == kind]
+
+    def delta(self, name: str, window_s: float) -> "float | None":
+        s = self._find(name, "counter")
+        return _delta([tuple(p) for p in s["points"]], window_s) if s else None
+
+    def rate(self, name: str, window_s: float) -> "float | None":
+        s = self._find(name, "counter")
+        return _rate([tuple(p) for p in s["points"]], window_s) if s else None
+
+    def ratio(self, num: str, den: str, window_s: float) -> "float | None":
+        dn = self.delta(num, window_s)
+        dd = self.delta(den, window_s)
+        if dd is None or dd <= 0:
+            return None
+        return (dn or 0.0) / dd
+
+    def quantile(self, name: str, q: float,
+                 window_s: float) -> "float | None":
+        vals: "list[float]" = []
+        for s in self._find_all(name, "hist"):
+            obs_pts = s.get("obs") or []
+            if not obs_pts:
+                continue
+            lo = obs_pts[-1][0] - window_s
+            vals.extend(v for (t, v) in obs_pts if t >= lo)
+        vals.sort()
+        return _nearest_rank(vals, q)
+
+    def hist_mean(self, name: str, window_s: float) -> "float | None":
+        series = [
+            [tuple(p) for p in s["points"]]
+            for s in self._find_all(name, "hist")
+        ]
+        return _pooled_hist_mean(series, window_s)
+
+    def gauge_last(self, name: str) -> "float | None":
+        s = self._find(name, "gauge")
+        if s is None or not s["points"]:
+            return None
+        return s["points"][-1][1]
+
+
+def merge_series(snapshots: "list[dict | None]") -> dict:
+    """Fold per-worker ring snapshots into one fleet snapshot.
+
+    Counter/gauge points are bucketed to the scrape cadence and summed
+    per bucket (fleet totals over time despite unaligned scrape clocks);
+    hist points sum count/sum per bucket and observation tails
+    concatenate (capped), so fleet quantile-over-window reads a
+    cross-worker sample — the same merge contract as
+    ``exporters.merge_snapshots``, with a time axis.
+    """
+    snaps = [s for s in snapshots if s]
+    cadence = max((float(s.get("cadence_ms") or 1000.0) for s in snaps),
+                  default=1000.0)
+    step = max(cadence / 1000.0, 1e-3)
+    merged: "dict[tuple, dict]" = {}
+    for snap in snaps:
+        for s in snap.get("series", []):
+            key = (s["name"], _label_key(s.get("labels", {})), s["kind"])
+            cur = merged.setdefault(key, {
+                "name": s["name"], "labels": dict(s.get("labels", {})),
+                "kind": s["kind"], "_buckets": {}, "obs": [],
+            })
+            for p in s.get("points", []):
+                b = int(p[0] / step)
+                acc = cur["_buckets"].setdefault(b, [p[0]] + [0.0] * (len(p) - 1))
+                acc[0] = max(acc[0], p[0])
+                for i in range(1, len(p)):
+                    acc[i] += p[i]
+            cur["obs"].extend(tuple(o) for o in s.get("obs", []))
+    out = []
+    for cur in merged.values():
+        points = [cur["_buckets"][b] for b in sorted(cur["_buckets"])]
+        s = {"name": cur["name"], "labels": cur["labels"],
+             "kind": cur["kind"], "points": points}
+        if cur["obs"]:
+            obs_pts = sorted(cur["obs"])[-_WIRE_OBS_CAP:]
+            s["obs"] = [list(o) for o in obs_pts]
+        out.append(s)
+    return {"cadence_ms": cadence, "series": out}

@@ -21,6 +21,7 @@ from concurrent.futures import Future
 import numpy as np
 
 from spark_bam_tpu import obs
+from spark_bam_tpu.obs import account as obs_account
 from spark_bam_tpu.obs import trace as obs_trace
 from spark_bam_tpu.serve.config import MAX_CONTIGS
 
@@ -35,11 +36,14 @@ class RowTask:
     Rows capture the submitting thread's trace context at creation: a
     tick batches rows from many requests (many traces), so the dispatch
     emits one synthetic span event per row, parented under that row's
-    request span rather than the shared tick.
+    request span rather than the shared tick. The request's cost
+    accumulator (obs/account.py) rides along the same way — a shared
+    tick bills each request its own rows.
     """
 
     __slots__ = ("window", "n", "at_eof", "lo", "own", "lengths", "nc",
-                 "deadline_ts", "enqueued_ts", "future", "trace_id", "pspan")
+                 "deadline_ts", "enqueued_ts", "future", "trace_id", "pspan",
+                 "cost")
 
     def __init__(self, window, n, at_eof, lo, own, lengths, nc,
                  deadline_ts=None):
@@ -56,6 +60,7 @@ class RowTask:
         ctx = obs_trace.current()
         self.trace_id = ctx.trace_id if ctx is not None else None
         self.pspan = ctx.span_id if ctx is not None else None
+        self.cost = obs_account.current()
 
 
 class Batcher:
@@ -219,6 +224,20 @@ class Batcher:
         self.batch_sizes[len(batch)] += 1
         obs.count("serve.batches")
         obs.observe("serve.batch_rows", len(batch))
+        obs.count("serve.h2d_bytes", sum(len(t.window) for t in batch))
+        # Per-row cost attribution: the same queue_ms the histogram saw,
+        # an even 1/rows share of the tick's device time, and the row's
+        # own window bytes — shares sum back to serve.tick / the
+        # serve.h2d_bytes counter exactly (the bench conservation gate).
+        share_ms = tick_ms / len(batch)
+        for t in batch:
+            if t.cost is not None:
+                t.cost.add(
+                    queue_ms=(now - t.enqueued_ts) * 1000.0,
+                    device_ms=share_ms,
+                    h2d_bytes=len(t.window),
+                    rows=1,
+                )
         # One synthetic dispatch event per traced row: the tick is shared
         # across requests, so each row's event parents under ITS request
         # span — this is the cross-process hop that makes a serve request

@@ -15,12 +15,19 @@ bytes. Concatenated, the frames are exactly a native columnar container
 same query. Handlers stage the chunks on the in-process response under
 the ``"_binary"`` key; the server pops it before JSON encoding.
 
-Admin ops (``drain``, ``tune``, ``telemetry``) bypass admission like
-``ping``/``stats``: ``drain`` stops new work-op admission (in-flight
-ticks finish unshed), ``tune`` retargets batching/admission knobs at
-runtime — the fabric autoscaler's actuator (docs/fabric.md) — and
+Admin ops (``drain``, ``tune``, ``telemetry``, ``alerts``) bypass
+admission like ``ping``/``stats``: ``drain`` stops new work-op admission
+(in-flight ticks finish unshed), ``tune`` retargets batching/admission
+knobs at runtime — the fabric autoscaler's actuator (docs/fabric.md) —
 ``telemetry`` returns the worker's merged obs snapshot, recent span
-events, and flight-recorder ring (docs/observability.md).
+events, time-series rings, and flight-recorder ring, and ``alerts``
+returns the SLO engine's statuses, burn rates and alert ledger
+(docs/observability.md).
+
+Requests may carry an optional ``tenant`` string — a client-chosen
+identity the per-request cost accountant (obs/account.py) rolls up by,
+so ``stats``/``top`` can answer "who is spending the fleet". Absent
+tenants bill to ``"-"``.
 
 Requests may carry an optional ``trace`` field — ``{"id": <trace_id>,
 "span": <parent span_id>}`` — minted by the client (or the fabric
@@ -40,7 +47,7 @@ import json
 
 #: ops answered by the service; anything else is a ProtocolError.
 OPS = ("ping", "stats", "plan", "record_starts", "count", "fleet", "batch",
-       "rewrite", "drain", "tune", "telemetry")
+       "rewrite", "drain", "tune", "telemetry", "alerts")
 
 
 class ProtocolError(ValueError):

@@ -30,8 +30,12 @@ import numpy as np
 
 from spark_bam_tpu import obs
 from spark_bam_tpu.bam.header import read_header
+from spark_bam_tpu.obs import account as obs_account
 from spark_bam_tpu.obs import flight
 from spark_bam_tpu.obs import trace as obs_trace
+from spark_bam_tpu.obs.sampler import TailSampler
+from spark_bam_tpu.obs.slo import SloEngine
+from spark_bam_tpu.obs.timeseries import RingStore
 from spark_bam_tpu.bgzf.flat import flatten_file
 from spark_bam_tpu.core.config import Config
 from spark_bam_tpu.core.faults import LatencyTracker
@@ -179,10 +183,69 @@ class SplitService:
         self._op_lock = threading.Lock()
         self._closed = False
         self.draining = False
+        # Observability stage 2 (docs/observability.md): cost accounting
+        # always runs (pure Python, no registry needed); the ring scraper,
+        # SLO engine and tail sampler start when obs is configured.
+        self.accountant = obs_account.Accountant()
+        self.rings: "RingStore | None" = None
+        self.slo_engine: "SloEngine | None" = None
+        self.sampler: "TailSampler | None" = None
+        self.start_observability()
+
+    def start_observability(self) -> bool:
+        """Idempotently start the time-series ring scraper, SLO engine
+        and tail sampler. Needs a configured registry — called at init
+        and again by harnesses that ``obs.configure()`` after building
+        the service (the bench A/B legs). Returns whether the stack is
+        live."""
+        if self.rings is not None:
+            return True
+        reg = obs.registry()
+        if reg is None:
+            return False
+        scfg = self.config.slo_config
+        rings = RingStore(reg, cadence_ms=scfg.every_ms)
+        engine = SloEngine(scfg, lambda: self.rings) if scfg.enabled else None
+        # Tail sampling only when an ``--slo`` spec opted in (even a
+        # knob-only ``"sample=0.5"`` counts): a bare ``--metrics-out``
+        # run must keep every trace, not a default 10% of them.
+        sampler = None
+        if self.config.slo:
+            sampler = TailSampler(
+                fraction=scfg.sample, seed=scfg.seed,
+                slow_ms=scfg.sampler_slow_ms(),
+                alerting=(
+                    (lambda: self.slo_engine and self.slo_engine.alerting)
+                    if engine is not None else None
+                ),
+            )
+        with self._files_lock:
+            self.rings = rings
+            self.slo_engine = engine
+            self.sampler = sampler
+        rings.start(
+            on_scrape=engine.evaluate if engine is not None else None
+        )
+        return True
+
+    def stop_observability(self) -> None:
+        """Tear the ring/engine/sampler stack down so a later
+        :meth:`start_observability` rebinds to the CURRENT registry —
+        the bench telemetry A/B flips obs off and on around a live
+        service, and a stale RingStore would keep scraping the dead
+        registry from before the flip."""
+        with self._files_lock:
+            rings, self.rings = self.rings, None
+            self.slo_engine = None
+            self.sampler = None
+        if rings is not None:
+            rings.stop()
 
     # ------------------------------------------------------------ lifecycle
     def close(self) -> None:
         self._closed = True
+        if self.rings is not None:
+            self.rings.stop()
         self.batcher.close()
         self.pool.shutdown(wait=False, cancel_futures=True)
         self.resolve_pool.shutdown(wait=False, cancel_futures=True)
@@ -218,6 +281,9 @@ class SplitService:
         if op == "telemetry":
             fut.set_result(ok_response(req, **self.telemetry(req)))
             return fut
+        if op == "alerts":
+            fut.set_result(ok_response(req, **self.alerts()))
+            return fut
         klass = CLASS_OF[op]
         if self._closed:
             raise RuntimeError("service is closed")
@@ -251,6 +317,11 @@ class SplitService:
         token = obs_trace.set_current(ctx) if ctx is not None else None
         flight.record("request", op=op, id=req.get("id"),
                       trace=ctx.trace_id if ctx else None)
+        # The cost accumulator travels by contextvar exactly like the
+        # trace: RowTask captures it at creation, the batcher attributes
+        # per-row queue/device/h2d costs at dispatch (obs/account.py).
+        cost = self.accountant.begin(op, req.get("tenant"))
+        cost_token = obs_account.bind(cost)
         try:
             with obs.span("serve.request", op=op):
                 if deadline_ts is not None and time.monotonic() > deadline_ts:
@@ -273,16 +344,25 @@ class SplitService:
             )
         finally:
             self.gate.release(klass)
+            obs_account.reset(cost_token)
             if token is not None:
                 obs_trace.reset(token)
         ms = (time.monotonic() - t0) * 1000.0
+        ok = bool(resp.get("ok"))
         self.latency.record(ms)
         obs.observe("serve.latency_ms", ms)
-        self._note_op(op, ms, resp)
-        if not resp.get("ok"):
+        nbytes = self._note_op(op, ms, resp)
+        self.accountant.finish(cost, ms, nbytes, ok=ok)
+        if not ok:
+            obs.count("serve.errors")
             flight.record("error", op=op, id=req.get("id"),
                           error=resp.get("error"),
                           message=resp.get("message"))
+        if self.sampler is not None:
+            # Tail decision at completion: prune dropped traces, pin
+            # slow/errored exemplars on the latency histogram.
+            self.sampler.note(ctx.trace_id if ctx else None, ms,
+                              error=not ok)
         # Under the op lock: ``+=`` from concurrent pool threads loses
         # updates, and ``served`` feeds the autoscaler's served-changed
         # hysteresis — a stuck count reads as "no fresh samples" and
@@ -291,10 +371,11 @@ class SplitService:
             self.served += 1
         fut.set_result(resp)
 
-    def _note_op(self, op: str, ms: float, resp: dict) -> None:
+    def _note_op(self, op: str, ms: float, resp: dict) -> int:
         """Per-op request/row/byte accounting. Rows come from whichever
         cardinality the op reports (``rows``/``count``/``total``); bytes
-        are the encoded JSON line plus any binary frames."""
+        are the encoded JSON line plus any binary frames (returned, so
+        the cost accountant bills the same number)."""
         rows = 0
         if resp.get("ok"):
             for key in ("rows", "count", "total"):
@@ -316,6 +397,7 @@ class SplitService:
             if lat is None:
                 lat = self._op_lat[op] = deque(maxlen=_LATENCY_WINDOW)
             lat.append(ms)
+        return nbytes
 
     # -------------------------------------------------------------- admin ops
     def drain(self) -> dict:
@@ -350,12 +432,22 @@ class SplitService:
         obs.count("serve.tuned")
         return {"applied": applied, **self._knobs()}
 
+    def alerts(self) -> dict:
+        """The SLO engine's full status — per-objective burn rates, the
+        firing set, and the bounded alert ledger. ``{"enabled": False}``
+        when no objectives are configured (``--slo``/``SPARK_BAM_SLO``)."""
+        if self.slo_engine is None:
+            return {"slo": {"enabled": False, "objectives": [],
+                            "firing": [], "ledger": []}}
+        return {"slo": self.slo_engine.status()}
+
     def telemetry(self, req: "dict | None" = None) -> dict:
         """One scrape's worth of worker observability: the live obs
         snapshot (None when metrics are disabled), a tail of recent span
-        events, the flight-recorder ring, and the same stats dict the
-        ``stats`` op serves — everything the router's fleet collector and
-        the ``top`` CLI need in a single round-trip."""
+        events, the time-series ring snapshot, the SLO status, the
+        accounting rollups, the flight-recorder ring, and the same stats
+        dict the ``stats`` op serves — everything the router's fleet
+        collector and the ``top`` CLI need in a single round-trip."""
         req = req or {}
         max_spans = int(req.get("max_spans") or 256)
         reg = obs.registry()
@@ -369,6 +461,10 @@ class SplitService:
             "telemetry_enabled": reg is not None,
             "snapshot": snap,
             "spans": spans,
+            "series": self.rings.snapshot() if self.rings else None,
+            "slo": (self.slo_engine.status()
+                    if self.slo_engine is not None else None),
+            "accounting": self.accountant.snapshot(),
             "flight": flight.recorder().events(),
             "stats": self.stats(),
         }
@@ -711,5 +807,11 @@ class SplitService:
             "latency_p99_ms": _percentile(all_lat, 0.99),
             "split_resolutions": resolutions,
             "ops": ops,
+            "accounting": self.accountant.snapshot(),
+            # The compact SLO block the fabric autoscaler steers on
+            # (max_burn_fast + firing objective names); None without
+            # configured objectives.
+            "slo": (self.slo_engine.summary()
+                    if self.slo_engine is not None else None),
             **self._knobs(),
         }

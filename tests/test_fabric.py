@@ -614,6 +614,143 @@ def test_worker_pool_subprocess_smoke(bam_path, tmp_path):
             assert exc.value.error == "Draining"
 
 
+def test_fabric_cli_sigterm_leaves_router_drain_dump(tmp_path):
+    """Satellite: the ROUTER process narrates its own death. SIGTERM on
+    the fabric CLI must land a ``sigterm`` flight event and a graceful
+    ``drain`` dump carrying the routing counters + move-ledger tail —
+    attach mode, so no worker subprocess (and no compile) is involved."""
+    import os
+    import signal as _signal
+    import subprocess
+    import sys as _sys
+
+    from spark_bam_tpu.obs import flight
+
+    env = dict(os.environ, SPARK_BAM_FLIGHT_DIR=str(tmp_path),
+               JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [_sys.executable, "-c",
+         "from spark_bam_tpu.cli.main import main; import sys;"
+         " sys.exit(main(sys.argv[1:]))",
+         "fabric", "--attach", "tcp:127.0.0.1:1",
+         "--listen", "tcp:127.0.0.1:0", "--fabric", QUIET_FABRIC],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        deadline = time.monotonic() + 120.0
+        lines = []
+        while time.monotonic() < deadline:
+            line = proc.stderr.readline()
+            lines.append(line)
+            if "routing on" in line:
+                break
+        else:
+            pytest.fail(f"fabric CLI never announced: {lines}")
+        proc.send_signal(_signal.SIGTERM)
+        rc = proc.wait(timeout=60)
+        assert rc == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        proc.wait(timeout=10)
+        proc.stdout.close()
+        proc.stderr.close()
+    dumps = sorted(tmp_path.glob("flight-*-router-drain.jsonl"))
+    assert dumps, "SIGTERM must leave a router-side drain dump"
+    events = flight.read_dump(dumps[-1])
+    meta = events[0]
+    assert meta["reason"] == "drain"       # filename carries who=router
+    assert "counters" in meta and "moves" in meta
+    assert any(e.get("e") == "sigterm" for e in events[1:])
+
+
+@pytest.mark.slow
+def test_failover_exemplar_resolves_to_one_merged_trace(
+    bam_path, tmp_path, monkeypatch
+):
+    """Satellite: SIGKILL the rendezvous primary mid-load under a tail
+    sampler; the retried request's exemplar (pinned on the survivor)
+    must resolve to ONE merged trace tree spanning the router and the
+    surviving worker — not a half-kept orphan."""
+    import os
+    import subprocess
+
+    from spark_bam_tpu import obs as _obs
+    from spark_bam_tpu.obs import trace as obs_trace
+    from spark_bam_tpu.obs.report import merge_traces
+
+    art = tmp_path / "telemetry"
+    art.mkdir()
+    # slow_ms=0.1 ⇒ effectively every request is a "slow" keep: the
+    # retried request is guaranteed an exemplar; sample=0 proves the
+    # keep came from the tail rules, not the hash fraction.
+    slo = "serve.latency:p99<3600s@1m;sample=0.0,slow_ms=0.1"
+    env = dict(os.environ,
+               SPARK_BAM_METRICS_OUT=str(art),
+               SPARK_BAM_CACHE_DIR=str(tmp_path),
+               SPARK_BAM_CACHE="readwrite")
+    with _live_obs():
+        with WorkerPool(workers=2, devices=1,
+                        serve="window=64KB,halo=8KB,batch=8,tick=5",
+                        slo=slo, env=env,
+                        stderr=subprocess.DEVNULL) as pool:
+            router = Router(pool.addresses,
+                            config=Config(fabric=QUIET_FABRIC))
+            with ServerThread(router) as rsrv:
+                with ServeClient(rsrv.address) as c:
+                    c.request("plan", path=bam_path, split_size=256 << 10)
+                    expected = c.request("count", path=bam_path)["count"]
+                    # SIGKILL the rendezvous primary for this path: the
+                    # next request starts there and fails over mid-op.
+                    primary = max(
+                        range(2),
+                        key=lambda i: rendezvous_weight(f"w{i}", bam_path),
+                    )
+                    pool.kill(primary, hard=True)
+                    tid = obs_trace.new_id()
+                    resp = c.request("count", path=bam_path,
+                                     trace={"id": tid})
+                    assert resp["count"] == expected
+                    tel = c.request("telemetry")
+        _obs.export_jsonl(art / f"trace-{os.getpid()}.jsonl")
+        _obs.shutdown()
+
+    # The retried request's exemplar is pinned fleet-visibly by trace id.
+    exemplars = [e for h in tel["fleet"]["hists"]
+                 if h["name"] == "serve.latency_ms"
+                 for e in h.get("exemplars") or []]
+    assert tid in {e[1] for e in exemplars}, exemplars
+
+    # ...and that id resolves to ONE merged tree across the surviving
+    # processes: the router-side relay parents the worker-side request.
+    traces = sorted(art.glob("trace-*.jsonl"))
+    assert len(traces) >= 2          # survivor worker + the test process
+    merged = merge_traces([str(p) for p in traces])
+    assert tid in merged["traces"], sorted(merged["traces"])
+    evs = merged["traces"][tid]
+    names = {e["name"] for e in evs}
+    assert {"fabric.relay", "serve.request"} <= names
+    spans = {e["span"]: e for e in evs}
+    # Exactly one serve.request: the retry REPLACED the lost attempt
+    # (whose worker-side spans died with the worker), and it parents
+    # under a router-side relay — two processes, one tree.
+    reqs = [e for e in evs if e["name"] == "serve.request"]
+    assert len(reqs) == 1
+    relay = spans[reqs[0]["pspan"]]
+    assert relay["name"] == "fabric.relay"
+    assert relay.get("pid") != reqs[0].get("pid")
+    # No orphans: every root span is a router-side relay (one per
+    # attempt — the failed attempt's relay is part of the story), and
+    # every worker-side span chains up into one of them.
+    roots = [e for e in evs if e.get("pspan") not in spans]
+    assert roots and all(e["name"] == "fabric.relay" for e in roots)
+    for e in evs:
+        cur = e
+        while cur.get("pspan") in spans:
+            cur = spans[cur["pspan"]]
+        assert cur["name"] == "fabric.relay"
+
+
 @pytest.mark.slow
 def test_worker_pool_merged_trace_and_sigkill_dump(
     bam_path, tmp_path, monkeypatch

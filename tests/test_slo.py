@@ -1,0 +1,507 @@
+"""SLO engine + cost accounting: spec parsing, time-series ring queries,
+burn-rate alert transitions, per-request cost rollups, tail-sampled
+exemplars, the zero-dependency dashboard, and the autoscaler's burn-rate
+steering — the obs stage-2 surface (docs/observability.md)."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from spark_bam_tpu import obs
+from spark_bam_tpu.core.config import Config
+from spark_bam_tpu.obs.account import (
+    Accountant,
+    RequestCost,
+    merge_accounting,
+)
+from spark_bam_tpu.obs.dashboard import DashboardServer, parse_listen
+from spark_bam_tpu.obs.registry import Registry
+from spark_bam_tpu.obs.sampler import (
+    TailSampler,
+    keep_fraction_hash,
+    merge_exemplars,
+)
+from spark_bam_tpu.obs.slo import (
+    Objective,
+    SloConfig,
+    SloEngine,
+    burn_rate,
+    parse_window_s,
+)
+from spark_bam_tpu.obs.timeseries import (
+    RingStore,
+    SeriesView,
+    merge_series,
+)
+
+
+@pytest.fixture
+def reg():
+    obs.shutdown()
+    r = obs.configure()
+    yield r
+    obs.shutdown()
+
+
+# ----------------------------------------------------------------- parsing
+
+
+def test_parse_window_units():
+    assert parse_window_s("90s") == 90.0
+    assert parse_window_s("5m") == 300.0
+    assert parse_window_s("1h") == 3600.0
+    assert parse_window_s("500ms") == 0.5
+    with pytest.raises(ValueError):
+        parse_window_s("60")          # unit is mandatory
+    with pytest.raises(ValueError):
+        parse_window_s("5 minutes")
+
+
+def test_objective_parse_latency_alias_and_units():
+    o = Objective.parse("serve.latency:p99<1500ms@5m")
+    assert o.metric == "serve.latency_ms"       # .latency → .latency_ms
+    assert (o.agg, o.cmp) == ("p99", "<")
+    assert o.threshold == 1500.0
+    assert o.window_s == 300.0
+    assert o.name == "serve.latency:p99<1500ms@5m"   # canonical identity
+    # seconds normalize to ms; no window falls back to the default.
+    o2 = Objective.parse("serve.latency:p50<1.5s", default_window_s=60.0)
+    assert o2.threshold == 1500.0 and o2.window_s == 60.0
+
+
+def test_objective_parse_ratio_and_floor():
+    o = Objective.parse("serve.errors:ratio<0.1%@1h")
+    assert o.agg == "ratio" and o.threshold == pytest.approx(0.001)
+    assert o.denominator == "serve.requests"
+    floor = Objective.parse("serve.requests:rate>5@1m")
+    assert floor.cmp == ">" and floor.threshold == 5.0
+
+
+def test_objective_parse_rejects_bad_specs():
+    for bad in (
+        "serve.latency",                    # no comparator
+        "serve.latency:p42<10ms",           # unknown aggregation
+        "serve.latency:p99<0ms",            # non-positive threshold
+        "serve.latency:p99<5%",             # percent needs ratio
+        "serve.requests:ratio<1%",          # ratio is for <layer>.errors
+        "serve.latency:p99<10ms@forever",   # bad window
+    ):
+        with pytest.raises(ValueError):
+            Objective.parse(bad)
+
+
+def test_slo_config_parse_objectives_and_knobs():
+    scfg = SloConfig.parse(
+        "serve.latency:p99<1500ms@5m;serve.errors:ratio<0.1%@1h;"
+        "fast=2m;slow=30m;every=500ms;burn=2,sample=0.25,seed=7"
+    )
+    assert len(scfg.objectives) == 2 and scfg.enabled
+    assert scfg.fast_s == 120.0 and scfg.slow_s == 1800.0
+    assert scfg.every_ms == 500.0
+    assert (scfg.burn, scfg.sample, scfg.seed) == (2.0, 0.25, 7)
+    # The sampler's slow bar derives from the tightest latency objective.
+    assert scfg.sampler_slow_ms() == 1500.0
+    assert SloConfig.parse(
+        "serve.latency:p99<9ms;slow_ms=50"
+    ).sampler_slow_ms() == 50.0
+    assert not SloConfig.parse("").enabled
+    with pytest.raises(ValueError):
+        SloConfig.parse("nope=1")
+    with pytest.raises(ValueError):
+        SloConfig.parse("sample=1.5")
+
+
+def test_config_carries_slo_spec(monkeypatch):
+    cfg = Config(slo="serve.latency:p99<250ms@1m")
+    assert cfg.slo_config.objectives[0].threshold == 250.0
+    monkeypatch.setenv("SPARK_BAM_SLO", "serve.latency:p99<99ms")
+    assert Config.from_env().slo_config.objectives[0].threshold == 99.0
+
+
+# --------------------------------------------------------------- ring store
+
+
+def test_ring_delta_rate_ratio_over_window(reg):
+    rs = RingStore(reg, cadence_ms=1000.0)
+    c = obs.counter("serve.requests")
+    e = obs.counter("serve.errors")
+    t0 = 1000.0
+    for i in range(6):
+        c.inc(10)
+        if i >= 4:
+            e.inc(1)
+        rs.scrape(now=t0 + i)              # 1 Hz synthetic clock
+    assert rs.delta("serve.requests", window_s=3.0) == 30
+    assert rs.rate("serve.requests", window_s=3.0) == pytest.approx(10.0)
+    # Window wider than history degrades to available history.
+    assert rs.delta("serve.requests", window_s=999.0) == 50
+    assert rs.ratio("serve.errors", "serve.requests", 3.0) == \
+        pytest.approx(2 / 30)
+    # No traffic in the window ⇒ no error-budget spend, not 0/0.
+    assert rs.ratio("serve.errors", "nope", 3.0) is None
+    assert rs.delta("absent", 3.0) is None
+
+
+def test_ring_quantile_pools_label_sets(reg):
+    """serve.latency_ms exists twice: label-less (obs.observe) and
+    unit="ms" (span-derived). Windowed quantiles must pool both — an
+    objective names a series, not a label set."""
+    rs = RingStore(reg, cadence_ms=1000.0)
+    for v in (10.0, 20.0, 30.0):
+        obs.observe("serve.latency_ms", v)
+    reg.histogram("serve.latency_ms", unit="ms").observe(40.0)
+    rs.scrape()
+    assert rs.quantile("serve.latency_ms", 0.99, 60.0) == 40.0
+    assert rs.quantile("serve.latency_ms", 0.0, 60.0) == 10.0
+    assert rs.hist_mean("serve.latency_ms", 60.0) == pytest.approx(25.0)
+    assert rs.quantile("absent", 0.5, 60.0) is None
+
+
+def test_ring_bounded_and_scrape_thread(reg):
+    rs = RingStore(reg, cadence_ms=10.0, cap=5)
+    c = obs.counter("x.ticks")
+    rs.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            c.inc()
+            snap = rs.snapshot()
+            pts = next((s["points"] for s in snap["series"]
+                        if s["name"] == "x.ticks"), [])
+            if len(pts) == 5:
+                break
+            time.sleep(0.01)
+    finally:
+        rs.stop()
+    assert len(pts) == 5                  # ring capacity, not unbounded
+    counters = {s["name"] for s in rs.snapshot()["series"]}
+    assert "ts.scrapes" in counters       # the scraper meters itself
+
+
+def test_series_view_and_merge_series(reg):
+    rs = RingStore(reg, cadence_ms=1000.0)
+    obs.counter("serve.requests").inc(4)
+    obs.observe("serve.latency_ms", 12.0)
+    rs.scrape(now=2000.0)
+    obs.counter("serve.requests").inc(6)
+    rs.scrape(now=2001.0)
+    snap = rs.snapshot()
+    view = SeriesView(snap)
+    assert view.delta("serve.requests", 60.0) == 6
+    assert view.quantile("serve.latency_ms", 0.5, 1e9) == 12.0
+    assert view.hist_mean("serve.latency_ms", 60.0) == 12.0
+    # Fleet merge: same-bucket counter points sum across workers.
+    merged = merge_series([snap, snap])
+    mv = SeriesView(merged)
+    pts = mv._find("serve.requests", "counter")["points"]
+    assert [p[1] for p in pts] == [8, 20]
+    assert mv.quantile("serve.latency_ms", 0.5, 1e9) == 12.0
+    assert merge_series([None, {}])["series"] == []
+
+
+# ------------------------------------------------------------- burn + engine
+
+
+def test_burn_rate_directions():
+    budget = Objective.parse("serve.latency:p99<100ms@1m")
+    assert burn_rate(budget, 150.0) == 1.5
+    assert burn_rate(budget, 50.0) == 0.5
+    assert burn_rate(budget, None) == 0.0         # no data burns nothing
+    floor = Objective.parse("serve.requests:rate>10@1m")
+    assert burn_rate(floor, 5.0) == 2.0           # under the floor burns
+    assert burn_rate(floor, 20.0) == 0.5
+    assert burn_rate(floor, 0.0) == float("inf")
+
+
+class _StubView:
+    """A fixed-measurement view: every query answers ``value``."""
+
+    def __init__(self, value):
+        self.value = value
+
+    def quantile(self, name, q, window_s):
+        return self.value
+
+    def rate(self, name, window_s):
+        return self.value
+
+    def ratio(self, num, den, window_s):
+        return self.value
+
+    def hist_mean(self, name, window_s):
+        return self.value
+
+
+def test_engine_alert_fires_and_resolves(reg):
+    scfg = SloConfig.parse("serve.latency:p99<100ms@1m")
+    view = _StubView(50.0)
+    engine = SloEngine(scfg, lambda: view)
+    st = engine.evaluate()[0]
+    assert not st["firing"] and not engine.alerting
+    view.value = 250.0                    # the storm: both windows burn
+    st = engine.evaluate()[0]
+    assert st["burn_fast"] == 2.5 and st["firing"]
+    assert engine.alerting and engine.firing() == [st["objective"]]
+    # The transition (not every evaluation) lands one ledger entry.
+    engine.evaluate()
+    assert [e["state"] for e in engine.ledger] == ["firing"]
+    view.value = 50.0
+    engine.evaluate()
+    assert not engine.alerting
+    assert [e["state"] for e in engine.ledger] == ["firing", "resolved"]
+    # slo.* metrics rode along.
+    snap = reg.snapshot()
+    counters = {c["name"]: c["value"] for c in snap["counters"]}
+    assert counters["slo.alerts"] == 1 and counters["slo.evals"] == 4
+    summary = engine.summary()
+    assert summary["max_burn_fast"] == 0.5 and summary["firing"] == []
+    status = engine.status()
+    assert status["enabled"] and len(status["ledger"]) == 2
+
+
+def test_engine_needs_both_windows_to_fire(reg):
+    """Multi-window protection: a fast-window spike with a clean slow
+    window must NOT page."""
+    class _SplitView:
+        def quantile(self, name, q, window_s):
+            return 500.0 if window_s <= 60.0 else 10.0
+
+    scfg = SloConfig.parse("serve.latency:p99<100ms@1m;slow=1h")
+    engine = SloEngine(scfg, lambda: _SplitView())
+    st = engine.evaluate()[0]
+    assert st["burn_fast"] == 5.0 and st["burn_slow"] == 0.1
+    assert not st["firing"] and list(engine.ledger) == []
+
+
+# --------------------------------------------------------------- accounting
+
+
+def test_accountant_rollup_and_host_ms_derivation(reg):
+    acct = Accountant()
+    cost = acct.begin("count", tenant="acme")
+    cost.add(queue_ms=5.0, device_ms=10.0, h2d_bytes=1024, rows=2)
+    vec = acct.finish(cost, total_ms=40.0, bytes_served=256, ok=True)
+    assert vec["host_ms"] == 25.0          # total − queue − device
+    cost2 = acct.begin("count")            # tenant-less bills to "-"
+    acct.finish(cost2, total_ms=3.0, bytes_served=0, ok=False)
+    snap = acct.snapshot()
+    assert set(snap["tenants"]) == {"acme", "-"}
+    assert snap["tenants"]["acme"]["h2d_bytes"] == 1024
+    assert snap["tenants"]["acme"]["rows"] == 2
+    assert snap["ops"]["count"]["requests"] == 2
+    assert snap["ops"]["count"]["errors"] == 1
+    assert snap["totals"]["requests"] == 2
+    # Vectors conserve: per-tenant sums equal the global totals.
+    for f in ("queue_ms", "host_ms", "device_ms", "h2d_bytes"):
+        assert sum(t[f] for t in snap["tenants"].values()) == \
+            pytest.approx(snap["totals"][f])
+
+
+def test_accountant_host_ms_clamped_and_concurrent_adds(reg):
+    acct = Accountant()
+    cost = acct.begin("batch")
+    threads = [
+        threading.Thread(
+            target=lambda: [cost.add(queue_ms=0.5, h2d_bytes=8, rows=1)
+                            for _ in range(100)]
+        )
+        for _ in range(4)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    vec = acct.finish(cost, total_ms=1.0, bytes_served=0)
+    assert vec["queue_ms"] == pytest.approx(200.0)
+    assert vec["h2d_bytes"] == 3200 and cost.rows == 400
+    assert vec["host_ms"] == 0.0           # clamped, never negative
+
+
+def test_merge_accounting_fleet_rollup():
+    def one(n, tenant):
+        a = Accountant()
+        for _ in range(n):
+            c = a.begin("count", tenant)
+            c.add(queue_ms=1.0, h2d_bytes=10, rows=1)
+            a.finish(c, total_ms=2.0, bytes_served=5)
+        return a.snapshot()
+
+    obs.shutdown()                         # rollups work metrics-off too
+    m = merge_accounting([one(2, "a"), one(3, "b"), None])
+    assert m["tenants"]["a"]["requests"] == 2
+    assert m["tenants"]["b"]["h2d_bytes"] == 30
+    assert m["totals"]["requests"] == 5
+    assert m["totals"]["bytes_served"] == 25
+
+
+# ------------------------------------------------------------ tail sampling
+
+
+def test_sampler_decide_reasons_and_determinism():
+    s = TailSampler(fraction=0.5, seed=3, slow_ms=100.0)
+    assert s.decide("t1", 500.0) == (True, "slow")
+    assert s.decide("t1", 5.0, error=True) == (True, "error")
+    alerting = {"v": False}
+    s2 = TailSampler(fraction=0.0, seed=3, slow_ms=100.0,
+                     alerting=lambda: alerting["v"])
+    assert s2.decide("t1", 5.0) == (False, "unsampled")
+    alerting["v"] = True                   # incident window keeps all
+    assert s2.decide("t1", 5.0) == (True, "alert_window")
+    # Hash sampling is deterministic per (seed, trace): every worker
+    # reaches the same verdict, so merged trees are never half-kept.
+    ids = [f"{i:016x}" for i in range(400)]
+    kept = [t for t in ids if keep_fraction_hash(7, t) < 0.25]
+    assert kept == [t for t in ids if TailSampler(0.25, 7, 1e9).decide(
+        t, 1.0)[0]]
+    assert 0.15 < len(kept) / len(ids) < 0.35
+
+
+def test_sampler_note_prunes_traces_and_pins_exemplars(reg):
+    s = TailSampler(fraction=0.0, seed=0, slow_ms=100.0)
+    # A kept (slow) trace and a dropped (fast) one.
+    for tid, ms in (("a" * 16, 500.0), ("b" * 16, 1.0)):
+        reg.emit_span_event("serve.request", ms, trace_id=tid)
+        obs.observe("serve.latency_ms", ms)
+        s.note(tid, ms)
+    assert (s.kept, s.dropped) == (1, 1)
+    traces = {ev.get("trace") for ev in reg.events()}
+    assert traces == {"a" * 16}            # dropped trace pruned
+    hists = {(h["name"], tuple(sorted(h["labels"].items()))): h
+             for h in reg.snapshot()["hists"]}
+    ex = hists[("serve.latency_ms", ())]["exemplars"]
+    assert [e[1] for e in ex] == ["a" * 16]
+    assert ex[0][0] == 500.0
+    # Metrics survive sampling: both observations still count.
+    assert hists[("serve.latency_ms", ())]["count"] == 2
+    counters = {c["name"]: c["value"] for c in reg.snapshot()["counters"]}
+    assert counters["sampler.kept"] == 1
+    assert counters["sampler.dropped"] == 1
+    assert counters["sampler.exemplars"] == 1
+
+
+def test_exemplars_merge_and_prometheus_exposition(reg):
+    from spark_bam_tpu.obs.exporters import merge_snapshots, prometheus_text
+
+    a, b = Registry(), Registry()
+    a.histogram("serve.latency_ms").observe(10.0)
+    a.histogram("serve.latency_ms").add_exemplar(10.0, "a" * 16)
+    b.histogram("serve.latency_ms").observe(90.0)
+    b.histogram("serve.latency_ms").add_exemplar(90.0, "b" * 16)
+    merged = merge_snapshots([a.snapshot(), b.snapshot()])
+    h = next(h for h in merged["hists"] if h["name"] == "serve.latency_ms")
+    assert [e[1] for e in h["exemplars"]] == ["b" * 16, "a" * 16]  # by value
+    text = prometheus_text(merged)
+    assert f'trace_id="{"b" * 16}"' in text
+    assert merge_exemplars([[[5.0, "x", 0.0]], None,
+                            [[7.0, "y", 0.0]]], cap=1) == [[7.0, "y", 0.0]]
+
+
+# ---------------------------------------------------------------- dashboard
+
+
+def test_parse_listen_forms():
+    assert parse_listen("0.0.0.0:8080") == ("0.0.0.0", 8080)
+    assert parse_listen(":9090") == ("127.0.0.1", 9090)
+    assert parse_listen("9090") == ("127.0.0.1", 9090)
+    with pytest.raises(ValueError):
+        parse_listen("host:port")
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.status, r.headers.get("Content-Type", ""), r.read()
+
+
+def test_dashboard_endpoints(reg):
+    obs.counter("serve.requests").inc(3)
+    rs = RingStore(reg, cadence_ms=1000.0)
+    rs.scrape()
+    payload = {
+        "snapshot": reg.snapshot(),
+        "series": rs.snapshot(),
+        "slo": {"enabled": True, "objectives": [], "firing": []},
+        "accounting": {"tenants": {"acme": {"requests": 1}}},
+        "flight": [],
+    }
+    dash = DashboardServer("127.0.0.1:0", lambda: payload).start()
+    try:
+        status, ctype, body = _get(f"http://{dash.address}/")
+        assert status == 200 and "text/html" in ctype
+        assert b"sparkline" in body or b"spark(" in body
+        status, ctype, body = _get(f"http://{dash.address}/metrics")
+        assert status == 200 and b"serve_requests 3" in body
+        status, _, body = _get(f"http://{dash.address}/slo")
+        doc = json.loads(body)
+        assert doc["slo"]["enabled"] is True
+        assert doc["accounting"]["tenants"]["acme"]["requests"] == 1
+        status, _, body = _get(f"http://{dash.address}/series")
+        series = json.loads(body)
+        assert any(s["name"] == "serve.requests"
+                   for s in series["series"])
+        import urllib.error
+
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(f"http://{dash.address}/nope")
+        assert exc.value.code == 404
+    finally:
+        dash.stop()
+
+
+def test_dashboard_provider_error_is_503(reg):
+    def boom():
+        raise RuntimeError("scrape failed")
+
+    dash = DashboardServer("127.0.0.1:0", boom).start()
+    try:
+        import urllib.error
+
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(f"http://{dash.address}/slo")
+        assert exc.value.code == 503
+    finally:
+        dash.stop()
+
+
+# ------------------------------------------------- autoscaler burn steering
+
+
+def test_autoscaler_steers_on_burn_rate():
+    from spark_bam_tpu.fabric import FabricConfig
+    from spark_bam_tpu.fabric.autoscaler import decide_with_reason
+
+    fcfg = FabricConfig.parse("slo=200")
+    base = {"batch_rows": 16, "tick_ms": 8.0,
+            "limits": {"scan": 64, "plan": 64}}
+    # A firing alert downscales and CITES the objective.
+    move, reason = decide_with_reason(
+        dict(base, slo={"max_burn_fast": 3.2,
+                        "firing": ["serve.latency:p99<100ms@1m"],
+                        "worst": "serve.latency:p99<100ms@1m"}),
+        fcfg,
+    )
+    assert move["batch_rows"] == 8
+    assert reason.startswith("slo_alert:serve.latency:p99<100ms@1m")
+    # Burn ≥ 1 without a confirmed alert still sheds.
+    move, reason = decide_with_reason(
+        dict(base, slo={"max_burn_fast": 1.4, "firing": [], "worst": "o"}),
+        fcfg,
+    )
+    assert move and "burn=1.4" in reason
+    # Headroom reclaims; the mid-band holds.
+    move, reason = decide_with_reason(
+        dict(base, slo={"max_burn_fast": 0.2, "firing": []}), fcfg
+    )
+    assert move["batch_rows"] == 20 and "burn=0.2" in reason
+    assert decide_with_reason(
+        dict(base, slo={"max_burn_fast": 0.8, "firing": []}), fcfg
+    ) == (None, None)
+    # burn == 0 means "no samples": fall back to the p99 path.
+    move, reason = decide_with_reason(
+        dict(base, latency_p99_ms=500.0,
+             slo={"max_burn_fast": 0.0, "firing": []}),
+        fcfg,
+    )
+    assert move and "p99=500.0ms" in reason
