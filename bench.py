@@ -1652,6 +1652,222 @@ def _child_fabric(clients: int = 16, per_client: int = 4):
     })
 
 
+def _child_fabric_chaos(clients: int = 4):
+    """Fabric chaos-storm leg (docs/robustness.md "Fleet resilience").
+
+    The resilience A/B: the SAME streaming fabric (3 workers,
+    ``stream=1``, retry budget) measured clean and then under a seeded
+    ``ChaosStorm`` — rolling SIGKILLs, one SIGSTOP wedge, and link-level
+    frame truncation — with every answer equal-bytes gated against the
+    clean run. Phases:
+
+    1. **clean** — 3 workers behind a streaming router, closed-loop
+       count/batch load → reference RPS/p99 and the byte-identity
+       reference frames;
+    2. **storm** — a second router over the same pool carries identical
+       load while the storm runs. The rendezvous-winning wid slot is
+       handed to the storm's primary victim so kills land on links with
+       requests in flight. Gates: zero lost requests (the load loop
+       re-raises), byte-identical batches, ≥5 kills + ≥1 wedge
+       actually executed, ≥1 mid-stream resume on a replacement worker,
+       and retry amplification ≤ 2× (dispatches over admitted — the
+       budget's steady-state bound).
+
+    The degradation ratio (storm RPS over clean RPS) is the headline:
+    chaos should cost latency, never answers.
+    """
+    _emit_stage("start")
+    import shutil
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    from spark_bam_tpu.benchmarks.synth import synthetic_fixture
+    from spark_bam_tpu.core.config import Config as C
+    from spark_bam_tpu.fabric import (
+        ChaosStorm, Router, WorkerPool, rendezvous_weight,
+    )
+    from spark_bam_tpu.fabric.chaos import FabricChaosSpec, storm_schedule
+    from spark_bam_tpu.serve import ServeClient, ServerThread
+
+    path = str(synthetic_fixture())
+    tmp = tempfile.mkdtemp(prefix="sbt_fabric_chaos_leg_")
+    spec = "window=64KB,halo=8KB,batch=8,tick=2"
+    wenv = dict(os.environ, SPARK_BAM_CACHE_DIR=tmp,
+                SPARK_BAM_CACHE="readwrite")
+    seed = 20260807
+    storm_spec = FabricChaosSpec.parse(
+        "kills=5+wedges=1+storm=700+revive=350"
+    )
+    # eject_max/holddown capped low: trunc chaos poisons reprobe pings
+    # too, so default multi-second holddowns could park ALL workers at
+    # once mid-storm; capped, the fleet is never dark for long.
+    resilience = (
+        "stream=1,budget=64,budget_rate=1,probe=150,probe_timeout=1000,"
+        "eject=100,eject_max=150,holddown=200,autoscale=60000"
+    )
+    lock = threading.Lock()
+    retries = [0]
+
+    def p99(lat):
+        return lat[min(len(lat) - 1, int(len(lat) * 0.99))]
+
+    def hammer(addr, expected, ref, until=None, per=12):
+        """Closed-loop mixed load: every 2nd request a streaming
+        ``batch``, the rest whole-file counts; ``until`` keeps clients
+        looping while it's true (the storm's lifetime). Any wrong
+        answer or failed request raises — zero loss is a gate."""
+        lat: list = []
+        n_ok = [0]
+
+        def call(c, op):
+            """One request, pacing through WorkerLost: the router
+            surfaces the loss when its retry budget is empty — by
+            design the CLIENT owns the next retry (docs/robustness.md).
+            Exhausting the patience window IS a lost request."""
+            from spark_bam_tpu.serve.client import ServeClientError
+
+            for _ in range(40):
+                try:
+                    r = c.request(op, path=path)
+                    return (b"".join(r["_binary"]) if op == "batch"
+                            else r["count"])
+                except ServeClientError as exc:
+                    if exc.error != "WorkerLost":
+                        raise
+                    with lock:
+                        retries[0] += 1
+                    time.sleep(0.15)
+            raise AssertionError(f"{op} lost: fleet never recovered")
+
+        def one(ci):
+            with ServeClient(addr) as c:
+                i = 0
+                while (i < per if until is None
+                       else (until() or i < per)) and i < 400:
+                    t0 = time.perf_counter()
+                    if i % 2:
+                        if call(c, "batch") != ref:
+                            raise AssertionError(
+                                "storm batch diverged from clean frames"
+                            )
+                    elif call(c, "count") != expected:
+                        raise AssertionError("count diverged under storm")
+                    dt = (time.perf_counter() - t0) * 1e3
+                    with lock:
+                        lat.append(dt)
+                        n_ok[0] += 1
+                    i += 1
+
+        t0 = time.perf_counter()
+        with ThreadPoolExecutor(clients) as ex:
+            for f in [ex.submit(one, i) for i in range(clients)]:
+                f.result()      # re-raises: a lost request fails the leg
+        return time.perf_counter() - t0, sorted(lat), n_ok[0]
+
+    try:
+        with WorkerPool(workers=3, devices=1, serve=spec, env=wenv,
+                        stderr=subprocess.DEVNULL) as pool:
+            with ServeClient(pool.addresses[0]) as c:
+                c.request("plan", path=path, split_size=256 << 10)
+                expected = c.request("count", path=path)["count"]
+                ref = b"".join(c.request("batch", path=path)["_binary"])
+            # The seeded schedule aims its kills at fixed POOL indices;
+            # routing aims single-path traffic at the rendezvous-winning
+            # WID. Hand the storm's favourite victim the winning slot so
+            # kills provably catch requests (and streams) in flight.
+            kill_counts: "dict[int, int]" = {}
+            for _t, victim, action in storm_schedule(
+                seed, 3, storm_spec
+            ):
+                if action == "kill":
+                    kill_counts[victim] = kill_counts.get(victim, 0) + 1
+            primary = max(range(3), key=lambda i: kill_counts.get(i, 0))
+            slots = sorted(range(3), reverse=True,
+                           key=lambda i: rendezvous_weight(f"w{i}", path))
+            order = [primary] + [i for i in range(3) if i != primary]
+            addrs: "list" = [None] * 3
+            for slot, pidx in zip(slots, order):
+                addrs[slot] = pool.addresses[pidx]
+            _emit_stage("fabric_chaos_warm")
+
+            # --- phase 1: clean streaming fabric -------------------------
+            router = Router(addrs, config=C(fabric=resilience), pool=pool)
+            rsrv = ServerThread(router).start()
+            try:
+                wall_c, lat_c, n_clean = hammer(
+                    rsrv.address, expected, ref
+                )
+            finally:
+                rsrv.stop()
+            rps_clean = n_clean / wall_c
+            _emit_stage(f"fabric_chaos_clean:{rps_clean:.1f}rps")
+
+            # --- phase 2: the storm --------------------------------------
+            router = Router(addrs, config=C(
+                fabric=f"{resilience},"
+                       f"chaos={seed}:trunc=0.12+kills=5+wedges=1"
+            ), pool=pool)
+            rsrv = ServerThread(router).start()
+            try:
+                storm = ChaosStorm(pool, seed, storm_spec)
+                storm.start()
+                wall_s, lat_s, n_storm = hammer(
+                    rsrv.address, expected, ref,
+                    until=lambda: storm._thread.is_alive(),
+                )
+                storm.join(timeout_s=120.0)
+                counters = dict(router.counters)
+            finally:
+                rsrv.stop()
+            rps_storm = n_storm / wall_s
+            kills = sum(e["action"] == "kill" for e in storm.events)
+            wedges = sum(e["action"] == "wedge" for e in storm.events)
+        _emit_stage(f"fabric_chaos_storm:{rps_storm:.1f}rps")
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    dispatches = counters.get("routed", 0) + counters.get("failovers", 0)
+    # Admitted = every client attempt (paced WorkerLost re-sends are
+    # re-admitted); the budget bounds dispatches per ADMISSION.
+    amplification = dispatches / max(n_storm + retries[0], 1)
+    resumed = int(counters.get("resumed", 0))
+    if kills < 5 or wedges < 1:
+        raise AssertionError(
+            f"storm under-delivered: kills={kills} wedges={wedges}"
+        )
+    if resumed < 1:
+        raise AssertionError(
+            f"no mid-stream resume under the storm: {counters}"
+        )
+    if amplification > 2.0:
+        raise AssertionError(
+            f"retry amplification {amplification:.2f} > 2.0: {counters}"
+        )
+    _emit_result("fabric_chaos", {
+        "fabric_chaos_seed": seed,
+        "fabric_chaos_clients": clients,
+        "fabric_chaos_kills": kills,
+        "fabric_chaos_wedges": wedges,
+        "fabric_chaos_reqs": n_storm,
+        "fabric_chaos_lost": 0,    # the load loop re-raises; gated
+        "fabric_chaos_batch_equal": True,
+        "fabric_chaos_clean_rps": round(rps_clean, 1),
+        "fabric_chaos_storm_rps": round(rps_storm, 1),
+        "fabric_chaos_degradation": round(
+            rps_storm / max(rps_clean, 1e-9), 3
+        ),
+        "fabric_chaos_clean_p99_ms": round(p99(lat_c), 1),
+        "fabric_chaos_storm_p99_ms": round(p99(lat_s), 1),
+        "fabric_chaos_failovers": int(counters.get("failovers", 0)),
+        "fabric_chaos_client_retries": int(retries[0]),
+        "fabric_chaos_resumed": resumed,
+        "fabric_chaos_breaker_opened": int(
+            counters.get("breaker.opened", 0)
+        ),
+        "fabric_chaos_amplification": round(amplification, 3),
+    })
+
+
 def _child_export(shots: int = 3, serve_queries: int = 12):
     """Columnar export leg (CPU backend, docs/analytics.md).
 
@@ -2747,6 +2963,24 @@ def fabric_leg():
     return out
 
 
+def fabric_chaos_leg():
+    """Parent wrapper for the chaos-storm leg (own child: the storm
+    SIGKILLs/SIGSTOPs real worker subprocesses — isolated so a wedged
+    process tree can't take the driver down). Budget env-tunable; 0
+    skips the leg."""
+    budget = int(os.environ.get("SB_BENCH_FABRIC_CHAOS_CHILD_S", "300"))
+    if budget <= 0:
+        return {}
+    results, stages, err = _run_child(["--child-fabric-chaos"], budget)
+    out = results.get("fabric_chaos")
+    if out is None:
+        raise RuntimeError(
+            "fabric chaos child produced no result: "
+            f"{err or 'stages=' + str(stages)}"
+        )
+    return out
+
+
 def main():
     if len(sys.argv) > 1 and sys.argv[1] == "--child-all":
         _child_device_all(
@@ -2777,6 +3011,9 @@ def main():
         return
     if len(sys.argv) > 1 and sys.argv[1] == "--child-fabric":
         _child_fabric()
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == "--child-fabric-chaos":
+        _child_fabric_chaos()
         return
     if len(sys.argv) > 1 and sys.argv[1] == "--tokenize-only":
         # Standalone read-path entropy-phase A/B: lands a
@@ -3242,6 +3479,13 @@ def _main_measure(record, warnings, errors):
         record.update(fabric_leg())
     except Exception as e:
         warnings.append(f"fabric leg: {type(e).__name__}: {e}")
+    # Chaos-storm leg: the same streaming fabric clean vs under a seeded
+    # kill/wedge/truncation storm — zero lost, equal-bytes, resume and
+    # amplification gated (own child process — docs/robustness.md).
+    try:
+        record.update(fabric_chaos_leg())
+    except Exception as e:
+        warnings.append(f"fabric chaos leg: {type(e).__name__}: {e}")
     # Host-zlib vs two-phase device inflate on identical windows
     # (in-process backend). setdefault: the inflate child's TPU-measured
     # first-class fields win when they landed; this leg guarantees the

@@ -390,7 +390,11 @@ def build_parser() -> argparse.ArgumentParser:
         "--fabric", default=None, metavar="SPEC",
         help="fabric knobs, e.g. 'workers=3,slo=200,probe=500,spill=8,"
              "batch_ceil=32' (SPARK_BAM_FABRIC env var works too; "
-             "docs/fabric.md)",
+             "docs/fabric.md). Resilience: budget/budget_rate, flap_k/"
+             "flap_window/holddown, brownout[_frac], stream=1 for "
+             "resumable streaming relay. Seeded fleet chaos: "
+             "'chaos=SEED:drop=0.05+trunc=0.02+delay=0.1x20' "
+             "(docs/robustness.md)",
     )
     sub.add_argument(
         "--serve", default=None, metavar="SPEC",
@@ -866,6 +870,7 @@ def main(argv=None) -> int:
                     dash.stop()
                 service.close()
         elif cmd == "fabric":
+            import os
             import signal as _signal
 
             from spark_bam_tpu.fabric import Router, WorkerPool
@@ -873,10 +878,16 @@ def main(argv=None) -> int:
             from spark_bam_tpu.serve import serve_forever
 
             fcfg = config.fabric_config
+            # Workers inherit the fabric spec via env so a chaos run's
+            # seed lands in THEIR flight dumps too (fabric/worker.py).
+            worker_env = None
+            if config.fabric:
+                worker_env = dict(os.environ,
+                                  SPARK_BAM_FABRIC=config.fabric)
             pool = WorkerPool(
                 workers=fcfg.workers, devices=args.worker_devices,
                 serve=config.serve, columnar=config.columnar,
-                slo=config.slo, attach=args.attach,
+                slo=config.slo, attach=args.attach, env=worker_env,
             )
             addresses = pool.start()
             router = Router(addresses, config=config, pool=pool)
@@ -893,11 +904,15 @@ def main(argv=None) -> int:
             _signal.signal(_signal.SIGTERM, _graceful)
             dash = None
             try:
+                chaos_note = (
+                    f" [chaos {router.chaos.describe()}]"
+                    if router.chaos is not None else ""
+                )
                 print(
                     f"fabric: routing on {args.listen} over "
                     f"{len(addresses)} workers "
                     f"({'attached' if args.attach else 'launched'}: "
-                    f"{', '.join(addresses)}) — Ctrl-C to stop",
+                    f"{', '.join(addresses)}){chaos_note} — Ctrl-C to stop",
                     file=sys.stderr,
                 )
                 if args.dashboard:

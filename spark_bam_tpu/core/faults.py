@@ -437,6 +437,13 @@ def install_chaos(arg: str | tuple[int, ChaosSpec]) -> ChaosState:
     _channel.set_chaos_wrapper(
         lambda ch, path: ChaosChannel(ch, seed, spec, state=state)
     )
+    # Stamp the seed into the flight-recorder dump context: any crash or
+    # postmortem artifact from a chaos run reproduces the run by itself.
+    from spark_bam_tpu.obs import flight
+    flight.set_context(
+        chaos_seed=seed,
+        chaos_spec=arg if isinstance(arg, str) else f"{seed}:{spec}",
+    )
     return state
 
 
@@ -444,6 +451,8 @@ def uninstall_chaos() -> None:
     global _installed
     _installed = None
     _channel.set_chaos_wrapper(None)
+    from spark_bam_tpu.obs import flight
+    flight.clear_context("chaos_seed", "chaos_spec")
 
 
 def installed_chaos() -> ChaosState | None:

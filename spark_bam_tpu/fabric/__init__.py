@@ -3,16 +3,31 @@
 A router with file-path affinity fronts N serve workers (one per host
 over ``jax.distributed``, or N local processes), each running its own
 accept loop, compiled ``MeshSteps``, flat-view LRU and ``.sbi`` warm
-tier. Health probes eject dead workers with exponential re-probe; a
-per-worker SLO control loop retunes ``batch_rows``/``tick_ms`` and the
-admission caps from the same ``stats`` percentiles operators read; a
-worker dying mid-request fails idempotent ops over to another worker
-exactly once, byte-identically. See docs/fabric.md.
+tier. Health probes drive a per-link circuit breaker
+(closed/open/half-open with flap hold-down); a worker dying mid-request
+fails idempotent ops over to another worker under a router-wide retry
+budget, byte-identically — with ``stream=1``, even mid-frame-stream via
+``resume_from`` tokens. A seeded chaos layer (``chaos=SEED:SPEC``,
+fabric/chaos.py) attacks all of it deterministically. See
+docs/fabric.md and docs/robustness.md ("Fleet resilience").
 """
 
 from spark_bam_tpu.fabric.autoscaler import autoscale_worker, decide
+from spark_bam_tpu.fabric.chaos import (
+    ChaosStorm,
+    ChaosWorkerLink,
+    FabricChaos,
+    FabricChaosSpec,
+    parse_fabric_chaos,
+    storm_schedule,
+)
 from spark_bam_tpu.fabric.config import FabricConfig
 from spark_bam_tpu.fabric.health import monitor_worker
+from spark_bam_tpu.fabric.resilience import (
+    CircuitBreaker,
+    RetryBudget,
+    brownout_level,
+)
 from spark_bam_tpu.fabric.router import (
     IDEMPOTENT_OPS,
     Router,
@@ -23,15 +38,24 @@ from spark_bam_tpu.fabric.router import (
 from spark_bam_tpu.fabric.worker import WorkerPool, serve_worker
 
 __all__ = [
+    "ChaosStorm",
+    "ChaosWorkerLink",
+    "CircuitBreaker",
+    "FabricChaos",
+    "FabricChaosSpec",
     "FabricConfig",
     "IDEMPOTENT_OPS",
+    "RetryBudget",
     "Router",
     "WorkerLink",
     "WorkerLost",
     "WorkerPool",
     "autoscale_worker",
+    "brownout_level",
     "decide",
     "monitor_worker",
+    "parse_fabric_chaos",
     "rendezvous_weight",
     "serve_worker",
+    "storm_schedule",
 ]

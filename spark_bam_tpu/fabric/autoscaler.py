@@ -116,14 +116,21 @@ def decide(stats: dict, fcfg) -> "dict | None":
     return move
 
 
-async def autoscale_worker(link, fcfg, count, note_move=None) -> None:
+async def autoscale_worker(link, fcfg, count, note_move=None,
+                           hold=None) -> None:
     """Control loop for one worker link; ``count`` is the router's
     counter hook (``autoscale_moves``), ``note_move`` its move-ledger
-    hook — called with ``{worker, move, reason}`` per actuated move."""
+    hook — called with ``{worker, move, reason}`` per actuated move.
+    ``hold`` (optional callable → bool) freezes actuation while true:
+    the router holds during brownout, because stats measured under
+    edge-shed traffic would read as idleness and downscale the exact
+    capacity the fleet needs back."""
     prev_served = None
     while True:
         await asyncio.sleep(fcfg.autoscale_ms / 1000.0)
         if not link.healthy or link.draining:
+            continue
+        if hold is not None and hold():
             continue
         try:
             stats = await link.request({"op": "stats"})

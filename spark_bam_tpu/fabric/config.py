@@ -27,6 +27,17 @@ class FabricConfig:
     eject_max_ms: float = 8000.0  # re-probe backoff ceiling (doubles)
     autoscale_ms: float = 1000.0  # control-loop period per worker
     spill: int = 8                # affinity target inflight before spillover
+    # --- resilience (fabric/resilience.py; docs/robustness.md) ---
+    budget: int = 32              # retry-budget token-bucket capacity
+    budget_rate: float = 0.1      # tokens earned per admitted request
+    flap_k: int = 4               # breaker openings within flap_window_ms ...
+    flap_window_ms: float = 10_000.0  # ... that trigger hold-down
+    holddown_ms: float = 5000.0   # re-probe floor while flapping
+    brownout: int = 0             # opt-in: shed by class when unhealthy
+    brownout_frac: float = 0.5    # healthy fraction at/below which to shed
+    # --- streaming failover + chaos (both opt-in; zero cost unset) ---
+    stream: int = 0               # relay batch frames as they arrive
+    chaos: str = ""               # "SEED:SPEC" (fabric/chaos.py grammar)
     # --- autoscaler actuation bounds (per worker, via the ``tune`` op) ---
     batch_floor: int = 1          # batch_rows floor (mesh-rounded upward)
     batch_ceil: int = 64          # batch_rows ceiling
@@ -55,6 +66,28 @@ class FabricConfig:
             )
         if self.spill < 1:
             raise ValueError(f"fabric spill must be >= 1: {self.spill}")
+        if self.budget < 0 or self.budget_rate < 0:
+            raise ValueError(
+                f"fabric budget/budget_rate must be >= 0: "
+                f"{self.budget}/{self.budget_rate}"
+            )
+        if self.flap_k < 1:
+            raise ValueError(f"fabric flap_k must be >= 1: {self.flap_k}")
+        if self.flap_window_ms <= 0 or self.holddown_ms <= 0:
+            raise ValueError(
+                f"fabric flap_window/holddown must be > 0 ms: "
+                f"{self.flap_window_ms}/{self.holddown_ms}"
+            )
+        if not 0.0 < self.brownout_frac <= 1.0:
+            raise ValueError(
+                f"fabric brownout_frac must be in (0, 1]: {self.brownout_frac}"
+            )
+        if self.chaos:
+            # Validate the sub-spec eagerly so a typo'd --fabric fails at
+            # parse time, not mid-storm (local import: chaos.py imports
+            # nothing from here, but keep the unconfigured path lean).
+            from spark_bam_tpu.fabric.chaos import parse_fabric_chaos
+            parse_fabric_chaos(self.chaos)
         for lo, hi in (("batch_floor", "batch_ceil"),
                        ("tick_floor", "tick_ceil"),
                        ("scanq_floor", "scanq_ceil"),
@@ -84,6 +117,17 @@ class FabricConfig:
         "autoscale": "autoscale_ms",
         "autoscale_ms": "autoscale_ms",
         "spill": "spill",
+        "budget": "budget",
+        "budget_rate": "budget_rate",
+        "flap_k": "flap_k",
+        "flap_window": "flap_window_ms",
+        "flap_window_ms": "flap_window_ms",
+        "holddown": "holddown_ms",
+        "holddown_ms": "holddown_ms",
+        "brownout": "brownout",
+        "brownout_frac": "brownout_frac",
+        "stream": "stream",
+        "chaos": "chaos",
         "batch_floor": "batch_floor",
         "batch_ceil": "batch_ceil",
         "tick_floor": "tick_floor",
@@ -94,7 +138,10 @@ class FabricConfig:
         "planq_ceil": "planq_ceil",
     }
     _FLOAT_KEYS = ("slo_p99_ms", "probe_ms", "probe_timeout_ms", "eject_ms",
-                   "eject_max_ms", "autoscale_ms", "tick_floor", "tick_ceil")
+                   "eject_max_ms", "autoscale_ms", "tick_floor", "tick_ceil",
+                   "budget_rate", "flap_window_ms", "holddown_ms",
+                   "brownout_frac")
+    _STR_KEYS = ("chaos",)
 
     @staticmethod
     @lru_cache(maxsize=64)
@@ -115,7 +162,9 @@ class FabricConfig:
                     f"Unknown fabric-config key {key!r}: expected one of "
                     f"{', '.join(sorted(set(FabricConfig._KEYS)))}"
                 )
-            if field in FabricConfig._FLOAT_KEYS:
+            if field in FabricConfig._STR_KEYS:
+                kw[field] = value
+            elif field in FabricConfig._FLOAT_KEYS:
                 kw[field] = float(value)
             else:
                 kw[field] = int(value)

@@ -15,17 +15,35 @@ Path-less ops (``fleet``) always go least-loaded.
 
 Failover: a worker dying mid-request fails every request pending on its
 link with :class:`WorkerLost`; idempotent ops (``plan`` /
-``record_starts`` / ``count`` / ``batch``) are re-dispatched to another
-worker exactly ONCE per request, everything else surfaces a typed
-``WorkerLost`` error. The router buffers a worker's complete response
-(JSON + all binary frames) before relaying it, so a mid-stream death
-never leaks partial frames to the client — the failover answer is
-byte-identical to a healthy worker's.
+``record_starts`` / ``count`` / ``batch`` / ``rewrite``) are
+re-dispatched to another worker while the router-wide
+:class:`~spark_bam_tpu.fabric.resilience.RetryBudget` holds tokens —
+retries can't amplify into a storm because every re-dispatch spends from
+a bucket refilled only by admitted traffic. Everything else surfaces a
+typed ``WorkerLost`` error. By default the router buffers a worker's
+complete response (JSON + all binary frames) before relaying it, so a
+mid-stream death never leaks partial frames to the client; with
+``stream=1`` the ``batch`` op instead relays frames AS THEY ARRIVE over
+a dedicated upstream connection and, on a mid-stream death, resumes on a
+replacement worker from a frame-sequence token (``resume_from=N``) —
+byte-identical output without ever holding a full response in router
+memory (docs/robustness.md "Resumable streaming failover").
 
 Upstream ``Overloaded``/``Draining`` answers spill across the remaining
 workers; only when EVERY healthy worker sheds does the router pace a
-jittered ``FaultPolicy`` retry round, and after the retry budget it
-relays the shed response for the client's own retry loop.
+jittered ``FaultPolicy`` retry round (shed responses without a
+``retry_after_ms`` hint are paced by the router's own rolling latency
+median, jittered), and after the retry rounds it relays the shed
+response for the client's own retry loop. With ``brownout=1`` the router
+itself sheds by admission class while the healthy fraction of the fleet
+sits at/below ``brownout_frac`` — scan-class first, everything at half
+that fraction — so queues on the survivors don't collapse.
+
+Chaos: ``chaos=SEED:SPEC`` in the fabric spec swaps the links for
+``fabric/chaos.py``'s :class:`ChaosWorkerLink` and (with ``accept>0``)
+the accept-loop entry point for a delaying wrapper — both chosen at
+CONSTRUCTION, so an unconfigured router runs the exact same hot path as
+before this layer existed.
 """
 
 from __future__ import annotations
@@ -33,22 +51,28 @@ from __future__ import annotations
 import asyncio
 import hashlib
 import json
+import random
 import struct
 import time
 from collections import deque
 
 from spark_bam_tpu import obs
 from spark_bam_tpu.core.config import Config
-from spark_bam_tpu.core.faults import FaultPolicy
+from spark_bam_tpu.core.faults import FaultPolicy, LatencyTracker
 from spark_bam_tpu.fabric.config import FabricConfig
+from spark_bam_tpu.fabric.resilience import RetryBudget, brownout_level
 from spark_bam_tpu.obs import flight
 from spark_bam_tpu.obs import trace as obs_trace
+from spark_bam_tpu.serve.admission import CLASS_OF
 from spark_bam_tpu.serve.protocol import error_response, ok_response
 from spark_bam_tpu.serve.server import MAX_LINE, ServeAddress
 
 #: ops safe to re-dispatch after a mid-request worker death: pure reads
-#: whose answers are deterministic for unchanged files.
-IDEMPOTENT_OPS = frozenset({"plan", "record_starts", "count", "batch"})
+#: whose answers are deterministic for unchanged files, plus ``rewrite``
+#: (its output commit is atomic — a re-run overwrites, never interleaves).
+IDEMPOTENT_OPS = frozenset(
+    {"plan", "record_starts", "count", "batch", "rewrite"}
+)
 
 
 class WorkerLost(ConnectionError):
@@ -81,6 +105,7 @@ class WorkerLink:
         )
         self.healthy = False
         self.draining = False
+        self.breaker = None      # attached by fabric/health.monitor_worker
         self._reader = None
         self._writer = None
         self._reader_task = None
@@ -156,14 +181,29 @@ class WorkerLink:
                         (length,) = struct.unpack("<Q", hdr)
                         frames.append(await self._reader.readexactly(length))
                     resp["_binary"] = frames
-                fut = self._pending.pop(resp.get("id"), None)
-                self._pending_meta.pop(resp.get("id"), None)
-                if fut is not None and not fut.done():
-                    fut.set_result(resp)
+                self._resolve(resp)
         except asyncio.CancelledError:
             raise
         except Exception as exc:
             self._fail(exc)
+
+    def _resolve(self, resp: dict) -> None:
+        """Hand a complete response to its waiting future. A second
+        delivery of the same id (duplicate under chaos) finds the future
+        already popped and falls on the floor — id-dedup is structural."""
+        uid = resp.get("id")
+        fut = self._pending.pop(uid, None)
+        self._pending_meta.pop(uid, None)
+        if fut is not None and not fut.done():
+            fut.set_result(resp)
+
+    def eject(self, exc: BaseException) -> None:
+        """Forcibly eject the worker: fail every pending future with
+        :class:`WorkerLost` and tear the connection down. The health
+        monitor calls this on probe timeout — a WEDGED (SIGSTOP'd)
+        worker keeps its socket open and never answers, so requests in
+        flight on it would otherwise hang forever."""
+        self._fail(exc)
 
     def _fail(self, exc: BaseException, expected: bool = False) -> None:
         """Connection-level death: mark down NOW (placement must stop
@@ -226,9 +266,33 @@ class Router:
         self.config = config if config is not None else Config()
         self.fcfg: FabricConfig = self.config.fabric_config
         self.policy: FaultPolicy = self.config.fault_policy
-        self.links = [
-            WorkerLink(f"w{i}", addr) for i, addr in enumerate(addresses)
-        ]
+        # Chaos is decided HERE, once: a configured fabric gets chaos
+        # link subclasses and (for accept>0) a delaying submit wrapper;
+        # an unconfigured fabric gets the plain classes — zero chaos
+        # branches anywhere on its hot path.
+        self.chaos = None
+        if self.fcfg.chaos:
+            from spark_bam_tpu.fabric.chaos import (
+                ChaosWorkerLink,
+                FabricChaos,
+                install_context,
+                parse_fabric_chaos,
+            )
+            seed, spec = parse_fabric_chaos(self.fcfg.chaos)
+            self.chaos = FabricChaos(seed, spec)
+            install_context(self.chaos)
+            self.links = [
+                ChaosWorkerLink(f"w{i}", addr, self.chaos)
+                for i, addr in enumerate(addresses)
+            ]
+            if spec.accept > 0:
+                self.submit = self._chaos_submit
+        else:
+            self.links = [
+                WorkerLink(f"w{i}", addr) for i, addr in enumerate(addresses)
+            ]
+        self.budget = RetryBudget(self.fcfg.budget, self.fcfg.budget_rate)
+        self._latency = LatencyTracker(window=128)
         self.pool = pool            # optional WorkerPool (drain → terminate)
         self.draining = False
         self.counters: "dict[str, int]" = {}
@@ -269,7 +333,8 @@ class Router:
             ))
             self._tasks.append(asyncio.ensure_future(
                 autoscale_worker(link, self.fcfg, self._count,
-                                 note_move=self._note_move)
+                                 note_move=self._note_move,
+                                 hold=self._autoscale_hold)
             ))
 
     async def aclose(self) -> None:
@@ -318,6 +383,44 @@ class Router:
                 self._count("spilled")
             return spill
         return min(cands, key=lambda l: l.inflight)
+
+    # ----------------------------------------------------------- resilience
+    def _shed_hint_ms(self, hint_ms: float = 0.0) -> float:
+        """Pacing hint for a shed response: the upstream worker's own
+        ``retry_after_ms`` when it sent one, else the router's rolling
+        relay-latency median — a worker too overloaded to even attach a
+        hint shouldn't earn an IMMEDIATE retry. Jittered (``FaultPolicy.
+        jitter``) so a thundering herd of pacing clients decorrelates."""
+        if hint_ms > 0:
+            return hint_ms
+        med = self._latency.median()
+        if med is None:
+            return 0.0
+        j = self.policy.jitter
+        return med * (1.0 - j + 2.0 * j * random.random())
+
+    def _brownout(self) -> int:
+        return brownout_level(
+            len(self.healthy_links()), len(self.links), self.fcfg,
+            self.budget.exhausted,
+        )
+
+    def _autoscale_hold(self) -> bool:
+        """The autoscaler must not retune workers from brownout traffic —
+        shed-heavy stats would read as idleness and downscale the exact
+        capacity the fleet is trying to win back."""
+        return self._brownout() > 0
+
+    async def _chaos_submit(self, req: dict) -> dict:
+        """Accept-loop chaos (installed as ``self.submit`` when the spec
+        sets ``accept>0``): delay a seeded subset of client requests at
+        the fleet edge before normal routing."""
+        chaos = self.chaos
+        if chaos.roll("accept"):
+            # lint: allow[obs-contract] literal name in obs/names.py
+            obs.count("fabric.chaos.accept_delays")
+            await asyncio.sleep(chaos.spec.delay_ms / 1000.0)
+        return await Router.submit(self, req)
 
     # -------------------------------------------------------------- serving
     async def submit(self, req: dict) -> dict:
@@ -373,8 +476,21 @@ class Router:
         ctx = obs_trace.from_carrier(req.get("trace"))
         if ctx is None and obs.enabled():
             ctx = obs_trace.mint()
+        self.budget.note_request()
+        level = self._brownout()
+        if level and (level >= 2 or CLASS_OF.get(op) == "scan"):
+            # Shed at the edge, BEFORE placement: brownout exists to keep
+            # the survivors' queues from collapsing under full load.
+            self._count("brownout_shed")
+            return error_response(
+                req, "Overloaded",
+                f"fabric brownout (level {level}): shedding "
+                f"{CLASS_OF.get(op, op)}-class work",
+                retry_after_ms=round(self._shed_hint_ms(), 3),
+            )
+        if op == "batch" and self.fcfg.stream:
+            return await self._stream_route(req, ctx)
         idempotent = op in IDEMPOTENT_OPS
-        failed_over = False
         shed_resp = None
         for round_no in range(self.policy.max_retries + 1):
             tried: set = set()
@@ -383,25 +499,35 @@ class Router:
                 if link is None:
                     break           # every healthy worker tried this round
                 tried.add(link.wid)
+                t0 = time.monotonic()
                 try:
                     resp = await self._relay(link, req, ctx)
                 except WorkerLost:
-                    if not idempotent or failed_over:
+                    if not idempotent:
                         self._count("lost")
                         return error_response(
                             req, "WorkerLost",
                             f"worker {link.wid} died mid-{op}; "
-                            "op is not re-dispatchable"
-                            if not idempotent else
-                            f"worker {link.wid} died mid-{op} after failover",
+                            "op is not re-dispatchable",
                         )
-                    failed_over = True
+                    if not self.budget.try_spend():
+                        # Budget empty: surfacing the loss beats joining
+                        # a retry storm. The client owns the next retry.
+                        self._count("lost")
+                        self._count("budget_exhausted")
+                        return error_response(
+                            req, "WorkerLost",
+                            f"worker {link.wid} died mid-{op}; "
+                            "retry budget exhausted",
+                        )
                     self._count("failovers")
-                    continue        # exactly one re-dispatch
+                    self._count("budget_spent")
+                    continue        # re-dispatch (budget-gated)
                 if (resp.get("ok") is False
                         and resp.get("error") in ("Overloaded", "Draining")):
                     shed_resp = resp
                     continue        # spill to the next-best worker
+                self._latency.record((time.monotonic() - t0) * 1000.0)
                 self._count("routed")
                 return resp
             if shed_resp is None:
@@ -410,12 +536,218 @@ class Router:
                 )
             if round_no >= self.policy.max_retries:
                 break
-            hint_ms = float(shed_resp.get("retry_after_ms") or 0.0)
+            if not self.budget.try_spend():
+                self._count("budget_exhausted")
+                break               # relay the shed answer; client paces
+            self._count("budget_spent")
+            hint_ms = self._shed_hint_ms(
+                float(shed_resp.get("retry_after_ms") or 0.0)
+            )
             await asyncio.sleep(
                 max(hint_ms / 1000.0, self.policy.backoff_delay(round_no))
             )
         self._count("relayed_overload")
         return shed_resp
+
+    # ------------------------------------------------------------ streaming
+    async def _stream_open(self, link: WorkerLink, req: dict,
+                           ctx, resume_from: int):
+        """Open a DEDICATED upstream connection for one streaming
+        response and read its head. The multiplexed link must buffer
+        complete responses (frames from different requests would
+        interleave); a stream gets its own socket so the router can relay
+        frames the moment they arrive. Returns ``(head, reader,
+        writer)``; raises :class:`WorkerLost` when the worker can't be
+        reached or dies before the head."""
+        addr = link.address
+        try:
+            if addr.kind == "unix":
+                reader, writer = await asyncio.open_unix_connection(
+                    addr.path, limit=MAX_LINE
+                )
+            else:
+                reader, writer = await asyncio.open_connection(
+                    addr.host, addr.port, limit=MAX_LINE
+                )
+        except (ConnectionError, OSError) as exc:
+            raise WorkerLost(f"worker {link.wid}: {exc}") from exc
+        fwd = {k: v for k, v in req.items() if k != "id"}
+        fwd["id"] = 1
+        if resume_from:
+            fwd["resume_from"] = int(resume_from)
+        if ctx is not None:
+            fwd["trace"] = obs_trace.carrier(ctx)
+        try:
+            writer.write((json.dumps(fwd) + "\n").encode())
+            await writer.drain()
+            line = await reader.readline()
+            if not line:
+                raise ConnectionError("worker closed before the stream head")
+            head = json.loads(line)
+        except (ConnectionError, OSError, ValueError,
+                asyncio.IncompleteReadError) as exc:
+            try:
+                writer.close()
+            except Exception:
+                pass
+            raise WorkerLost(f"worker {link.wid}: {exc}") from exc
+        return head, reader, writer
+
+    async def _stream_route(self, req: dict, ctx) -> dict:
+        """Streaming relay for ``batch`` (``stream=1``): forward the head
+        as soon as the first worker answers, then hand the accept loop an
+        async frame iterator (``_binary_iter``) that relays each frame as
+        it arrives. A mid-stream :class:`WorkerLost` at frame N re-opens
+        on a replacement worker with ``resume_from = N`` (plus whatever
+        resume base the CLIENT sent — the token composes end-to-end), so
+        the delivered frame sequence is byte-identical to an undisturbed
+        run without the router ever buffering the response."""
+        path = req.get("path")
+        client_base = int(req.get("resume_from") or 0)
+        tried: set = set()
+        shed_resp = None
+        while True:
+            link = self.pick(path, exclude=tried)
+            if link is None:
+                if shed_resp is not None:
+                    self._count("relayed_overload")
+                    return shed_resp
+                return error_response(
+                    req, "WorkerLost", "no healthy workers in the fabric",
+                )
+            tried.add(link.wid)
+            try:
+                head, reader, writer = await self._stream_open(
+                    link, req, ctx, client_base
+                )
+            except WorkerLost:
+                if not self.budget.try_spend():
+                    self._count("lost")
+                    self._count("budget_exhausted")
+                    return error_response(
+                        req, "WorkerLost",
+                        f"worker {link.wid} died opening stream; "
+                        "retry budget exhausted",
+                    )
+                self._count("failovers")
+                self._count("budget_spent")
+                continue
+            if head.get("ok") is False:
+                if head.get("error") in ("Overloaded", "Draining"):
+                    shed_resp = dict(head, id=req.get("id"))
+                    continue        # spill to the next-best worker
+                return dict(head, id=req.get("id"))   # typed worker error
+            break
+        total = int(head.get("binary_frames") or 0)
+        self._count("routed")
+        self._count("streamed")
+
+        async def frames():
+            nonlocal reader, writer
+            delivered = 0
+            cur_wid = link.wid
+            chaos = self.chaos
+            try:
+                while delivered < total:
+                    try:
+                        if chaos is not None and chaos.roll("trunc"):
+                            # lint: allow[obs-contract] in obs/names.py
+                            obs.count("fabric.chaos.truncs")
+                            raise ConnectionError("chaos: stream truncated")
+                        hdr = await reader.readexactly(8)
+                        (length,) = struct.unpack("<Q", hdr)
+                        frame = await reader.readexactly(length)
+                    except (ConnectionError, OSError,
+                            asyncio.IncompleteReadError) as exc:
+                        flight.record(
+                            "stream_lost", worker=cur_wid, op="batch",
+                            delivered=delivered, total=total,
+                            error=str(exc),
+                        )
+                        reader, writer, cur_wid = await self._stream_resume(
+                            req, ctx, cur_wid,
+                            client_base + delivered, total - delivered,
+                            writer,
+                        )
+                        continue
+                    delivered += 1
+                    self._count("stream_frames")
+                    yield frame
+            finally:
+                try:
+                    writer.close()
+                except Exception:
+                    pass
+
+        resp = {k: v for k, v in head.items()
+                if k not in ("resume_from", "total_frames")}
+        resp["id"] = req.get("id")
+        resp["binary_frames"] = total
+        resp["_binary_iter"] = frames()
+        return resp
+
+    async def _stream_resume(self, req: dict, ctx, dead_wid: str,
+                             resume_from: int, need: int, old_writer):
+        """Find a replacement worker mid-stream and re-open from the
+        resume token. Budget-gated like any failover; raises
+        :class:`WorkerLost` when the budget or the fleet runs out (the
+        accept loop then ABORTS the client connection — a half-delivered
+        frame sequence must never look complete)."""
+        try:
+            old_writer.close()
+        except Exception:
+            pass
+        exclude = {dead_wid}
+        while True:
+            if not self.budget.try_spend():
+                self._count("budget_exhausted")
+                raise WorkerLost(
+                    f"stream lost at resume_from={resume_from}; "
+                    "retry budget exhausted"
+                )
+            self._count("failovers")
+            self._count("budget_spent")
+            nxt = self.pick(req.get("path"), exclude=exclude)
+            if nxt is None:
+                raise WorkerLost("no healthy workers to resume the stream")
+            try:
+                head, reader, writer = await self._stream_open(
+                    nxt, req, ctx, resume_from
+                )
+            except WorkerLost:
+                exclude.add(nxt.wid)
+                continue
+            if head.get("ok") is False:
+                try:
+                    writer.close()
+                except Exception:
+                    pass
+                if head.get("error") in ("Overloaded", "Draining"):
+                    await asyncio.sleep(max(
+                        self._shed_hint_ms(
+                            float(head.get("retry_after_ms") or 0.0)
+                        ) / 1000.0,
+                        self.policy.backoff_delay(0),
+                    ))
+                    continue
+                raise WorkerLost(
+                    f"worker {nxt.wid} refused stream resume: "
+                    f"{head.get('error')}"
+                )
+            got = int(head.get("binary_frames") or 0)
+            if got != need:
+                try:
+                    writer.close()
+                except Exception:
+                    pass
+                raise WorkerLost(
+                    f"resume mismatch: worker {nxt.wid} offered {got} "
+                    f"frames at resume_from={resume_from}, need {need}"
+                )
+            self._count("resumed")
+            flight.record("stream_resume", worker=nxt.wid,
+                          resume_from=resume_from, frames=need)
+            return reader, writer, nxt.wid
 
     # ------------------------------------------------------------ admin ops
     def _admin_targets(self, req: dict) -> "list[WorkerLink]":
@@ -497,15 +829,32 @@ class Router:
                 "healthy": bool(l.healthy),
                 "draining": bool(l.draining),
                 "inflight": int(l.inflight),
+                "breaker": (l.breaker.state if l.breaker is not None
+                            else None),
                 "stats": stats,
             }
             for l, stats in zip(links, upstream)
         }
+        extra = {}
+        if self.chaos is not None:
+            extra["chaos"] = {
+                "seed": self.chaos.seed,
+                "spec": self.chaos.describe(),
+                "injected": dict(self.chaos.injected),
+            }
         return ok_response(
             req, fabric=True, draining=bool(self.draining),
             counters=dict(sorted(self.counters.items())),
+            budget={
+                "tokens": round(self.budget.tokens, 3),
+                "capacity": self.budget.capacity,
+                "spent": self.budget.spent,
+                "denied": self.budget.denied,
+            },
+            brownout=self._brownout(),
             moves=list(self.moves),
             workers=workers,
+            **extra,
         )
 
     async def _alerts(self, req: dict) -> dict:

@@ -89,6 +89,15 @@ def serve_worker(
         config = config.replace(columnar=columnar)
     if slo:
         config = config.replace(slo=slo)
+    try:
+        # Under a chaos run (SPARK_BAM_FABRIC carries chaos=SEED:SPEC)
+        # the worker's own dumps must name the seed too — a postmortem
+        # from EITHER side of the fabric seam reproduces the run.
+        chaos_spec = config.fabric_config.chaos
+    except Exception:
+        chaos_spec = ""
+    if chaos_spec:
+        flight.set_context(chaos=chaos_spec)
     service = SplitService(config, mesh=local_mesh())
 
     stop = threading.Event()
@@ -154,7 +163,13 @@ class WorkerPool:
     reads each one's announce line for its bound address; attach mode
     takes addresses of already-running workers (other hosts' loops) and
     supervises nothing. ``kill(i, hard=True)`` exists for the failover
-    bench/tests; ``terminate()`` SIGTERMs for graceful drains.
+    bench/tests; ``terminate()`` SIGTERMs for graceful drains. The chaos
+    layer (fabric/chaos.py ``ChaosStorm``) adds three more verbs:
+    ``respawn(i)`` relaunches a killed worker on its ORIGINAL port (the
+    router's link re-probes the same address and reinstates it), and
+    ``wedge(i)``/``unwedge(i)`` SIGSTOP/SIGCONT a live worker — the
+    wedged state keeps every socket open while answering nothing, which
+    only a probe timeout can detect.
     """
 
     def __init__(self, workers: int = 3, devices: int = 1, serve: str = "",
@@ -172,32 +187,35 @@ class WorkerPool:
         self.procs: list = []
         self.addresses: "list[str]" = []
 
+    def _spawn(self, listen: str):
+        import subprocess
+
+        env = dict(os.environ if self.env is None else self.env)
+        # -c (not -m): runpy would import the fabric package first and
+        # warn about the worker module being re-executed as __main__.
+        cmd = [sys.executable, "-c",
+               "import sys; from spark_bam_tpu.fabric.worker import main;"
+               " sys.exit(main(sys.argv[1:]))",
+               "--listen", listen]
+        if self.devices:
+            cmd += ["--devices", str(self.devices)]
+        if self.serve:
+            cmd += ["--serve", self.serve]
+        if self.columnar:
+            cmd += ["--columnar", self.columnar]
+        if self.slo:
+            cmd += ["--slo", self.slo]
+        return subprocess.Popen(
+            cmd, stdout=subprocess.PIPE, stderr=self.stderr,
+            env=env, text=True,
+        )
+
     def start(self, timeout_s: float = 120.0) -> "list[str]":
         if self.attach:
             self.addresses = list(self.attach)
             return self.addresses
-        import subprocess
-
-        env = dict(os.environ if self.env is None else self.env)
         for _ in range(self.workers):
-            # -c (not -m): runpy would import the fabric package first and
-            # warn about the worker module being re-executed as __main__.
-            cmd = [sys.executable, "-c",
-                   "import sys; from spark_bam_tpu.fabric.worker import main;"
-                   " sys.exit(main(sys.argv[1:]))",
-                   "--listen", "tcp:127.0.0.1:0"]
-            if self.devices:
-                cmd += ["--devices", str(self.devices)]
-            if self.serve:
-                cmd += ["--serve", self.serve]
-            if self.columnar:
-                cmd += ["--columnar", self.columnar]
-            if self.slo:
-                cmd += ["--slo", self.slo]
-            self.procs.append(subprocess.Popen(
-                cmd, stdout=subprocess.PIPE, stderr=self.stderr,
-                env=env, text=True,
-            ))
+            self.procs.append(self._spawn("tcp:127.0.0.1:0"))
         deadline = time.monotonic() + timeout_s
         for p in self.procs:
             line = self._read_announce(p, deadline)
@@ -230,6 +248,49 @@ class WorkerPool:
         p = self.procs[i]
         if p.poll() is None:
             p.send_signal(signal.SIGKILL if hard else signal.SIGTERM)
+
+    def respawn(self, i: int, timeout_s: float = 120.0) -> str:
+        """Relaunch worker ``i`` on its ORIGINAL port. The router's link
+        for that address stays in place; its health monitor reinstates
+        the worker on the first successful re-probe — a rolling storm
+        leaves the fleet exactly as it found it."""
+        old = self.procs[i]
+        if old.poll() is None:
+            old.kill()
+        old.wait(timeout=timeout_s)
+        if old.stdout is not None:
+            old.stdout.close()
+        addr = self.addresses[i]
+        deadline = time.monotonic() + timeout_s
+        while True:
+            # The dying process may hold the port through TCP teardown;
+            # retry the bind until the OS releases it.
+            proc = self._spawn(addr)
+            try:
+                line = self._read_announce(proc, deadline)
+                break
+            except RuntimeError:
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.2)
+        self.procs[i] = proc
+        if line["address"] != addr:
+            raise RuntimeError(
+                f"respawned worker bound {line['address']}, wanted {addr}"
+            )
+        return addr
+
+    def wedge(self, i: int) -> None:
+        """SIGSTOP worker ``i``: sockets stay open, nothing answers —
+        the failure mode only a probe timeout can detect."""
+        p = self.procs[i]
+        if p.poll() is None:
+            p.send_signal(signal.SIGSTOP)
+
+    def unwedge(self, i: int) -> None:
+        p = self.procs[i]
+        if p.poll() is None:
+            p.send_signal(signal.SIGCONT)
 
     def terminate(self, timeout_s: float = 30.0) -> None:
         for p in self.procs:

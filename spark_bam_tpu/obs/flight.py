@@ -32,6 +32,35 @@ from collections import deque
 FLIGHT_DIR_ENV = "SPARK_BAM_FLIGHT_DIR"
 _RING_CAP = 512
 
+# Process-wide dump context: stable facts every artifact must carry to
+# be reproducible on its own (chaos seed/spec, primarily). Merged into
+# each dump's flight_meta line and readable by other artifact writers
+# (obs/slo.py stamps it into alert-ledger entries).
+_context: dict = {}
+_context_lock = threading.Lock()
+
+
+def set_context(**fields) -> None:
+    """Attach reproducibility facts (e.g. ``chaos_seed``/``chaos_spec``)
+    to every subsequent dump from this process."""
+    with _context_lock:
+        _context.update(fields)
+
+
+def clear_context(*names) -> None:
+    """Drop named context keys (all of them when called bare)."""
+    with _context_lock:
+        if not names:
+            _context.clear()
+        for n in names:
+            _context.pop(n, None)
+
+
+def context() -> dict:
+    """A snapshot of the current dump context."""
+    with _context_lock:
+        return dict(_context)
+
 
 class FlightRecorder:
     """Thread-safe bounded event ring with a JSONL dump."""
@@ -65,6 +94,7 @@ class FlightRecorder:
             "reason": reason,
             "t": round(time.time(), 6),
             "pid": os.getpid(),
+            **context(),
             **(extra or {}),
         })]
         for ev in self.events():

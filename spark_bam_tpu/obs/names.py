@@ -68,6 +68,18 @@ NAMES = frozenset({
     "fabric.ejected", "fabric.failovers", "fabric.lost",
     "fabric.reinstated", "fabric.relayed_overload", "fabric.routed",
     "fabric.spilled",
+    # fabric.breaker — per-link circuit breakers (docs/robustness.md)
+    "fabric.breaker.opened", "fabric.breaker.half_open",
+    "fabric.breaker.closed", "fabric.breaker.holddowns",
+    # fabric resilience: retry budget, brownout, streaming failover
+    "fabric.budget_spent", "fabric.budget_exhausted",
+    "fabric.brownout_shed", "fabric.streamed", "fabric.stream_frames",
+    "fabric.resumed",
+    # fabric.chaos — fleet-seam fault injection (fabric/chaos.py)
+    "fabric.chaos.drops", "fabric.chaos.delays", "fabric.chaos.dups",
+    "fabric.chaos.truncs", "fabric.chaos.slowed",
+    "fabric.chaos.accept_delays", "fabric.chaos.kills",
+    "fabric.chaos.wedges",
     # faults — retry/hedge/quarantine ledger (docs/robustness.md)
     "faults.attempt_ms", "faults.hedges", "faults.quarantined",
     "faults.quarantined_blocks", "faults.retries",
@@ -107,8 +119,8 @@ NAMES = frozenset({
     "serve.connections", "serve.device_dispatch", "serve.errors",
     "serve.h2d_bytes", "serve.latency_ms", "serve.overloaded",
     "serve.parse", "serve.queue_depth", "serve.queue_ms", "serve.request",
-    "serve.requests", "serve.rewrite", "serve.shed", "serve.tick",
-    "serve.tuned",
+    "serve.requests", "serve.rewrite", "serve.shed", "serve.stream_aborts",
+    "serve.tick", "serve.tuned",
     # slo — burn-rate objective engine (obs/slo.py)
     "slo.alerts", "slo.burn_rate", "slo.evals", "slo.firing",
     # ts — time-series ring scraper (obs/timeseries.py)

@@ -9,9 +9,10 @@ path:
 - **Metrics**: labeled ``Counter`` / ``Gauge`` / ``Histogram`` series in
   one process-wide ``Registry`` (``obs.counter("bgzf.blocks_read")``).
 - **Spans**: ``with obs.span("inflate.window", blocks=n):`` context
-  managers that nest (thread-local stack), record wall time, emit one
-  structured JSONL event each, and feed a per-name duration histogram so
-  aggregate timings survive even when the raw trace is capped.
+  managers that nest (contextvar stack — per asyncio task, per thread),
+  record wall time, emit one structured JSONL event each, and feed a
+  per-name duration histogram so aggregate timings survive even when the
+  raw trace is capped.
 - **Exporters** (``obs.exporters``): JSONL trace file, Prometheus
   text-format snapshot, and a human summary in the reference's stats
   format (``core/stats.py``).
@@ -30,6 +31,7 @@ Span naming convention: dotted ``layer.stage`` names — ``bgzf.read``,
 
 from __future__ import annotations
 
+import contextvars
 import json
 import os
 import random
@@ -39,6 +41,17 @@ import zlib
 from typing import Iterator
 
 from spark_bam_tpu.obs import trace as _trace
+
+# The open-span stack rides the execution CONTEXT, not the thread: on an
+# asyncio loop every task shares one thread, and a thread-local stack
+# would parent task B's span under whatever span task A still has open —
+# grafting B onto A's trace and, once interleaved exits leak an entry,
+# poisoning every later span on that thread. Immutable tuples + contextvar
+# give each task (and each thread — fresh threads start with an empty
+# context) its own properly-nested stack.
+_SPAN_STACK: "contextvars.ContextVar[tuple]" = contextvars.ContextVar(
+    "spark_bam_span_stack", default=()
+)
 
 # Histograms keep raw samples (for reference-style stats rendering) up to
 # this many observations; beyond it a uniform reservoir (algorithm R)
@@ -165,7 +178,7 @@ class Span:
 
     __slots__ = ("registry", "name", "attrs", "parent", "depth", "_t0",
                  "t_wall", "trace_id", "span_id", "parent_span_id",
-                 "_ctx_token")
+                 "_ctx_token", "_stack_token")
 
     def __init__(self, registry: "Registry", name: str, attrs: dict):
         self.registry = registry
@@ -179,13 +192,14 @@ class Span:
         self.span_id = None
         self.parent_span_id = None
         self._ctx_token = None
+        self._stack_token = None
 
     def set(self, **attrs) -> None:
         """Attach attributes mid-span (e.g. measured device time)."""
         self.attrs.update(attrs)
 
     def __enter__(self) -> "Span":
-        stack = self.registry._stack()
+        stack = _SPAN_STACK.get()
         if stack:
             top = stack[-1]
             self.parent = top.name
@@ -203,16 +217,16 @@ class Span:
             self._ctx_token = _trace.set_current(
                 _trace.TraceContext(self.trace_id, self.span_id)
             )
-        stack.append(self)
+        self._stack_token = _SPAN_STACK.set(stack + (self,))
         self.t_wall = time.time()
         self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, *exc) -> None:
         ms = (time.perf_counter() - self._t0) * 1e3
-        stack = self.registry._stack()
-        if stack and stack[-1] is self:
-            stack.pop()
+        # reset() restores the exact entry-time stack — exits from
+        # interleaved asyncio tasks can't pop each other's spans.
+        _SPAN_STACK.reset(self._stack_token)
         if self._ctx_token is not None:
             _trace.reset(self._ctx_token)
             self._ctx_token = None
@@ -256,7 +270,6 @@ class Registry:
         self._events: list[dict] = []
         self._dropped = 0
         self._max_events = max_events
-        self._tls = threading.local()
         self.t_start = time.time()
         # Time-series attachment (obs/timeseries.py): once a RingStore
         # attaches, new and existing histograms grow an observation ring
@@ -305,12 +318,6 @@ class Registry:
         return self._get(self._hists, Histogram, name, labels)
 
     # --------------------------------------------------------------- spans
-    def _stack(self) -> list:
-        stack = getattr(self._tls, "stack", None)
-        if stack is None:
-            stack = self._tls.stack = []
-        return stack
-
     def span(self, name: str, **attrs) -> Span:
         return Span(self, name, attrs)
 

@@ -313,13 +313,16 @@ class SloEngine:
                 was = obj.name in self._firing
                 if firing and not was:
                     self._firing.add(obj.name)
-                    entry = dict(st, state="firing")
+                    # flight.context() carries the chaos seed/spec when
+                    # one is installed — an alert fired during a chaos
+                    # run names the run that provoked it.
+                    entry = dict(st, state="firing", **flight.context())
                     self.ledger.append(entry)
                     obs.count("slo.alerts")
                     flight.record("slo_alert", **entry)
                 elif was and not firing:
                     self._firing.discard(obj.name)
-                    entry = dict(st, state="resolved")
+                    entry = dict(st, state="resolved", **flight.context())
                     self.ledger.append(entry)
                     flight.record("slo_alert", **entry)
         with self._lock:

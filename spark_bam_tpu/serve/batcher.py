@@ -98,6 +98,13 @@ class Batcher:
             self._cond.notify()
         return task.future
 
+    def backlog(self) -> int:
+        """Rows queued but not yet dispatched — the ``stats`` op exposes
+        this so operators (and brownout postmortems) can see queue
+        pressure building BEFORE latency percentiles move."""
+        with self._cond:
+            return len(self._queue)
+
     def set_batch_rows(self, batch_rows: int) -> int:
         """Retarget rows-per-tick at runtime (the ``tune`` op / fabric
         autoscaler). Rounded up to a mesh-size multiple as at startup, so

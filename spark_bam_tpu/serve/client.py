@@ -12,6 +12,13 @@ re-sends before the error surfaces — admission shedding reads as
 latency, not failure, exactly like the partition executor's transient
 handling (docs/serving.md "Backpressure"). Pass ``policy=None`` to
 fail fast instead.
+
+``batch`` requests additionally survive MID-STREAM connection loss: the
+client keeps every frame it has already read, reconnects, and re-issues
+the request with ``resume_from=<frames held>`` — the frame-sequence
+resume token of docs/robustness.md. Against a streaming fabric router
+the replacement worker serves only the missing tail; the reassembled
+frame list is byte-identical to an undisturbed response.
 """
 
 from __future__ import annotations
@@ -45,6 +52,13 @@ class ServeClient:
         a ``(host, port)`` tuple, or a unix socket path. ``policy`` paces
         Overloaded retries (None = raise immediately)."""
         self.policy = policy
+        self._address = address
+        self._timeout = timeout
+        self._connect()
+        self._next_id = 0
+
+    def _connect(self) -> None:
+        address, timeout = self._address, self._timeout
         if isinstance(address, tuple):
             self._sock = socket.create_connection(address, timeout=timeout)
         else:
@@ -60,7 +74,10 @@ class ServeClient:
                     (addr.host, addr.port), timeout=timeout
                 )
         self._rfile = self._sock.makefile("rb")
-        self._next_id = 0
+
+    def _reconnect(self) -> None:
+        self.close()
+        self._connect()
 
     def request(self, op: str, **fields) -> dict:
         """Send one request and block for its response payload. Responses
@@ -68,15 +85,26 @@ class ServeClient:
         u64-length-prefixed frames read off the socket and attached as a
         list of bytes under ``"_binary"`` — concatenated they are a
         native columnar container (columnar/native.py). ``Overloaded``
-        responses honor their Retry-After hint under ``self.policy``."""
+        responses honor their Retry-After hint under ``self.policy``;
+        ``batch`` requests that lose the connection mid-stream reconnect
+        and resume from the frames already held (``resume_from``)."""
         retries = self.policy.max_retries if self.policy is not None else 0
+        # Frames survive across resume attempts: a mid-stream loss keeps
+        # what arrived and asks only for the tail.
+        progress: "list[bytes]" = [] if op == "batch" else None
         for attempt in range(retries + 1):
             try:
-                return self._request_once(op, fields)
+                return self._request_once(op, fields, progress=progress)
             except ServeClientError as exc:
                 if exc.error != "Overloaded" or attempt >= retries:
                     raise
                 time.sleep(self._overload_delay(exc, attempt))
+            except (ConnectionError, OSError, json.JSONDecodeError):
+                # A death mid-JSON-line decodes as garbage; treat it the
+                # same as a mid-frame cut — reconnect and resume.
+                if progress is None or attempt >= retries:
+                    raise
+                self._reconnect()
         raise AssertionError("unreachable")
 
     def _overload_delay(self, exc: "ServeClientError", attempt: int) -> float:
@@ -88,9 +116,19 @@ class ServeClient:
         d = min(p.backoff_max, max(hint_s, p.backoff_base * (2 ** attempt)))
         return d * (1 - p.jitter + p.jitter * random.random())
 
-    def _request_once(self, op: str, fields: dict) -> dict:
+    def _request_once(self, op: str, fields: dict,
+                      progress: "list[bytes] | None" = None) -> dict:
         self._next_id += 1
         req = {"op": op, "id": self._next_id, **fields}
+        # Frames held at ENTRY came from a prior severed attempt — only
+        # then is this a resume (the list fills during a normal read too).
+        resuming = bool(progress)
+        if resuming:
+            # Compose with any caller-supplied token: the server slices
+            # its deterministic frame sequence at base + held frames.
+            req["resume_from"] = (
+                int(fields.get("resume_from") or 0) + len(progress)
+            )
         if "trace" not in req and obs.enabled():
             # Join the caller's trace (e.g. the CLI root span) or mint a
             # fresh one per request; the server rebinds it so the whole
@@ -106,11 +144,20 @@ class ServeClient:
             raise ServeClientError(resp)
         n_frames = int(resp.get("binary_frames") or 0)
         if n_frames:
-            frames = []
+            frames = progress if progress is not None else []
             for _ in range(n_frames):
                 (length,) = struct.unpack("<Q", self._read_exact(8))
                 frames.append(self._read_exact(length))
-            resp["_binary"] = frames
+            resp["_binary"] = list(frames)
+        elif resuming:
+            # Resumed with zero frames left to serve (the loss hit after
+            # the final frame): the held list IS the complete response.
+            resp["_binary"] = list(progress)
+        if resuming:
+            # Present the reassembled response as the undisturbed one.
+            resp["binary_frames"] = len(resp.get("_binary") or ())
+            resp.pop("resume_from", None)
+            resp.pop("total_frames", None)
         return resp
 
     def _read_exact(self, n: int) -> bytes:

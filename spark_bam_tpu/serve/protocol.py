@@ -13,7 +13,18 @@ follow, each written as a little-endian u64 length prefix + that many
 bytes. Concatenated, the frames are exactly a native columnar container
 (columnar/native.py) — byte-identical to the file sink's output for the
 same query. Handlers stage the chunks on the in-process response under
-the ``"_binary"`` key; the server pops it before JSON encoding.
+the ``"_binary"`` key; the server pops it before JSON encoding. The
+fabric router's streaming relay stages an ASYNC ITERATOR under
+``"_binary_iter"`` instead — same wire format, but the server writes
+each frame as it arrives rather than joining a buffered list.
+
+``batch`` (and ``rewrite``, vacuously) accept an optional ``resume_from``
+integer — the frame-sequence resume token (docs/robustness.md): the
+frame list for an unchanged file + query is deterministic, so a request
+with ``resume_from=N`` is answered with frames ``N..`` only, plus
+``total_frames`` echoing the full count. Clients and the fabric router
+use it to resume a response severed mid-stream on a replacement worker;
+the reassembled sequence is byte-identical to an undisturbed one.
 
 Admin ops (``drain``, ``tune``, ``telemetry``, ``alerts``) bypass
 admission like ``ping``/``stats``: ``drain`` stops new work-op admission

@@ -35,7 +35,12 @@ async def _handle_connection(service: SplitService, reader, writer) -> None:
         # Binary record-batch frames (the batch op) ride after the JSON
         # line, each with a u64 length prefix; the JSON's binary_frames
         # field tells the client how many to read (serve/protocol.py).
+        # ``_binary`` is a materialized list; ``_binary_iter`` (the
+        # fabric router's streaming relay) is an async iterator drained
+        # frame-by-frame under the write lock — the frames are relayed
+        # as the upstream worker produces them, never buffered whole.
         chunks = resp.pop("_binary", None)
+        frames_iter = resp.pop("_binary_iter", None)
         data = encode(resp)
         if chunks:
             data = b"".join(
@@ -44,6 +49,23 @@ async def _handle_connection(service: SplitService, reader, writer) -> None:
         async with wlock:
             writer.write(data)
             await writer.drain()
+            if frames_iter is not None:
+                try:
+                    async for c in frames_iter:
+                        writer.write(struct.pack("<Q", len(c)) + bytes(c))
+                        await writer.drain()
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    # The JSON head already promised binary_frames the
+                    # stream can no longer deliver (resume exhausted):
+                    # abort the transport so the client sees a hard
+                    # connection error, never a silently-short response.
+                    obs.count("serve.stream_aborts")
+                    try:
+                        writer.transport.abort()
+                    except Exception:
+                        pass
 
     async def one(req: dict) -> None:
         try:

@@ -589,6 +589,10 @@ class SplitService:
                 DeflateConfig.parse(deflate)
             except ValueError as exc:
                 raise ServiceError("ProtocolError", str(exc)) from exc
+        # ``resume_from`` (the streaming-failover token) is accepted and
+        # ignored here: rewrite emits no frames — its idempotency is the
+        # atomic output commit, so a failover simply re-runs the rewrite
+        # and overwrites, never interleaves.
         try:
             block_payload = int(req.get("block_payload") or 0xFF00)
             level = int(req.get("level") or 6)
@@ -666,10 +670,27 @@ class SplitService:
                 chunks.append(batch_frame(rb, meta))
                 rows += rb.num_rows
         chunks.append(end_frame(rows, len(chunks) - 1))
+        total_frames = len(chunks)
+        # Frame-sequence resume token (docs/robustness.md): the chunk
+        # list is deterministic for an unchanged file + query, so a
+        # replacement worker re-encodes and serves only the tail — the
+        # delivered sequence is byte-identical to an undisturbed run.
+        resume_from = int(req.get("resume_from") or 0)
+        out = {}
+        if resume_from:
+            if not 0 <= resume_from < total_frames:
+                raise ServiceError(
+                    "ProtocolError",
+                    f"resume_from={resume_from} out of range "
+                    f"(0..{total_frames - 1})",
+                )
+            chunks = chunks[resume_from:]
+            out["resume_from"] = resume_from
+            out["total_frames"] = total_frames
         nbytes = sum(len(c) for c in chunks)
         obs.count("columnar.rows", rows)
         obs.count("columnar.bytes_out", nbytes)
-        return {
+        out.update({
             "path": fs.path,
             "rows": int(rows),
             "columns": list(columns),
@@ -677,7 +698,8 @@ class SplitService:
             "binary_frames": len(chunks),
             "binary_bytes": int(nbytes),
             "_binary": chunks,
-        }
+        })
+        return out
 
     # ------------------------------------------------------------- scanning
     def _flat_range(self, fs: _FileState, req: dict) -> "tuple[int, int]":
@@ -796,6 +818,7 @@ class SplitService:
             "served": int(self.served),
             "inflight": inflight,
             "queue_depth": int(sum(inflight.values())),
+            "backlog": int(self.batcher.backlog()),
             "draining": bool(self.draining),
             "files_resident": len(self._files),
             "batch_sizes": {

@@ -1,0 +1,926 @@
+"""Fleet chaos engineering: seeded fault injection + resilience gates.
+
+The fast tier exercises the primitives (spec grammar, splitmix64 roll
+determinism, retry budget, circuit breaker + flap hold-down, brownout
+levels, storm schedules) and the in-process integration paths: chaos
+links injecting reorder/dup/slow/drop faults under real workers, the
+``stream=1`` resumable relay, server-side ``resume_from`` slicing, the
+client's mid-stream reconnect-resume, brownout shedding, and the chaos
+seed landing in every flight dump / SLO ledger entry. The slow tier is
+the storm regression: a seeded rolling SIGKILL/SIGSTOP schedule against
+real worker subprocesses under concurrent mixed-op load — zero lost
+requests, one merged trace tree per request, amplification ≤ 2×.
+
+Seeds used by the integration tests are SEARCHED (deterministically)
+with the same ``_roll`` the injector uses, so the tests state their
+fault-pattern requirement instead of hard-coding magic seeds.
+"""
+
+import asyncio
+import contextlib
+import json
+import struct
+import threading
+import time
+
+import pytest
+
+from spark_bam_tpu.benchmarks.synth import synthetic_fixture
+from spark_bam_tpu.core.config import Config
+from spark_bam_tpu.core.faults import FaultPolicy, _roll
+from spark_bam_tpu.fabric import (
+    ChaosWorkerLink,
+    CircuitBreaker,
+    FabricChaos,
+    FabricChaosSpec,
+    FabricConfig,
+    RetryBudget,
+    Router,
+    WorkerLink,
+    brownout_level,
+    parse_fabric_chaos,
+    storm_schedule,
+)
+from spark_bam_tpu.fabric.chaos import _KINDS
+from spark_bam_tpu.fabric.resilience import CLOSED, HALF_OPEN, OPEN
+from spark_bam_tpu.serve import (
+    ServeClient,
+    ServeClientError,
+    ServerThread,
+    SplitService,
+)
+
+pytestmark = [pytest.mark.fabric, pytest.mark.chaos]
+
+SERVE_SPEC = "window=64KB,halo=8KB,batch=8,tick=5,workers=4"
+QUIET_FABRIC = "probe=60000,autoscale=60000"
+
+
+@pytest.fixture(scope="module")
+def bam_path(tmp_path_factory):
+    return str(synthetic_fixture(tmp_path_factory.mktemp("chaos_fixture")))
+
+
+@pytest.fixture(autouse=True)
+def _clean_flight_context():
+    """Chaos routers stamp the process-wide dump context at
+    construction; don't leak one test's seed into the next."""
+    yield
+    from spark_bam_tpu.obs import flight
+
+    flight.clear_context()
+
+
+@contextlib.contextmanager
+def _fabric(n=2, fabric_spec=QUIET_FABRIC, serve_spec=SERVE_SPEC):
+    """n real workers + a router, all on in-process accept loops."""
+    services = [SplitService(Config(serve=serve_spec)) for _ in range(n)]
+    srvs = [ServerThread(s).start() for s in services]
+    addrs = [f"tcp:{h}:{p}" for h, p in (s.address for s in srvs)]
+    router = Router(addrs, config=Config(fabric=fabric_spec))
+    rsrv = ServerThread(router).start()
+    try:
+        yield rsrv.address, router, services, addrs
+    finally:
+        rsrv.stop()
+        for s in srvs:
+            s.stop()
+        for s in services:
+            s.close()
+
+
+def _find_seed(kind, rate, want_true_before, want_false_at=(), start=1):
+    """Smallest seed whose fault pattern for ``kind`` has at least one
+    True roll among the first ``want_true_before`` events and False at
+    every index in ``want_false_at`` — deterministic seed selection by
+    the documented roll function itself."""
+    k = _KINDS[kind]
+    for seed in range(start, start + 10_000):
+        if any(_roll(seed, k, i, rate) for i in range(want_true_before)) \
+                and not any(_roll(seed, k, i, rate) for i in want_false_at):
+            return seed
+    raise AssertionError("no seed found — roll distribution is broken")
+
+
+# ------------------------------------------------------------ spec grammar
+
+
+def test_chaos_spec_parse_both_separators_and_ms_suffix():
+    s = FabricChaosSpec.parse("drop=0.05+delay=0.1x25+kills=5+wedges=1")
+    assert s.drop == 0.05
+    assert (s.delay, s.delay_ms) == (0.1, 25.0)
+    assert (s.kills, s.wedges) == (5, 1)
+    assert s.trunc == 0.0                      # unset keys keep defaults
+    # Standalone specs may use commas; embedded in a fabric spec they
+    # can't (the outer parse splits on commas) — hence ``+``.
+    assert FabricChaosSpec.parse("slow=0.2x5,dup=0.1") == \
+        FabricChaosSpec.parse("slow=0.2x5+dup=0.1")
+    assert FabricChaosSpec.parse("") == FabricChaosSpec()
+
+
+def test_chaos_spec_rejects_bad_entries():
+    with pytest.raises(ValueError):
+        FabricChaosSpec.parse("nope=1")
+    with pytest.raises(ValueError):
+        FabricChaosSpec.parse("drop")
+    with pytest.raises(ValueError):
+        parse_fabric_chaos("notanint:drop=0.1")
+
+
+def test_parse_fabric_chaos_roundtrips_through_fabric_config():
+    fcfg = FabricConfig.parse("probe=100,chaos=42:drop=0.05+kills=3")
+    assert fcfg.chaos == "42:drop=0.05+kills=3"
+    seed, spec = parse_fabric_chaos(fcfg.chaos)
+    assert seed == 42 and spec.drop == 0.05 and spec.kills == 3
+    # The chaos value is validated EAGERLY at config parse, not at the
+    # first injected fault.
+    with pytest.raises(ValueError):
+        FabricConfig.parse("chaos=42:bogus=1")
+    with pytest.raises(ValueError):
+        FabricConfig.parse("chaos=xx:drop=0.1")
+
+
+def test_fabric_config_resilience_keys_and_rejects():
+    fcfg = FabricConfig.parse(
+        "budget=16,budget_rate=0.5,flap_k=3,flap_window=2000,"
+        "holddown=9000,brownout=1,brownout_frac=0.25,stream=1"
+    )
+    assert (fcfg.budget, fcfg.budget_rate) == (16, 0.5)
+    assert (fcfg.flap_k, fcfg.flap_window_ms) == (3, 2000.0)
+    assert fcfg.holddown_ms == 9000.0
+    assert (fcfg.brownout, fcfg.brownout_frac) == (1, 0.25)
+    assert fcfg.stream == 1
+    assert FabricConfig.parse("").brownout == 0   # brownout is opt-in
+    assert FabricConfig.parse("").chaos == ""
+    for bad in ("budget=-1", "budget_rate=-0.1", "flap_k=0",
+                "holddown=0", "brownout_frac=0", "brownout_frac=1.5"):
+        with pytest.raises(ValueError):
+            FabricConfig.parse(bad)
+
+
+# ----------------------------------------------------------- determinism
+
+
+def test_chaos_rolls_are_a_pure_function_of_the_seed():
+    spec = FabricChaosSpec.parse("drop=0.2+delay=0.3+dup=0.1")
+    a = FabricChaos(99, spec)
+    b = FabricChaos(99, spec)
+    seq_a = [(k, a.roll(k)) for _ in range(200) for k in ("drop", "delay")]
+    seq_b = [(k, b.roll(k)) for _ in range(200) for k in ("drop", "delay")]
+    assert seq_a == seq_b
+    assert a.injected == b.injected
+    assert a.injected["drop"] > 0 and a.injected["delay"] > 0
+    c = FabricChaos(100, spec)
+    seq_c = [(k, c.roll(k)) for _ in range(200) for k in ("drop", "delay")]
+    assert seq_c != seq_a                      # the seed IS the schedule
+    # Kinds draw from independent splitmix64 streams: a zero-rate kind
+    # never fires no matter how often the others do.
+    assert all(not a.roll("trunc") for _ in range(100))
+
+
+def test_chaos_describe_names_the_run():
+    seed, spec = parse_fabric_chaos("42:drop=0.05+delay=0.1+kills=5+wedges=1")
+    d = FabricChaos(seed, spec).describe()
+    assert d.startswith("42:")
+    for part in ("drop=0.05", "delay=0.1", "kills=5", "wedges=1"):
+        assert part in d
+
+
+def test_storm_schedule_deterministic_and_rolling():
+    spec = FabricChaosSpec.parse("kills=5+wedges=1+storm=500")
+    sched = storm_schedule(7, 3, spec)
+    assert sched == storm_schedule(7, 3, spec)
+    assert len(sched) == 6
+    actions = [a for _, _, a in sched]
+    assert actions.count("kill") == 5 and actions.count("wedge") == 1
+    times = [t for t, _, _ in sched]
+    assert times == sorted(times)
+    assert times[1] - times[0] == pytest.approx(0.5)   # rolling, not burst
+    assert all(0 <= v < 3 for _, v, _ in sched)
+    assert sched != storm_schedule(8, 3, spec)
+    assert storm_schedule(7, 3, FabricChaosSpec()) == []
+
+
+# ------------------------------------------------------------- resilience
+
+
+def test_retry_budget_bounds_amplification():
+    b = RetryBudget(capacity=4, rate=0.5)
+    assert [b.try_spend() for _ in range(4)] == [True] * 4
+    assert b.exhausted and not b.try_spend()
+    assert (b.spent, b.denied) == (4, 1)
+    for _ in range(2):                         # admitted traffic refills
+        b.note_request()
+    assert b.try_spend() and not b.try_spend()
+    b2 = RetryBudget(capacity=4, rate=0.5)
+    for _ in range(100):
+        b2.note_request()
+    assert b2.tokens == 4.0                    # refill caps at capacity
+
+
+def test_circuit_breaker_lifecycle_with_injected_clock():
+    now = [0.0]
+    fcfg = FabricConfig.parse("eject=100,eject_max=400")
+    br = CircuitBreaker(fcfg, clock=lambda: now[0])
+    assert br.state == CLOSED and br.delay_s() == 0.0
+    assert br.record_failure() == OPEN
+    assert br.delay_s() == pytest.approx(0.1)
+    assert not br.allow_probe()                # backoff not yet expired
+    now[0] = 0.11
+    assert br.allow_probe() and br.state == HALF_OPEN
+    assert not br.allow_probe()                # exactly one probe per open
+    assert br.record_success() == CLOSED
+    # Consecutive failures double toward the cap...
+    br.record_failure()
+    assert br.backoff_s == pytest.approx(0.1)
+    br.record_failure()
+    assert br.backoff_s == pytest.approx(0.2)
+    br.record_failure()
+    br.record_failure()
+    assert br.backoff_s == pytest.approx(0.4)  # capped at eject_max
+    # ...and a success resets the schedule.
+    now[0] = 10.0
+    assert br.allow_probe()
+    br.record_success()
+    br.record_failure()
+    assert br.backoff_s == pytest.approx(0.1)
+
+
+def test_circuit_breaker_flap_holddown():
+    now = [0.0]
+    fcfg = FabricConfig.parse(
+        "eject=100,eject_max=400,flap_k=3,flap_window=60000,holddown=5000"
+    )
+    br = CircuitBreaker(fcfg, clock=lambda: now[0])
+    # Three openings inside the window — even interleaved with probe
+    # successes (open→closed→open oscillation IS the flap pattern).
+    for i in range(2):
+        br.record_failure()
+        now[0] += 0.2
+        assert br.allow_probe()
+        br.record_success()
+        now[0] += 0.2
+    assert br.holddowns == 0
+    br.record_failure()                        # third opening in window
+    assert br.holddowns == 1
+    assert br.delay_s() == pytest.approx(5.0)  # floored at holddown
+    assert not br.allow_probe()
+    now[0] += 5.1
+    assert br.allow_probe()                    # hold-down expires normally
+
+
+def test_brownout_levels():
+    off = FabricConfig.parse("")
+    on = FabricConfig.parse("brownout=1,brownout_frac=0.5")
+    assert brownout_level(1, 4, off) == 0          # opt-in
+    assert brownout_level(4, 4, on) == 0           # healthy fleet
+    assert brownout_level(3, 4, on) == 0           # 0.75 > frac
+    assert brownout_level(2, 4, on) == 1           # at frac: shed scans
+    assert brownout_level(1, 4, on) == 2           # ≤ frac/2: shed work
+    assert brownout_level(2, 4, on, budget_exhausted=True) == 2
+    assert brownout_level(0, 4, on) == 0           # dead fleet: route and
+    assert brownout_level(0, 0, on) == 0           # surface WorkerLost
+
+
+# -------------------------------------------------- zero-cost construction
+
+
+def test_unconfigured_router_has_no_chaos_machinery():
+    """Acceptance: chaos is zero-cost when unconfigured — plain link
+    class, no injector, no accept-path wrapper instance attribute."""
+    router = Router(["tcp:127.0.0.1:1"], config=Config(fabric=QUIET_FABRIC))
+    assert router.chaos is None
+    assert type(router.links[0]) is WorkerLink
+    assert "submit" not in vars(router)            # class method, unswapped
+    chaotic = Router(
+        ["tcp:127.0.0.1:1"],
+        config=Config(fabric=QUIET_FABRIC + ",chaos=42:drop=0.1+accept=0.1"),
+    )
+    assert type(chaotic.links[0]) is ChaosWorkerLink
+    assert chaotic.chaos.seed == 42
+    assert "submit" in vars(chaotic)               # accept chaos installed
+
+
+# --------------------------------------------------- injected-fault planes
+
+
+def test_chaos_reorder_dup_slow_absorbed_byte_exactly(bam_path):
+    """delay (reordering) + dup (double delivery) + slow (link latency)
+    under concurrent load: every answer must still be correct — id-keyed
+    futures absorb reordering, id-dedup drops duplicates."""
+    spec = "delay=0.3x30+dup=0.3+slow=0.2x2"
+    with _fabric(
+        n=2, fabric_spec=QUIET_FABRIC + ",chaos=11:" + spec
+    ) as (raddr, router, _services, _addrs):
+        with ServeClient(raddr) as c:
+            c.request("plan", path=bam_path, split_size=256 << 10)
+            expected = c.request("count", path=bam_path)["count"]
+        results, errors = [], []
+
+        def load():
+            try:
+                with ServeClient(raddr) as c:
+                    for _ in range(8):
+                        results.append(
+                            c.request("count", path=bam_path)["count"]
+                        )
+            except Exception as exc:   # pragma: no cover - fail loudly
+                errors.append(exc)
+
+        threads = [threading.Thread(target=load) for _ in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errors
+        assert results == [expected] * 24          # zero lost, zero wrong
+        inj = router.chaos.injected
+        assert inj["delay"] > 0 and inj["dup"] > 0 and inj["slow"] > 0
+        with ServeClient(raddr) as c:
+            stats = c.request("stats")
+        assert stats["chaos"]["seed"] == 11
+        assert stats["chaos"]["injected"]["delay"] == inj["delay"]
+
+
+def test_chaos_drop_fails_over_within_budget(bam_path):
+    """Seeded connection drops: the victim link's pendings fail with
+    WorkerLost and the router re-dispatches under the retry budget."""
+    # A seed whose drop pattern fires early but NOT on the very first
+    # sends (the fixture plan/warm-up requests must land).
+    seed = _find_seed("drop", 0.25, want_true_before=12,
+                      want_false_at=(0, 1, 2))
+    with _fabric(
+        n=2,
+        # Chaos drops hit the reprobe pings too, so cap the breaker
+        # backoff and neutralize flap hold-down (holddown ≤ eject_max)
+        # or the suppression designed for crash-loops would — correctly —
+        # park both links for seconds at a time.
+        fabric_spec=f"probe=60,eject=30,eject_max=120,holddown=120,"
+        f"autoscale=60000,budget=64,budget_rate=1,chaos={seed}:drop=0.25",
+    ) as (raddr, router, _services, addrs):
+        # Reference from a DIRECT worker connection: the router's links
+        # are under chaos from the first request (probes included).
+        with ServeClient(addrs[0]) as c:
+            c.request("plan", path=bam_path, split_size=256 << 10)
+            expected = c.request("count", path=bam_path)["count"]
+        with ServeClient(raddr) as c:
+            for _ in range(20):
+                # The fleet can be momentarily all-dropped; the client
+                # owns that retry (typed WorkerLost), never a wrong or
+                # hung answer.
+                for attempt in range(40):
+                    try:
+                        assert c.request("count",
+                                         path=bam_path)["count"] == expected
+                        break
+                    except ServeClientError as exc:
+                        assert exc.error == "WorkerLost"
+                        time.sleep(0.15)
+                else:
+                    pytest.fail("fleet never recovered from chaos drops")
+        assert router.chaos.injected["drop"] >= 1
+        assert router.counters.get("failovers", 0) >= 1
+        assert router.counters.get("budget_spent", 0) >= 1
+
+
+# ----------------------------------------------------- streaming failover
+
+
+def test_stream_relay_is_byte_identical(bam_path):
+    with _fabric(n=2, fabric_spec=QUIET_FABRIC) as (_r, _router, _s, addrs):
+        with ServeClient(addrs[0]) as c:
+            c.request("plan", path=bam_path, split_size=256 << 10)
+            ref = c.request("batch", path=bam_path)["_binary"]
+    assert len(ref) >= 3, "fixture must stream several frames"
+    with _fabric(
+        n=2, fabric_spec=QUIET_FABRIC + ",stream=1"
+    ) as (raddr, router, _services, _addrs):
+        with ServeClient(raddr) as c:
+            c.request("plan", path=bam_path, split_size=256 << 10)
+            resp = c.request("batch", path=bam_path)
+            assert resp["binary_frames"] == len(ref)
+            assert resp["_binary"] == ref          # frame-for-frame equal
+        assert router.counters.get("streamed", 0) == 1
+        assert router.counters.get("stream_frames", 0) == len(ref)
+
+
+def test_stream_resumes_after_midstream_cut_byte_identical(bam_path):
+    """Chaos trunc severs the relay mid-stream; the router must resume
+    on the other worker from the frame token and deliver a sequence
+    byte-identical to the undisturbed one — without buffering."""
+    with _fabric(n=1, fabric_spec=QUIET_FABRIC) as (_r, _router, _s, addrs):
+        with ServeClient(addrs[0]) as c:
+            c.request("plan", path=bam_path, split_size=256 << 10)
+            ref = c.request("batch", path=bam_path)["_binary"]
+    # Cut somewhere strictly inside the stream: no trunc on frame 0
+    # (resume from 0 is just a retry), at least one before the last.
+    seed = _find_seed("trunc", 0.25, want_true_before=len(ref) - 1,
+                      want_false_at=(0,))
+    with _fabric(
+        n=2,
+        fabric_spec=QUIET_FABRIC + f",stream=1,budget=64,budget_rate=1,"
+        f"chaos={seed}:trunc=0.25",
+    ) as (raddr, router, _services, _addrs):
+        with ServeClient(raddr) as c:
+            c.request("plan", path=bam_path, split_size=256 << 10)
+            resp = c.request("batch", path=bam_path)
+            assert resp["_binary"] == ref
+        assert router.counters.get("resumed", 0) >= 1
+        assert router.chaos.injected["trunc"] >= 1
+
+
+def test_service_resume_from_slices_the_deterministic_frames(bam_path):
+    with _fabric(n=1) as (_r, _router, _services, addrs):
+        with ServeClient(addrs[0]) as c:
+            c.request("plan", path=bam_path, split_size=256 << 10)
+            full = c.request("batch", path=bam_path)["_binary"]
+            n = len(full)
+            assert n >= 3
+            resumed = c.request("batch", path=bam_path, resume_from=n - 2)
+            assert resumed["total_frames"] == n
+            assert resumed["resume_from"] == n - 2
+            assert resumed["_binary"] == full[n - 2:]
+            with pytest.raises(ServeClientError) as exc:
+                c.request("batch", path=bam_path, resume_from=n)
+            assert exc.value.error == "ProtocolError"
+            with pytest.raises(ServeClientError) as exc:
+                c.request("batch", path=bam_path, resume_from=-1)
+            assert exc.value.error == "ProtocolError"
+
+
+class _CutOnceWorker:
+    """Serves ``batch`` of 3 deterministic frames but cuts the first
+    attempt after frame 0 — the client must reconnect and ask for the
+    tail with ``resume_from=1``."""
+
+    FRAMES = [b"A" * 32, b"B" * 48, b"C" * 16]
+
+    def __init__(self):
+        self.port = None
+        self.resume_tokens = []
+        self._attempts = 0
+        self._loop = None
+        self._stop = None
+        self._started = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def start(self):
+        self._thread.start()
+        assert self._started.wait(10)
+        return self
+
+    def stop(self):
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._stop.set)
+        self._thread.join(timeout=10)
+
+    def _run(self):
+        asyncio.run(self._main())
+
+    async def _main(self):
+        server = await asyncio.start_server(self._handle, "127.0.0.1", 0)
+        self.port = server.sockets[0].getsockname()[1]
+        self._loop = asyncio.get_running_loop()
+        self._stop = asyncio.Event()
+        self._started.set()
+        async with server:
+            await self._stop.wait()
+
+    async def _handle(self, reader, writer):
+        try:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    return
+                req = json.loads(line)
+                rid = req.get("id")
+                base = int(req.get("resume_from") or 0)
+                self.resume_tokens.append(req.get("resume_from"))
+                self._attempts += 1
+                tail = self.FRAMES[base:]
+                writer.write((json.dumps(
+                    {"id": rid, "ok": True, "binary_frames": len(tail),
+                     "total_frames": len(self.FRAMES), "resume_from": base}
+                ) + "\n").encode())
+                if self._attempts == 1:
+                    # Frame 0 lands whole, then the connection dies.
+                    writer.write(
+                        struct.pack("<Q", len(tail[0])) + tail[0]
+                    )
+                    await writer.drain()
+                    return
+                for fr in tail:
+                    writer.write(struct.pack("<Q", len(fr)) + fr)
+                await writer.drain()
+        finally:
+            with contextlib.suppress(Exception):
+                writer.close()
+
+
+def test_client_reconnects_and_resumes_midstream():
+    w = _CutOnceWorker().start()
+    try:
+        with ServeClient(f"tcp:127.0.0.1:{w.port}",
+                         policy=FaultPolicy(max_retries=3)) as c:
+            resp = c.request("batch", path="/x.bam")
+            assert resp["_binary"] == _CutOnceWorker.FRAMES
+            assert resp["binary_frames"] == 3
+            # Reassembly presents as an undisturbed response.
+            assert "resume_from" not in resp and "total_frames" not in resp
+        assert w.resume_tokens == [None, 1]
+    finally:
+        w.stop()
+
+
+# ----------------------------------------------------------- wedge + eject
+
+
+class _SilentWorker:
+    """Accepts connections and never answers — a SIGSTOP'd (wedged)
+    worker as seen from the router: the socket is open, nothing moves."""
+
+    def __init__(self):
+        self.port = None
+        self._loop = None
+        self._stop = None
+        self._started = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def start(self):
+        self._thread.start()
+        assert self._started.wait(10)
+        return self
+
+    def stop(self):
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._stop.set)
+        self._thread.join(timeout=10)
+
+    def _run(self):
+        asyncio.run(self._main())
+
+    async def _main(self):
+        server = await asyncio.start_server(self._handle, "127.0.0.1", 0)
+        self.port = server.sockets[0].getsockname()[1]
+        self._loop = asyncio.get_running_loop()
+        self._stop = asyncio.Event()
+        self._started.set()
+        async with server:
+            await self._stop.wait()
+
+    async def _handle(self, reader, writer):
+        with contextlib.suppress(Exception):
+            while await reader.readline():
+                pass                             # swallow, never reply
+
+
+def test_wedged_worker_is_ejected_and_pending_fails_over(bam_path):
+    """The strictly-harder failure: a wedged worker hangs requests
+    instead of failing them. The probe timeout must EJECT it — failing
+    the pending future so the idempotent op fails over — and its breaker
+    must read open."""
+    from spark_bam_tpu.fabric.router import rendezvous_weight
+
+    wedged = _SilentWorker().start()
+    service = SplitService(Config(serve=SERVE_SPEC))
+    try:
+        with ServerThread(service) as srv:
+            h, p = srv.address
+            real, dead = f"tcp:{h}:{p}", f"tcp:127.0.0.1:{wedged.port}"
+            with ServeClient(real) as c:
+                c.request("plan", path=bam_path, split_size=256 << 10)
+                expected = c.request("count", path=bam_path)["count"]
+            # The wedged worker must win rendezvous so the routed count
+            # starts (and hangs) there.
+            wedged_wins_w0 = rendezvous_weight("w0", bam_path) > \
+                rendezvous_weight("w1", bam_path)
+            addrs = [dead, real] if wedged_wins_w0 else [real, dead]
+            router = Router(addrs, config=Config(
+                fabric="probe=100,probe_timeout=300,eject=50,autoscale=60000"
+            ))
+            with ServerThread(router) as rsrv:
+                t0 = time.monotonic()
+                with ServeClient(rsrv.address) as c:
+                    assert c.request("count",
+                                     path=bam_path)["count"] == expected
+                waited = time.monotonic() - t0
+            assert router.counters.get("failovers", 0) >= 1
+            wid = "w0" if wedged_wins_w0 else "w1"
+            link = next(l for l in router.links if l.wid == wid)
+            assert link.healthy is False
+            assert link.breaker is not None and link.breaker.state != CLOSED
+            # The hang is bounded by the probe cycle, not the client
+            # timeout: probe_ms + probe_timeout + slack.
+            assert waited < 10.0
+    finally:
+        service.close()
+        wedged.stop()
+
+
+# ---------------------------------------------------------------- brownout
+
+
+def test_brownout_sheds_scan_class_with_pacing_hint(bam_path):
+    """Kill one of two workers under ``brownout=1,brownout_frac=0.9``:
+    healthy frac 0.5 ≤ 0.9 but > 0.45 → level 1 — scan-class ops shed
+    with a pacing hint at the edge, plan-class ops still served."""
+    services = [SplitService(Config(serve=SERVE_SPEC)) for _ in range(2)]
+    srvs = [ServerThread(s).start() for s in services]
+    addrs = [f"tcp:{h}:{p}" for h, p in (s.address for s in srvs)]
+    router = Router(addrs, config=Config(
+        fabric="probe=50,eject=30,autoscale=60000,"
+               "brownout=1,brownout_frac=0.9"
+    ))
+    rsrv = ServerThread(router).start()
+    try:
+        with ServeClient(rsrv.address, policy=None) as c:
+            c.request("plan", path=bam_path, split_size=256 << 10)
+            expected = c.request("count", path=bam_path)["count"]
+            srvs[0].stop()                         # worker 0 vanishes
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                if not router.links[0].healthy:
+                    break
+                time.sleep(0.05)
+            assert router.links[0].healthy is False
+            with pytest.raises(ServeClientError) as exc:
+                c.request("count", path=bam_path)   # scan-class: shed
+            assert exc.value.error == "Overloaded"
+            assert "retry_after_ms" in exc.value.resp
+            plan = c.request("plan", path=bam_path,
+                             split_size=256 << 10)  # plan-class: served
+            assert plan["ok"]
+            assert c.request("stats")["brownout"] == 1
+        assert router.counters.get("brownout_shed", 0) >= 1
+        assert router._autoscale_hold() is True
+    finally:
+        rsrv.stop()
+        for s in srvs[1:]:
+            s.stop()
+        for s in services:
+            s.close()
+
+
+def test_shed_hint_derives_from_latency_median_jittered():
+    router = Router([], config=Config(fabric=QUIET_FABRIC))
+    assert router._shed_hint_ms(25.0) == 25.0      # upstream hint wins
+    assert router._shed_hint_ms() == 0.0           # no samples yet
+    for ms in (10.0, 12.0, 14.0):
+        router._latency.record(ms)
+    j = router.policy.jitter
+    for _ in range(20):
+        hint = router._shed_hint_ms()
+        assert 12.0 * (1 - j) <= hint <= 12.0 * (1 + j)
+
+
+def test_autoscaler_holds_while_brownout_active():
+    from spark_bam_tpu.fabric.autoscaler import autoscale_worker
+
+    class _Link:
+        wid = "w0"
+        healthy = True
+        draining = False
+
+        def __init__(self):
+            self.ops = []
+
+        async def request(self, req):
+            self.ops.append(req["op"])
+            if req["op"] == "stats":
+                return {"ok": True, "served": len(self.ops),
+                        "latency_p99_ms": 500.0, "batch_rows": 16,
+                        "tick_ms": 8.0, "limits": {"scan": 64, "plan": 64}}
+            return {"ok": True, "applied": {}}
+
+    async def run(hold_value):
+        link = _Link()
+        fcfg = FabricConfig.parse("autoscale=5,slo=200")
+        counts = []
+        task = asyncio.ensure_future(autoscale_worker(
+            link, fcfg, lambda *a: counts.append(a),
+            hold=lambda: hold_value,
+        ))
+        await asyncio.sleep(0.1)
+        task.cancel()
+        with contextlib.suppress(asyncio.CancelledError):
+            await task
+        return link.ops, counts
+
+    ops, counts = asyncio.run(run(True))
+    assert "tune" not in ops and not counts        # held: no actuation
+    ops, counts = asyncio.run(run(False))
+    assert "tune" in ops and counts                # released: tunes flow
+
+
+# ------------------------------------------------------- artifact context
+
+
+def test_chaos_seed_lands_in_flight_dumps(tmp_path, monkeypatch):
+    from spark_bam_tpu.obs import flight
+
+    monkeypatch.setenv(flight.FLIGHT_DIR_ENV, str(tmp_path))
+    router = Router([], config=Config(
+        fabric=QUIET_FABRIC + ",chaos=77:drop=0.5"
+    ))
+    assert router.chaos is not None
+    try:
+        assert flight.context()["chaos_seed"] == 77
+        path = flight.dump_auto("chaos_test", who="router")
+        assert path is not None
+        meta = flight.read_dump(path)[0]
+        assert meta["chaos_seed"] == 77
+        assert meta["chaos_spec"].startswith("77:drop=0.5")
+    finally:
+        flight.clear_context("chaos_seed", "chaos_spec")
+    # Cleared context stops stamping subsequent dumps.
+    meta = flight.read_dump(flight.dump_auto("after", who="router"))[0]
+    assert "chaos_seed" not in meta
+
+
+def test_chaos_seed_lands_in_slo_alert_ledger():
+    from spark_bam_tpu.obs import flight
+    from spark_bam_tpu.obs.slo import SloConfig, SloEngine
+
+    class _View:
+        value = 50.0
+
+        def quantile(self, name, q, window_s):
+            return self.value
+
+    view = _View()
+    engine = SloEngine(SloConfig.parse("serve.latency:p99<100ms@1m"),
+                       lambda: view)
+    flight.set_context(chaos_seed=5, chaos_spec="5:drop=0.1")
+    try:
+        engine.evaluate()
+        view.value = 300.0
+        engine.evaluate()                          # fires
+        entry = list(engine.ledger)[-1]
+        assert entry["state"] == "firing"
+        assert entry["chaos_seed"] == 5
+        assert entry["chaos_spec"] == "5:drop=0.1"
+    finally:
+        flight.clear_context("chaos_seed", "chaos_spec")
+
+
+# ------------------------------------------------------ the storm (slow)
+
+
+@pytest.mark.slow
+def test_seeded_storm_zero_lost_merged_traces_bounded_amplification(
+    bam_path, tmp_path, monkeypatch
+):
+    """Satellite 4 / the acceptance storm: a seeded rolling
+    SIGKILL+SIGSTOP schedule against real worker subprocesses under
+    concurrent mixed-op load. Gates: zero lost requests, retry
+    amplification ≤ 2×, one merged trace tree per (post-storm tagged)
+    request, and the chaos seed in the router's flight artifacts."""
+    import os
+    import subprocess
+
+    from spark_bam_tpu import obs as _obs
+    from spark_bam_tpu.fabric import ChaosStorm, WorkerPool
+    from spark_bam_tpu.obs import flight
+    from spark_bam_tpu.obs import trace as obs_trace
+    from spark_bam_tpu.obs.report import merge_traces
+
+    art = tmp_path / "telemetry"
+    art.mkdir()
+    monkeypatch.setenv(flight.FLIGHT_DIR_ENV, str(art))
+    env = dict(os.environ,
+               SPARK_BAM_METRICS_OUT=str(art),
+               SPARK_BAM_FLIGHT_DIR=str(art),
+               SPARK_BAM_CACHE_DIR=str(tmp_path),
+               SPARK_BAM_CACHE="readwrite")
+    seed = 1234
+    spec = FabricChaosSpec.parse("kills=5+wedges=1+storm=900+revive=400")
+    results, errors = [], []
+    tagged: "list[str]" = []
+
+    from spark_bam_tpu import obs
+
+    obs.shutdown()
+    obs.configure()
+    try:
+        with WorkerPool(workers=3, devices=1,
+                        serve="window=64KB,halo=8KB,batch=8,tick=5",
+                        env=env, stderr=subprocess.DEVNULL) as pool:
+            # The seeded schedule (asserted below) aims every kill at
+            # POOL index 0, while single-path traffic all lands on the
+            # rendezvous-winning WID — a per-run function of the tmp
+            # path. Hand the kill victim the winning wid slot so the
+            # storm provably catches requests in flight (failovers),
+            # instead of EOF-ing an idle link when the winner happens
+            # to be a bystander.
+            from spark_bam_tpu.fabric.router import rendezvous_weight
+            slots = sorted(range(3), reverse=True,
+                           key=lambda i: rendezvous_weight(f"w{i}",
+                                                           bam_path))
+            addrs: "list[str | None]" = [None] * 3
+            for slot, pidx in zip(slots, range(3)):
+                addrs[slot] = pool.addresses[pidx]
+            router = Router(addrs, config=Config(
+                fabric="probe=150,probe_timeout=1000,eject=100,"
+                       "autoscale=60000,budget=64,budget_rate=1,"
+                       f"chaos={seed}:kills=5+wedges=1"
+            ), pool=pool)
+            with ServerThread(router) as rsrv:
+                with ServeClient(rsrv.address) as c:
+                    c.request("plan", path=bam_path, split_size=256 << 10)
+                    expected = c.request("count", path=bam_path)["count"]
+                    ref = b"".join(
+                        c.request("batch", path=bam_path)["_binary"]
+                    )
+
+                storm = ChaosStorm(pool, seed, spec)
+
+                def load(tid):
+                    # Mixed idempotent ops under CONTINUOUS pressure for
+                    # the storm's whole lifetime (respawns stretch it).
+                    # Batch-heavy on purpose: a batch keeps a request in
+                    # flight on the link for most of the wall clock, so
+                    # the seeded kills land mid-request (failovers), not
+                    # in the idle gaps between short counts.
+                    try:
+                        with ServeClient(rsrv.address) as c:
+                            i = 0
+                            while (storm._thread.is_alive() or i < 12) \
+                                    and i < 400:
+                                if i % 2:
+                                    got = b"".join(c.request(
+                                        "batch", path=bam_path
+                                    )["_binary"])
+                                    results.append(
+                                        ("batch", got == ref)
+                                    )
+                                else:
+                                    results.append((
+                                        "count",
+                                        c.request("count", path=bam_path)
+                                        ["count"] == expected,
+                                    ))
+                                i += 1
+                    except Exception as exc:
+                        errors.append((tid, repr(exc)))
+
+                storm.start()
+                threads = [threading.Thread(target=load, args=(i,))
+                           for i in range(4)]
+                for t in threads:
+                    t.start()
+                storm.join(timeout_s=600)
+                for t in threads:
+                    t.join(timeout=600)
+                assert len(storm.events) == 6
+                assert sum(e["action"] == "kill"
+                           for e in storm.events) == 5
+                assert sum(e["action"] == "wedge"
+                           for e in storm.events) == 1
+                # Post-storm: tagged requests, each must resolve to ONE
+                # merged cross-process trace tree.
+                deadline = time.monotonic() + 30.0
+                while time.monotonic() < deadline and \
+                        len(router.healthy_links()) < 3:
+                    time.sleep(0.2)
+                with ServeClient(rsrv.address) as c:
+                    for _ in range(4):
+                        tid = obs_trace.new_id()
+                        r = c.request("count", path=bam_path,
+                                      trace={"id": tid})
+                        assert r["count"] == expected
+                        tagged.append(tid)
+                counters = dict(router.counters)
+        _obs.export_jsonl(art / f"trace-{os.getpid()}.jsonl")
+    finally:
+        obs.shutdown()
+
+    # Gate 1: zero lost requests, zero wrong answers.
+    assert not errors, f"storm lost requests: {errors}"
+    assert len(results) >= 48 and all(ok for _op, ok in results)
+    # Gate 2: retry amplification ≤ 2× — upstream dispatches over
+    # admitted requests.
+    admitted = len(results) + 4 + 3   # load + tagged + warm-up
+    dispatches = counters.get("routed", 0) + counters.get("failovers", 0)
+    assert dispatches / admitted <= 2.0, counters
+    assert counters.get("failovers", 0) >= 1      # the storm actually bit
+    assert counters.get("breaker.opened", 0) >= 3
+    # Gate 3: the router's worker-lost postmortems carry the chaos seed.
+    dumps = sorted(art.glob("flight-*-worker_lost.jsonl"))
+    assert dumps, "SIGKILLs must leave router-side postmortems"
+    meta = flight.read_dump(dumps[-1])[0]
+    assert meta["chaos_seed"] == seed
+    # Gate 4: one merged trace tree per tagged request across processes.
+    traces = sorted(art.glob("trace-*.jsonl"))
+    assert len(traces) >= 2
+    merged = merge_traces([str(p) for p in traces])
+    for tid in tagged:
+        assert tid in merged["traces"], sorted(merged["traces"])
+        evs = merged["traces"][tid]
+        spans = {e["span"]: e for e in evs}
+        reqs = [e for e in evs if e["name"] == "serve.request"]
+        assert len(reqs) == 1                      # one tree, no orphans
+        for e in evs:
+            cur = e
+            while cur.get("pspan") in spans:
+                cur = spans[cur["pspan"]]
+            assert cur["name"] == "fabric.relay"

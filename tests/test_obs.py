@@ -477,6 +477,56 @@ def test_concurrent_span_nesting_across_threads(reg):
         assert int(ev["trace"], 16) == parent["attrs"]["thread"]
 
 
+def test_concurrent_span_nesting_across_asyncio_tasks(reg):
+    """Interleaved asyncio tasks share ONE thread: the span stack must
+    ride the execution context, not the thread. A thread-local stack
+    parents task B's span under whatever span task A still holds open —
+    grafting B onto A's trace — and once interleaved exits leak an
+    entry, every later span on the loop inherits a stale trace (the
+    fabric router's relay spans all collapsed onto one trace id under
+    storm load before this was contextvar-backed)."""
+    import asyncio
+
+    n_tasks, per_task = 8, 10
+    errors: list = []
+
+    async def task(i):
+        ctx = obs_trace.TraceContext(f"{i:016x}")
+        with obs_trace.bind(ctx):
+            for _ in range(per_task):
+                with obs.span("relay", task=i) as outer:
+                    await asyncio.sleep(0)     # interleave mid-span
+                    with obs.span("inner") as inner:
+                        await asyncio.sleep(0)
+                        if inner.trace_id != f"{i:016x}":
+                            errors.append((i, "trace", inner.trace_id))
+                        if inner.parent_span_id != outer.span_id:
+                            errors.append((i, "parent"))
+                        if inner.depth != 1:
+                            errors.append((i, "depth", inner.depth))
+
+    async def main():
+        await asyncio.gather(*(task(i) for i in range(n_tasks)))
+        # The loop thread's stack must be EMPTY afterwards: a serial
+        # span opened next joins only its own bound trace.
+        with obs_trace.bind(obs_trace.TraceContext("e" * 16)):
+            with obs.span("after") as sp:
+                assert sp.depth == 0 and sp.trace_id == "e" * 16
+
+    asyncio.run(main())
+    assert errors == []
+    events = [ev for ev in reg.events() if ev["name"] != "after"]
+    assert len(events) == n_tasks * per_task * 2
+    by_span = {ev["span"]: ev for ev in events}
+    for ev in events:
+        if ev["name"] != "inner":
+            continue
+        parent = by_span[ev["pspan"]]
+        assert parent["name"] == "relay"
+        assert parent["trace"] == ev["trace"]
+        assert int(ev["trace"], 16) == parent["attrs"]["task"]
+
+
 def test_executor_threads_rebind_trace(reg):
     from spark_bam_tpu.parallel.executor import ParallelConfig, run_partitions
 
