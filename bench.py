@@ -1711,11 +1711,13 @@ def _child_fabric_chaos(clients: int = 4):
     def p99(lat):
         return lat[min(len(lat) - 1, int(len(lat) * 0.99))]
 
-    def hammer(addr, expected, ref, until=None, per=12):
-        """Closed-loop mixed load: every 2nd request a streaming
-        ``batch``, the rest whole-file counts; ``until`` keeps clients
+    def hammer(addr, expected, ref, agg_ref, until=None, per=12):
+        """Closed-loop mixed load: a rotating ``count`` / streaming
+        ``batch`` / streaming ``aggregate`` mix; ``until`` keeps clients
         looping while it's true (the storm's lifetime). Any wrong
-        answer or failed request raises — zero loss is a gate."""
+        answer or failed request raises — zero loss is a gate, and both
+        frame-bearing ops are byte-equality gated against the clean
+        run's reference bytes."""
         lat: list = []
         n_ok = [0]
 
@@ -1729,7 +1731,8 @@ def _child_fabric_chaos(clients: int = 4):
             for _ in range(40):
                 try:
                     r = c.request(op, path=path)
-                    return (b"".join(r["_binary"]) if op == "batch"
+                    return (b"".join(r["_binary"])
+                            if op in ("batch", "aggregate")
                             else r["count"])
                 except ServeClientError as exc:
                     if exc.error != "WorkerLost":
@@ -1745,10 +1748,15 @@ def _child_fabric_chaos(clients: int = 4):
                 while (i < per if until is None
                        else (until() or i < per)) and i < 400:
                     t0 = time.perf_counter()
-                    if i % 2:
+                    if i % 3 == 1:
                         if call(c, "batch") != ref:
                             raise AssertionError(
                                 "storm batch diverged from clean frames"
+                            )
+                    elif i % 3 == 2:
+                        if call(c, "aggregate") != agg_ref:
+                            raise AssertionError(
+                                "storm aggregate diverged from clean bytes"
                             )
                     elif call(c, "count") != expected:
                         raise AssertionError("count diverged under storm")
@@ -1771,6 +1779,9 @@ def _child_fabric_chaos(clients: int = 4):
                 c.request("plan", path=path, split_size=256 << 10)
                 expected = c.request("count", path=path)["count"]
                 ref = b"".join(c.request("batch", path=path)["_binary"])
+                agg_ref = b"".join(
+                    c.request("aggregate", path=path)["_binary"]
+                )
             # The seeded schedule aims its kills at fixed POOL indices;
             # routing aims single-path traffic at the rendezvous-winning
             # WID. Hand the storm's favourite victim the winning slot so
@@ -1795,7 +1806,7 @@ def _child_fabric_chaos(clients: int = 4):
             rsrv = ServerThread(router).start()
             try:
                 wall_c, lat_c, n_clean = hammer(
-                    rsrv.address, expected, ref
+                    rsrv.address, expected, ref, agg_ref
                 )
             finally:
                 rsrv.stop()
@@ -1812,7 +1823,7 @@ def _child_fabric_chaos(clients: int = 4):
                 storm = ChaosStorm(pool, seed, storm_spec)
                 storm.start()
                 wall_s, lat_s, n_storm = hammer(
-                    rsrv.address, expected, ref,
+                    rsrv.address, expected, ref, agg_ref,
                     until=lambda: storm._thread.is_alive(),
                 )
                 storm.join(timeout_s=120.0)
@@ -1851,6 +1862,7 @@ def _child_fabric_chaos(clients: int = 4):
         "fabric_chaos_reqs": n_storm,
         "fabric_chaos_lost": 0,    # the load loop re-raises; gated
         "fabric_chaos_batch_equal": True,
+        "fabric_chaos_aggregate_equal": True,
         "fabric_chaos_clean_rps": round(rps_clean, 1),
         "fabric_chaos_storm_rps": round(rps_storm, 1),
         "fabric_chaos_degradation": round(
@@ -2011,6 +2023,162 @@ def export_leg():
     if out is None:
         raise RuntimeError(
             f"export child produced no result: {err or 'stages=' + str(stages)}"
+        )
+    return out
+
+
+def _child_aggregate(serve_queries: int = 12):
+    """On-device aggregation leg (docs/analytics.md "Aggregation").
+
+    The bytes-reduction A/B: the serve ``aggregate`` op (fused device
+    reduction, kilobytes back) vs the equivalent ``batch`` + host
+    reduction for the SAME query — the host side fetches only the
+    columns the plan actually needs (a stronger baseline than the full
+    batch) and reduces with the numpy oracle. Gates: the decoded device
+    vectors must be byte-equal to the host reduction, and the wire
+    bytes must shrink ≥10× (the PR's acceptance floor).
+
+    Own child for the same reason as ``--child-serve``: the daemon's
+    mesh wants 8 virtual CPU devices forced before jax init."""
+    _emit_stage("start")
+    from spark_bam_tpu.core.platform import force_cpu_devices
+
+    force_cpu_devices(8)
+    enable_compile_cache()
+    import jax
+
+    _emit_stage("backend_ok:" + jax.devices()[0].platform)
+
+    import re
+
+    from spark_bam_tpu.agg.host import host_aggregate
+    from spark_bam_tpu.agg.plan import AggConfig, decode_result
+    from spark_bam_tpu.bam.bai import index_bam
+    from spark_bam_tpu.benchmarks.synth import synthetic_fixture
+    from spark_bam_tpu.columnar.native import NativeReader
+    from spark_bam_tpu.core.config import Config as C
+    from spark_bam_tpu.serve import ServeClient, ServerThread, SplitService
+
+    path = str(synthetic_fixture(reads=20_000))
+    index_bam(path)
+    loci = "chr1:100k-900k"
+    plan = AggConfig.parse("")
+    # The minimal projection a host reduction of the default plan needs:
+    # fixed planes + seq (l_seq) + cigar (ref_span).
+    host_columns = "flag,ref_id,pos,mapq,tlen,cigar,seq"
+    cig_ref = re.compile(rb"(\d+)([MIDNSHP=X])")
+
+    def resp_bytes(r: dict) -> int:
+        """Total wire bytes of one response: the JSON line plus every
+        binary frame (with its u64 length prefix)."""
+        head = {k: v for k, v in r.items() if k != "_binary"}
+        frames = r.get("_binary") or []
+        return (len(json.dumps(head)) + 1
+                + sum(8 + len(f) for f in frames))
+
+    def planes_from_batch(blob: bytes) -> dict:
+        """Rebuild the oracle's flat planes from streamed batch frames —
+        the work a host-side aggregation pipeline actually does."""
+        reader = NativeReader(blob)
+        cols = {k: [] for k in ("flag", "ref_id", "pos", "mapq", "tlen")}
+        l_seq: list = []
+        ref_span: list = []
+        for b in reader.iter_batches():
+            for k in cols:
+                cols[k].append(np.asarray(b.columns[k]))
+            sc = b.columns["seq"]
+            l_seq.append(np.diff(np.asarray(sc.offsets)))
+            cg = b.columns["cigar"]
+            off, val = np.asarray(cg.offsets), np.asarray(cg.values)
+            for i in range(b.num_rows):
+                span = 0
+                for m in cig_ref.finditer(
+                        val[off[i]: off[i + 1]].tobytes()):
+                    if m.group(2) in (b"M", b"D", b"N", b"=", b"X"):
+                        span += int(m.group(1))
+                ref_span.append(span)
+        out = {
+            k: (np.concatenate(v) if v else np.zeros(0, np.int32))
+            for k, v in cols.items()
+        }
+        out["l_seq"] = (
+            np.concatenate(l_seq).astype(np.int32)
+            if l_seq else np.zeros(0, np.int32)
+        )
+        out["ref_span"] = np.asarray(ref_span, dtype=np.int32)
+        out["valid"] = np.ones(len(out["flag"]), dtype=bool)
+        return out
+
+    service = SplitService(C(serve="window=64KB,halo=8KB,workers=2"))
+    try:
+        srv = ServerThread(service).start()
+        try:
+            with ServeClient(srv.address) as c:
+                warm = c.request("aggregate", path=path, intervals=loci)
+                nc = len(warm["result"]["contigs"])
+                _emit_stage("agg_warm")
+                t0 = time.perf_counter()
+                for _ in range(serve_queries):
+                    r = c.request("aggregate", path=path, intervals=loci)
+                agg_wall = time.perf_counter() - t0
+                agg_bytes = resp_bytes(r)
+                device = decode_result(r["result"], r["_binary"][0])
+                # Host side: projected batch fetch + numpy reduction.
+                t0 = time.perf_counter()
+                rb = c.request("batch", path=path, intervals=loci,
+                               columns=host_columns)
+                blob = b"".join(rb["_binary"])
+                host = host_aggregate(planes_from_batch(blob), plan, nc)
+                host_wall = time.perf_counter() - t0
+                batch_bytes = resp_bytes(rb)
+        finally:
+            srv.stop()
+    finally:
+        service.close()
+    _emit_stage("agg_ab_done")
+
+    equal = all(
+        np.array_equal(device[k].reshape(-1), host[k]) for k in host
+    )
+    if not equal:
+        raise AssertionError(
+            "device aggregate diverged from batch+host reduction"
+        )
+    reduction = batch_bytes / max(agg_bytes, 1)
+    if reduction < 10.0:
+        raise AssertionError(
+            f"aggregate bytes reduction {reduction:.1f}x < 10x "
+            f"({agg_bytes} vs {batch_bytes} wire bytes)"
+        )
+    agg_ms = agg_wall / serve_queries * 1e3
+    _emit_result("aggregate", {
+        "agg_rows": int(r["rows"]),
+        "agg_bytes": int(agg_bytes),
+        "agg_batch_bytes": int(batch_bytes),
+        "agg_bytes_reduction": round(reduction, 1),
+        "agg_equal": True,
+        "agg_rps": round(serve_queries / agg_wall, 1),
+        "agg_ms": round(agg_ms, 2),
+        "agg_host_ms": round(host_wall * 1e3, 2),
+        "agg_vs_host_ms": {
+            "aggregate": round(agg_ms, 2),
+            "batch_plus_host": round(host_wall * 1e3, 2),
+        },
+    })
+
+
+def aggregate_leg():
+    """Parent wrapper for the on-device aggregation leg (own child:
+    virtual device mesh). Budget env-tunable; 0 skips."""
+    budget = int(os.environ.get("SB_BENCH_AGGREGATE_CHILD_S", "420"))
+    if budget <= 0:
+        return {}
+    results, stages, err = _run_child(["--child-aggregate"], budget)
+    out = results.get("aggregate")
+    if out is None:
+        raise RuntimeError(
+            "aggregate child produced no result: "
+            f"{err or 'stages=' + str(stages)}"
         )
     return out
 
@@ -3009,6 +3177,9 @@ def main():
     if len(sys.argv) > 1 and sys.argv[1] == "--child-export":
         _child_export()
         return
+    if len(sys.argv) > 1 and sys.argv[1] == "--child-aggregate":
+        _child_aggregate()
+        return
     if len(sys.argv) > 1 and sys.argv[1] == "--child-fabric":
         _child_fabric()
         return
@@ -3472,6 +3643,14 @@ def _main_measure(record, warnings, errors):
         record.update(export_leg())
     except Exception as e:
         warnings.append(f"export leg: {type(e).__name__}: {e}")
+    # Aggregation leg: serve `aggregate` (fused device reduction) vs the
+    # same query as a projected `batch` + host numpy reduction, gated on
+    # byte-equal answers and a ≥10x wire-bytes reduction (own child
+    # process — docs/analytics.md "Aggregation").
+    try:
+        record.update(aggregate_leg())
+    except Exception as e:
+        warnings.append(f"aggregate leg: {type(e).__name__}: {e}")
     # Fabric leg: 3 subprocess workers behind the router vs one daemon,
     # plus SLO-autoscaler recovery and SIGKILL failover (own child
     # process; equal-count/equal-bytes gated — docs/fabric.md).

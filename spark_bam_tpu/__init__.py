@@ -34,6 +34,7 @@ __all__ = [
     "load_splits_and_reads",
     "load_reads_and_positions",
     "export",
+    "aggregate",
     "count_reads_tpu",
     "load_reads_columnar",
     "record_starts_streaming",
@@ -51,6 +52,7 @@ _LAZY = {
         for name in (
             "load_bam", "load_reads", "load_sam", "load_bam_intervals",
             "load_splits_and_reads", "load_reads_and_positions", "export",
+            "aggregate",
         )
     },
     **{

@@ -1,0 +1,37 @@
+"""On-device analytics plane: fused aggregation inside the mesh tick.
+
+``plan`` holds the ``AggSpec`` grammar and the JSON+binary result
+schema, ``kernels`` the jit/shard_map reduction steps over the parser's
+flat planes, ``host`` the numpy oracle (differential truth + CPU
+fallback). Serving surface: the ``aggregate`` op (serve/service.py),
+``load.api.aggregate`` / ``Dataset.aggregate``, and the
+``spark-bam-tpu aggregate`` CLI subcommand — docs/analytics.md
+"Aggregation".
+"""
+
+from spark_bam_tpu.agg.plan import (
+    DEFAULT_SPEC,
+    AggConfig,
+    AggSpec,
+    decode_result,
+    encode_result,
+)
+from spark_bam_tpu.agg.kernels import aggregate_planes, make_shard_map_agg_step
+from spark_bam_tpu.agg.host import (
+    columns_from_records,
+    combine,
+    host_aggregate,
+)
+
+__all__ = [
+    "AggConfig",
+    "AggSpec",
+    "DEFAULT_SPEC",
+    "aggregate_planes",
+    "columns_from_records",
+    "combine",
+    "decode_result",
+    "encode_result",
+    "host_aggregate",
+    "make_shard_map_agg_step",
+]

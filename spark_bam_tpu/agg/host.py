@@ -1,0 +1,145 @@
+"""Host numpy oracle for the aggregation plane.
+
+Independent reference implementation of every metric in agg/plan.py —
+written against the *wire contract* (vector layouts, clamps) rather
+than sharing code with the device kernels, so the differential tests
+(tests/test_agg.py) compare two derivations of the same definition.
+Doubles as the CPU fallback: ``SplitService._handle_aggregate`` demotes
+here when the device reduction raises, and the record-based entry point
+serves the CRAM/SAM loaders whose records never materialize as flat
+planes.
+
+All arithmetic is int64 end-to-end — the oracle has no overflow
+discipline to manage, which is exactly why it is the truth the int32
+device carry is tested against.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from spark_bam_tpu.agg.plan import FLAG_BITS, AggConfig
+
+
+def host_aggregate(
+    columns: "dict[str, np.ndarray]", plan: AggConfig, nc: int,
+) -> "dict[str, np.ndarray]":
+    """Reduce parser flat planes (numpy arrays, ``valid`` already
+    narrowed by any filter) to the plan's int64 vectors. ``columns``
+    needs ``valid`` plus whichever planes the plan's metrics read
+    (``flag``, ``mapq``, ``tlen``, ``l_seq``, ``pos``, ``ref_span``,
+    ``ref_id``)."""
+    valid = np.asarray(columns["valid"], dtype=bool)
+    out: "dict[str, np.ndarray]" = {}
+    for spec in plan.specs:
+        if spec.name == "count":
+            flag = np.asarray(columns["flag"], dtype=np.int64)[valid]
+            lseq = np.asarray(columns["l_seq"], dtype=np.int64)[valid]
+            out["count"] = np.array(
+                [valid.sum(), int((flag & 4 == 0).sum()), int(lseq.sum())],
+                dtype=np.int64,
+            )
+        elif spec.name == "flagstat":
+            flag = np.asarray(columns["flag"], dtype=np.int64)[valid]
+            vec = np.zeros(1 + len(FLAG_BITS), dtype=np.int64)
+            vec[0] = len(flag)
+            for i, bit in enumerate(FLAG_BITS):
+                vec[1 + i] = int((flag & bit != 0).sum())
+            out["flagstat"] = vec
+        elif spec.name == "mapq":
+            mapq = np.asarray(columns["mapq"], dtype=np.int64)[valid]
+            out["mapq"] = np.bincount(
+                np.clip(mapq, 0, 255), minlength=256
+            ).astype(np.int64)
+        elif spec.name == "tlen":
+            mx = spec.get("max")
+            tlen = np.abs(np.asarray(columns["tlen"], dtype=np.int64)[valid])
+            out["tlen"] = np.bincount(
+                np.minimum(tlen, mx + 1), minlength=mx + 2
+            ).astype(np.int64)
+        elif spec.name == "coverage":
+            out["coverage"] = _host_coverage(columns, spec, nc, valid)
+    return out
+
+
+def _host_coverage(columns, spec, nc: int, valid) -> np.ndarray:
+    """Per-contig binned base depth — the oracle's per-record bucket
+    walk, applying the wire contract's clamps (last-bucket collapse,
+    ``cap``-bucket truncation) literally."""
+    B, bins, cap = spec.get("bin"), spec.get("bins"), spec.get("cap")
+    ref = np.asarray(columns["ref_id"], dtype=np.int64)
+    pos = np.asarray(columns["pos"], dtype=np.int64)
+    span = np.maximum(np.asarray(columns["ref_span"], dtype=np.int64), 1)
+    flag = np.asarray(columns["flag"], dtype=np.int64)
+    use = valid & (flag & 4 == 0) & (ref >= 0) & (ref < nc) & (pos >= 0)
+    cov = np.zeros((nc, bins), dtype=np.int64)
+    for i in np.flatnonzero(use):
+        s = int(pos[i])
+        e = s + int(span[i])
+        sb = min(s // B, bins - 1)
+        eb = min(min((e - 1) // B, bins - 1), sb + cap - 1)
+        for k in range(sb, eb + 1):
+            lo = max(s, k * B)
+            hi = e if k == bins - 1 else min(e, (k + 1) * B)
+            if hi > lo:
+                cov[int(ref[i]), k] += hi - lo
+    return cov.reshape(-1)
+
+
+#: CIGAR op codes that consume reference bases: M, D, N, =, X — the
+#: same set the device parser folds into ``ref_span`` (tpu/parser.py).
+_REF_CONSUMING = {0, 2, 3, 7, 8}
+
+
+def record_ref_span(rec) -> int:
+    """Reference span of one ``BamRecord`` — Σ CIGAR lengths over the
+    ref-consuming ops, matching the parser's ``ref_span`` plane."""
+    return sum(n for n, op in (rec.cigar or []) if op in _REF_CONSUMING)
+
+
+def columns_from_records(records) -> "dict[str, np.ndarray]":
+    """Flat-plane columns for an iterable of ``BamRecord`` — the bridge
+    that lets the CRAM/SAM record loaders (and ``Dataset.aggregate``)
+    feed the same reductions as the BAM flat-plane path. Items may be
+    bare records or tuples whose last element is one (the ``(Pos, rec)``
+    load shapes)."""
+    flag, mapq, tlen, lseq, pos, span, ref = [], [], [], [], [], [], []
+    for rec in records:
+        if isinstance(rec, tuple):          # the (Pos, record) load shapes
+            rec = rec[-1]
+        flag.append(int(rec.flag))
+        mapq.append(int(rec.mapq))
+        tlen.append(int(rec.tlen))
+        lseq.append(len(rec.seq) if rec.seq and rec.seq != "*" else 0)
+        pos.append(int(rec.pos))
+        span.append(record_ref_span(rec))
+        ref.append(int(rec.ref_id))
+    n = len(flag)
+    return {
+        "valid": np.ones(n, dtype=bool),
+        "flag": np.asarray(flag, dtype=np.int32),
+        "mapq": np.asarray(mapq, dtype=np.int32),
+        "tlen": np.asarray(tlen, dtype=np.int32),
+        "l_seq": np.asarray(lseq, dtype=np.int32),
+        "pos": np.asarray(pos, dtype=np.int32),
+        "ref_span": np.asarray(span, dtype=np.int32),
+        "ref_id": np.asarray(ref, dtype=np.int32),
+    }
+
+
+def combine(
+    parts: "list[dict[str, np.ndarray]]", plan: AggConfig, nc: int,
+) -> "dict[str, np.ndarray]":
+    """Sum per-partition partial vectors — every metric is a pure sum,
+    so partition order doesn't matter (the RDD-accumulator property the
+    reference's benchmark harvesting relied on)."""
+    out = {
+        spec.name: np.zeros(spec.length(nc), dtype=np.int64)
+        for spec in plan.specs
+    }
+    for part in parts:
+        if part is None:
+            continue                          # quarantined partition
+        for name, vec in part.items():
+            out[name] += np.asarray(vec, dtype=np.int64).ravel()
+    return out

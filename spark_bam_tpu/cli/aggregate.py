@@ -1,0 +1,123 @@
+"""``spark-bam-tpu aggregate``: on-device aggregate statistics
+(docs/analytics.md "Aggregation").
+
+    spark-bam-tpu aggregate [-a SPEC] [-i LOCI] [--flags-required N]
+                            [--flags-forbidden N] [-t TG]...
+                            [--format tsv|json] [-F FASTA] PATH
+
+One ``metric<TAB>key<TAB>value`` line per populated bucket (tsv, the
+default), or the whole result as one JSON object. The reduction runs on
+device over the parsed planes (agg/kernels.py) for BAM and through the
+partition executor's numpy oracle for CRAM/SAM — identical numbers
+either way.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+from spark_bam_tpu.core.config import Config
+
+#: SAM flag bit → flagstat row label, in wire order (agg/plan.py).
+_FLAG_LABELS = (
+    "paired", "proper_pair", "unmapped", "mate_unmapped", "reverse",
+    "mate_reverse", "read1", "read2", "secondary", "qc_fail", "dup",
+    "supplementary",
+)
+
+
+def _tsv_lines(result: dict):
+    """Flatten a ``load.api.aggregate`` result into tsv rows — only
+    populated buckets print, so a WGS coverage vector stays readable."""
+    contigs = result["contigs"]
+    for name, vec in result["metrics"].items():
+        if name == "count":
+            for label, v in zip(("records", "mapped", "bases"), vec):
+                yield f"count\t{label}\t{int(v)}"
+        elif name == "flagstat":
+            yield f"flagstat\ttotal\t{int(vec[0])}"
+            for label, v in zip(_FLAG_LABELS, vec[1:]):
+                yield f"flagstat\t{label}\t{int(v)}"
+        elif name in ("mapq", "tlen"):
+            top = len(vec) - 1
+            for i, v in enumerate(vec):
+                if v:
+                    key = (
+                        f">{top - 1}" if name == "tlen" and i == top
+                        else str(i)
+                    )
+                    yield f"{name}\t{key}\t{int(v)}"
+        elif name == "coverage":
+            nc = len(contigs) or 1
+            bins = len(vec) // nc
+            grid = vec.reshape(nc, bins)
+            # Bucket width comes from the canonical spec the result
+            # carries (agg/plan.py defaults when unstated).
+            params = {}
+            spec = _coverage_spec(result)
+            if ":" in spec:
+                for kv in spec.split(":", 1)[1].split(","):
+                    key, _, value = kv.partition("=")
+                    if value:
+                        params[key] = int(value)
+            width = params.get("bin", 1000)
+            for (cname, clen), row in zip(contigs, grid):
+                for k, v in enumerate(row):
+                    if v:
+                        lo = k * width
+                        hi = clen if k == bins - 1 else min((k + 1) * width, clen)
+                        yield f"coverage\t{cname}:{lo}-{hi}\t{int(v)}"
+        else:
+            for i, v in enumerate(vec):
+                if v:
+                    yield f"{name}\t{i}\t{int(v)}"
+
+
+def _coverage_spec(result: dict) -> str:
+    for part in result["agg"].split(";"):
+        if part.split(":", 1)[0] == "coverage":
+            return part
+    return "coverage"
+
+
+def run(
+    path,
+    p,
+    config: Config,
+    agg=None,
+    loci=None,
+    flags_required: int = 0,
+    flags_forbidden: int = 0,
+    tags_required=(),
+    fmt: str = "tsv",
+    reference=None,
+) -> None:
+    from spark_bam_tpu.load.api import aggregate
+
+    t0 = time.monotonic()
+    result = aggregate(
+        path, agg=agg or "", loci=loci, flags_required=flags_required,
+        flags_forbidden=flags_forbidden, tags_required=tags_required,
+        config=config, reference=reference,
+    )
+    seconds = time.monotonic() - t0
+    if fmt == "json":
+        p.echo(json.dumps({
+            "agg": result["agg"],
+            "rows": result["rows"],
+            "contigs": [[n, int(ln)] for n, ln in result["contigs"]],
+            "metrics": {
+                k: [int(x) for x in v] for k, v in result["metrics"].items()
+            },
+        }, sort_keys=True))
+    else:
+        for line in _tsv_lines(result):
+            p.echo(line)
+    import sys
+
+    print(
+        f"aggregated {result['rows']} rows [{result['agg']}] "
+        f"in {seconds:.2f}s",
+        file=sys.stderr,
+    )

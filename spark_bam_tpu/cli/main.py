@@ -281,6 +281,46 @@ def build_parser() -> argparse.ArgumentParser:
                      help="output file path")
     sub.add_argument("path")
 
+    # On-device aggregation: reduce a query to kilobytes of statistics
+    # without materializing records (docs/analytics.md "Aggregation").
+    sub = sp.add_parser("aggregate")
+    _add_metrics(sub)
+    _add_faults(sub)
+    _add_cache(sub)
+    _add_limits(sub)
+    _add_remote(sub)
+    sub.add_argument("-m", "--max-split-size", default=None,
+                     help="split size (byte shorthand like 2MB ok)")
+    sub.add_argument(
+        "-a", "--agg", default=None, metavar="SPEC",
+        help="';'-separated metric[:k=v,...] spec — count, flagstat, "
+             "mapq, tlen[:max=N], coverage[:bin=N,bins=N,cap=N] "
+             "(default: every metric at defaults, or SPARK_BAM_AGG)",
+    )
+    sub.add_argument(
+        "-i", "--intervals", default=None, metavar="LOCI",
+        help="genomic loci to restrict to, e.g. 'chr1:5k-10k,chr2' "
+             "(decimal k/m suffixes; whole contig when no range)",
+    )
+    sub.add_argument("--flags-required", type=int, default=0,
+                     help="only records with ALL these SAM flag bits")
+    sub.add_argument("--flags-forbidden", type=int, default=0,
+                     help="only records with NONE of these SAM flag bits")
+    sub.add_argument(
+        "-t", "--tag", action="append", default=None, metavar="TG",
+        help="only records carrying this two-char tag (repeatable; "
+             "all must be present)",
+    )
+    sub.add_argument("--format", default="tsv", choices=("tsv", "json"),
+                     help="report format (default tsv)")
+    sub.add_argument("-F", "--reference", default=None,
+                     help="FASTA for reference-based (RR=true) CRAM decode")
+    sub.add_argument("-w", "--warn", action="store_true",
+                     help="root log level WARN")
+    sub.add_argument("-o", "--out", default=None,
+                     help="write the report here instead of stdout")
+    sub.add_argument("path")
+
     sub = sp.add_parser("index-blocks")
     _add_metrics(sub)
     sub.add_argument("-o", "--out", default=None)
@@ -786,6 +826,33 @@ def main(argv=None) -> int:
             export_cmd.run(
                 args.path, p, config, args.export_out, fmt=args.format,
                 loci=loci, columns=args.columns, reference=args.reference,
+            )
+        elif cmd == "aggregate":
+            from spark_bam_tpu.agg.plan import AggConfig
+            from spark_bam_tpu.cli import aggregate as aggregate_cmd
+            from spark_bam_tpu.load.intervals import BadLociError, LociSet
+
+            loci = getattr(args, "intervals", None)
+            if loci:
+                try:
+                    LociSet.parse(loci)  # fail before any work starts
+                except BadLociError as e:
+                    raise UsageError(str(e)) from e
+            try:
+                AggConfig.parse(args.agg or config.agg)
+                for t in args.tag or ():
+                    if len(t) != 2:
+                        raise ValueError(
+                            f"tag names are exactly two chars: {t!r}"
+                        )
+            except ValueError as e:
+                raise UsageError(str(e)) from e
+            aggregate_cmd.run(
+                args.path, p, config, agg=args.agg, loci=loci,
+                flags_required=args.flags_required,
+                flags_forbidden=args.flags_forbidden,
+                tags_required=tuple(args.tag or ()),
+                fmt=args.format, reference=args.reference,
             )
         elif cmd == "index-blocks":
             from spark_bam_tpu.bgzf.index_blocks import index_blocks

@@ -18,10 +18,14 @@ whitespace) holding ``schema_version``/``columns``/``codec``/``level``/
 ``contigs`` — nothing run-specific (no paths, no timestamps), so the
 same query produces the same bytes whether the producer is the file
 sink or the serve daemon. A batch frame holds ``rows u32, ncols u16``
-then per column (schema order) a kind byte (0 fixed / 1 var) and its
-buffer(s); each buffer is ``raw_len u64, enc_len u64, bytes`` where
-``enc_len == raw_len`` means stored raw (codec "none") and anything
-else is zlib. The end frame carries ``total_rows u64, n_batches u32``
+then per column (schema order) a kind byte (0 fixed / 1 var / 2
+dictionary) and its buffer(s); each buffer is ``raw_len u64, enc_len
+u64, bytes`` where ``enc_len == raw_len`` means stored raw (codec
+"none") and anything else is zlib. Kind 2 (emitted for ``name``/
+``cigar`` only when it is strictly smaller than kind 1) holds int32
+per-row codes plus the dictionary's own offsets/values buffers, the
+dictionary in first-occurrence order so the bytes stay a pure function
+of the row stream. The end frame carries ``total_rows u64, n_batches u32``
 so a reader detects truncation in O(1), like ``_Reader.count``.
 """
 
@@ -102,21 +106,68 @@ def container_head(meta: dict) -> bytes:
     return _HEAD.pack(MAGIC, VERSION, 0) + _frame(TAG_SCHEMA, payload)
 
 
+#: Var columns worth a dictionary pass: read names repeat their flowcell
+#: prefix and CIGARs collapse to a handful of shapes, while seq/qual are
+#: near-unique per row (the dict would only add bytes there).
+_DICT_COLUMNS = frozenset({"name", "cigar"})
+
+
+def _var_parts(col: VarColumn, codec: str, level: int) -> "list[bytes]":
+    return [
+        b"\x01",
+        _encode_buffer(
+            np.ascontiguousarray(col.offsets, dtype=np.int64).tobytes(),
+            codec, level,
+        ),
+        _encode_buffer(
+            np.ascontiguousarray(col.values, dtype=np.uint8).tobytes(),
+            codec, level,
+        ),
+    ]
+
+
+def _dict_parts(col: VarColumn, codec: str, level: int) -> "list[bytes]":
+    """Kind-2 encoding: per-row int32 codes into a first-occurrence-order
+    dictionary (deterministic — a pure function of the row stream, so
+    the same query still produces the same bytes)."""
+    offsets = np.ascontiguousarray(col.offsets, dtype=np.int64)
+    values = np.ascontiguousarray(col.values, dtype=np.uint8)
+    rows = len(offsets) - 1
+    codes = np.empty(rows, dtype=np.int32)
+    index: "dict[bytes, int]" = {}
+    entries: "list[bytes]" = []
+    for i in range(rows):
+        s = values[offsets[i]: offsets[i + 1]].tobytes()
+        code = index.get(s)
+        if code is None:
+            code = len(entries)
+            index[s] = code
+            entries.append(s)
+        codes[i] = code
+    d_off = np.zeros(len(entries) + 1, dtype=np.int64)
+    np.cumsum([len(e) for e in entries], out=d_off[1:])
+    return [
+        b"\x02",
+        _encode_buffer(codes.tobytes(), codec, level),
+        _encode_buffer(d_off.tobytes(), codec, level),
+        _encode_buffer(b"".join(entries), codec, level),
+    ]
+
+
 def batch_frame(batch: RecordBatch, meta: dict) -> bytes:
     codec, level = meta["codec"], meta["level"]
     parts = [_BATCH.pack(batch.num_rows, len(meta["columns"]))]
     for name in meta["columns"]:
         col = batch.columns[name]
         if isinstance(col, VarColumn):
-            parts.append(b"\x01")
-            parts.append(_encode_buffer(
-                np.ascontiguousarray(col.offsets, dtype=np.int64).tobytes(),
-                codec, level,
-            ))
-            parts.append(_encode_buffer(
-                np.ascontiguousarray(col.values, dtype=np.uint8).tobytes(),
-                codec, level,
-            ))
+            var = _var_parts(col, codec, level)
+            if name in _DICT_COLUMNS:
+                # Keep-only-when-smaller: the dict section pays off only
+                # when the column actually repeats.
+                dct = _dict_parts(col, codec, level)
+                if sum(map(len, dct)) < sum(map(len, var)):
+                    var = dct
+            parts.extend(var)
         else:
             parts.append(b"\x00")
             parts.append(_encode_buffer(
@@ -187,6 +238,46 @@ def _decode_batch(payload: memoryview, columns) -> RecordBatch:
                     f"column {name!r}: offsets inconsistent with "
                     f"{len(values)} value bytes"
                 )
+            cols[name] = VarColumn(offsets, values)
+        elif kind[0] == 2:
+            # Dictionary section (name/cigar): int32 codes + the dict's
+            # own offsets/values. Reconstructs the full VarColumn so
+            # consumers never see the encoding.
+            raw_codes, p = _decode_buffer(payload, p)
+            raw_off, p = _decode_buffer(payload, p)
+            raw_val, p = _decode_buffer(payload, p)
+            codes = np.frombuffer(raw_codes, dtype=np.int32)
+            d_off = np.frombuffer(raw_off, dtype=np.int64)
+            d_val = np.frombuffer(raw_val, dtype=np.uint8)
+            if len(codes) != rows:
+                raise ColumnarFormatError(
+                    f"column {name!r}: {len(codes)} codes for {rows} rows"
+                )
+            ndict = len(d_off) - 1
+            if ndict < 0 or (len(d_off) and (
+                    int(d_off[0]) != 0
+                    or (ndict and int(d_off[-1]) != len(d_val))
+                    or (np.diff(d_off) < 0).any())):
+                raise ColumnarFormatError(
+                    f"column {name!r}: dictionary offsets inconsistent "
+                    f"with {len(d_val)} value bytes"
+                )
+            if rows and (ndict == 0 or codes.min() < 0
+                         or codes.max() >= ndict):
+                raise ColumnarFormatError(
+                    f"column {name!r}: code out of range for "
+                    f"{ndict}-entry dictionary"
+                )
+            lens = np.diff(d_off)
+            row_lens = lens[codes] if rows else np.zeros(0, dtype=np.int64)
+            offsets = np.zeros(rows + 1, dtype=np.int64)
+            np.cumsum(row_lens, out=offsets[1:])
+            values = (
+                np.concatenate([
+                    d_val[d_off[c]: d_off[c + 1]] for c in codes
+                ]) if rows and int(offsets[-1])
+                else np.zeros(0, dtype=np.uint8)
+            )
             cols[name] = VarColumn(offsets, values)
         else:
             raise ColumnarFormatError(

@@ -163,6 +163,14 @@ class Config:
     # health probe/eject pacing, and the SLO autoscaler's target and
     # actuation floors/ceilings.
     fabric: str = ""
+    # --- on-device aggregation plane (agg/; docs/analytics.md) ---
+    # Compact AggConfig spec ("coverage:bin=1000,bins=512;flagstat;mapq;
+    # tlen:max=2000;count"; "" = every metric at defaults). Same
+    # string-spec pattern; ``agg_config`` parses it (cached). Governs
+    # the default metric plan behind the serve ``aggregate`` op, the
+    # ``aggregate`` CLI subcommand and ``load.api.aggregate``; requests
+    # may override it per call.
+    agg: str = ""
     # --- SLO objectives + burn-rate alerting (obs/slo.py) ---
     # Compact SloConfig spec ("serve.latency:p99<1500ms@5m;
     # serve.errors:ratio<0.1%@1h;sample=0.1"; "" = disabled). Same
@@ -270,6 +278,13 @@ class Config:
         from spark_bam_tpu.fabric.config import FabricConfig
 
         return FabricConfig.parse(self.fabric)
+
+    @property
+    def agg_config(self):
+        """The parsed ``AggConfig`` for this config's ``agg`` spec."""
+        from spark_bam_tpu.agg.plan import AggConfig
+
+        return AggConfig.parse(self.agg)
 
     @property
     def slo_config(self):

@@ -71,7 +71,7 @@ from spark_bam_tpu.serve.server import MAX_LINE, ServeAddress
 #: whose answers are deterministic for unchanged files, plus ``rewrite``
 #: (its output commit is atomic — a re-run overwrites, never interleaves).
 IDEMPOTENT_OPS = frozenset(
-    {"plan", "record_starts", "count", "batch", "rewrite"}
+    {"plan", "record_starts", "count", "batch", "aggregate", "rewrite"}
 )
 
 
@@ -488,7 +488,7 @@ class Router:
                 f"{CLASS_OF.get(op, op)}-class work",
                 retry_after_ms=round(self._shed_hint_ms(), 3),
             )
-        if op == "batch" and self.fcfg.stream:
+        if op in ("batch", "aggregate") and self.fcfg.stream:
             return await self._stream_route(req, ctx)
         idempotent = op in IDEMPOTENT_OPS
         shed_resp = None
@@ -660,7 +660,8 @@ class Router:
                     except (ConnectionError, OSError,
                             asyncio.IncompleteReadError) as exc:
                         flight.record(
-                            "stream_lost", worker=cur_wid, op="batch",
+                            "stream_lost", worker=cur_wid,
+                            op=req.get("op", "batch"),
                             delivered=delivered, total=total,
                             error=str(exc),
                         )

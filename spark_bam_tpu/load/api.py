@@ -781,6 +781,127 @@ def export(
     )
 
 
+def aggregate(
+    path,
+    agg: str = "",
+    loci: "LociSet | str | None" = None,
+    flags_required: int = 0,
+    flags_forbidden: int = 0,
+    tags_required=(),
+    split_size=None,
+    config: Config = Config(),
+    parallel: ParallelConfig = ParallelConfig(),
+    reference=None,
+    chunk: "int | None" = None,
+) -> dict:
+    """Aggregate statistics for a query, without materializing records
+    (docs/analytics.md "Aggregation"). ``agg`` is the compact
+    :class:`~spark_bam_tpu.agg.plan.AggConfig` spec (``""`` = every
+    metric at defaults, or ``config.agg``); predicates mirror
+    ``export``'s (``loci`` intervals, flag masks, plus tag presence).
+
+    BAM files reduce on device: flat view → parsed planes →
+    ``_apply_filter`` pushdown → the fused jit carry
+    (agg/kernels.py). CRAM/SAM fall back to the record path — the
+    fault-tolerant partition executor runs the numpy oracle per
+    partition and merges (``Dataset.aggregate``). Both paths return the
+    identical structure: ``{"agg", "rows", "contigs", "metrics"}`` with
+    int64 vectors byte-equal across paths for the same query.
+    """
+    from spark_bam_tpu.agg.plan import AggConfig
+    from spark_bam_tpu.bam.record import render_tags
+
+    plan = AggConfig.parse(agg or config.agg)
+    tags_required = tuple(tags_required or ())
+    for t in tags_required:
+        if not isinstance(t, str) or len(t) != 2:
+            raise ValueError(f"tag names are exactly two chars: {t!r}")
+    s = str(path)
+    if s.endswith(".bam"):
+        import numpy as np
+
+        from spark_bam_tpu.agg.kernels import aggregate_planes
+        from spark_bam_tpu.bgzf.flat import flatten_file
+        from spark_bam_tpu.load.tpu_load import _apply_filter, record_starts
+        from spark_bam_tpu.tpu.parser import ReadBatch, parse_flat_records
+
+        header = with_retries(
+            lambda: read_header(path), config.fault_policy, "read_header"
+        )
+        contig_lengths = header.contig_lengths
+        nc = len(contig_lengths.lengths_list())
+        flat = flatten_file(path)
+        starts = np.asarray(record_starts(path, config).starts, dtype=np.int64)
+        batch = parse_flat_records(flat.data, starts)
+        if loci or flags_required or flags_forbidden or tags_required:
+            _apply_filter(
+                batch, header, loci, flags_required, flags_forbidden,
+                tags_required=tags_required,
+            )
+        rows = int(np.count_nonzero(batch.columns["valid"]))
+        with obs.span("agg.reduce", path=s, rows=rows):
+            metrics = aggregate_planes(batch.columns, plan, nc, chunk=chunk)
+    else:
+        if s.endswith(".cram"):
+            from spark_bam_tpu.cram import CramReader
+
+            with CramReader(path) as r:
+                contig_lengths = r.bam_header.contig_lengths
+            ds = (
+                load_cram_intervals(path, loci, split_size, config, parallel,
+                                    reference=reference)
+                if loci
+                else load_cram(path, split_size, config, parallel,
+                               reference=reference)
+            )
+        elif s.endswith(".sam"):
+            contig_lengths = _scan_sam_header(path)
+            ds = (
+                _load_sam_intervals(path, loci, split_size, config, parallel)
+                if loci
+                else load_sam(path, split_size, config, parallel)
+            )
+        else:
+            raise ValueError(f"Can't tell format of path: {s}")
+        if flags_required or flags_forbidden:
+            ds = ds.filter(
+                lambda rec: (rec.flag & flags_required) == flags_required
+                and (rec.flag & flags_forbidden) == 0
+            )
+        if tags_required:
+            # Presence via the total tag renderer: malformed tag blocks
+            # render what they can, so a damaged entry reads as absent —
+            # the same stop-clean semantics as the plane scan.
+            prefixes = tuple(t + ":" for t in tags_required)
+
+            def _has_tags(rec) -> bool:
+                rendered = render_tags(rec.tags)
+                return all(
+                    any(r.startswith(p) for r in rendered) for p in prefixes
+                )
+
+            ds = ds.filter(_has_tags)
+        nc = len(contig_lengths.lengths_list())
+        metrics = ds.aggregate(plan, nc)
+        # count[0] / flagstat[0] are both "records seen" — reuse either
+        # rather than re-running the dataset for a side count.
+        if "count" in metrics:
+            rows = int(metrics["count"][0])
+        elif "flagstat" in metrics:
+            rows = int(metrics["flagstat"][0])
+        else:
+            rows = None
+    contigs = [
+        (name, length) for _, (name, length) in sorted(contig_lengths.items())
+    ]
+    return {
+        "agg": plan.canonical(),
+        "rows": rows,
+        "contigs": contigs,
+        "metrics": metrics,
+    }
+
+
 # --------------------------------------------------------------- intervals
 def interval_chunks(
     path, loci: LociSet, header: BamHeader, config: Config = Config()

@@ -91,6 +91,30 @@ class Dataset(Generic[P, T]):
 
         return self._execute(first)
 
+    def aggregate(self, plan, nc: int) -> dict:
+        """Aggregate this dataset's records into ``plan``'s int64 metric
+        vectors (agg/plan.py): each partition reduces through the numpy
+        oracle (agg/host.py) and the partials merge with ``combine`` —
+        the record-path twin of the device plane, byte-equal for the
+        same records. Quarantined partitions contribute nothing in
+        tolerant mode (their loss shows in ``last_report``)."""
+        from spark_bam_tpu.agg.host import (
+            columns_from_records,
+            combine,
+            host_aggregate,
+        )
+        from spark_bam_tpu.agg.plan import AggConfig
+
+        if not isinstance(plan, AggConfig):
+            plan = AggConfig.parse(plan)
+        with obs.span("agg.reduce", partitions=len(self.partitions)):
+            parts = self._execute(
+                lambda p: host_aggregate(
+                    columns_from_records(list(self.compute(p))), plan, nc
+                )
+            )
+        return combine(parts, plan, nc)
+
     def to_batches(self, batch_rows: int = 8192, columns=None):
         """Lazy columnar record batches of this dataset's records
         (docs/analytics.md). Items may be bare ``BamRecord``s or tuples

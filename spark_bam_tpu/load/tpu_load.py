@@ -148,18 +148,105 @@ def _interval_table(header, loci: LociSet | str) -> np.ndarray:
     return np.array(rows or [(-2, 0, 0)], dtype=np.int32)
 
 
+#: tag value-type byte → fixed payload size; Z/H are NUL-terminated and
+#: B is typed-array-counted — both handled inline by the scan.
+_TAG_SIZES = {
+    ord("A"): 1, ord("c"): 1, ord("C"): 1,
+    ord("s"): 2, ord("S"): 2,
+    ord("i"): 4, ord("I"): 4, ord("f"): 4,
+}
+
+
+def _tag_presence_mask(batch: ReadBatch, tags_required) -> np.ndarray:
+    """Per-row mask: does the record's tag region contain every tag in
+    ``tags_required`` (two-character names, e.g. ``("NM", "MD")``)?
+
+    Guard-boundary-clean by construction (core/guard.py discipline for
+    untrusted bytes, without the raise half): every offset is clamped to
+    the buffer, the walk is bounded by the record's declared extent, and
+    a malformed entry (unknown type byte, truncated payload, unbounded
+    B-array count) STOPS the walk — the remaining tags read as absent,
+    never as an exception or an over-extent read. No struct unpacks, no
+    unbounded loops.
+    """
+    cols = batch.columns
+    buf = batch.buf
+    wanted = [t.encode("latin-1") for t in tags_required]
+    mask = np.zeros(len(cols["valid"]), dtype=bool)
+    if buf is None:
+        raise ValueError(
+            "tag filter needs the flat record buffer (batch.buf)"
+        )
+    nbuf = len(buf)
+    starts = batch.starts
+    name_off = cols["name_offset"]
+    l_name = cols["l_read_name"]
+    n_cigar = cols["n_cigar"]
+    l_seq = cols["l_seq"]
+    block_size = cols["block_size"]
+    for i in np.flatnonzero(cols["valid"]):
+        ls = int(l_seq[i])
+        p = (int(name_off[i]) + int(l_name[i]) + 4 * int(n_cigar[i])
+             + (ls + 1) // 2 + ls)
+        end = int(starts[i]) + 4 + int(block_size[i])
+        end = max(0, min(end, nbuf))
+        p = max(0, min(p, end))
+        present = set()
+        while p + 3 <= end:
+            tag = bytes(buf[p: p + 2])
+            typ = int(buf[p + 2])
+            p += 3
+            if typ in _TAG_SIZES:
+                q = p + _TAG_SIZES[typ]
+            elif typ in (ord("Z"), ord("H")):
+                nuls = np.flatnonzero(buf[p:end] == 0)
+                if len(nuls) == 0:
+                    break                     # unterminated: stop clean
+                q = p + int(nuls[0]) + 1
+            elif typ == ord("B"):
+                if p + 5 > end:
+                    break
+                elem = _TAG_SIZES.get(int(buf[p]))
+                count = (int(buf[p + 1]) | (int(buf[p + 2]) << 8)
+                         | (int(buf[p + 3]) << 16) | (int(buf[p + 4]) << 24))
+                if elem is None or count < 0 or count > end - p:
+                    break                     # malformed: stop clean
+                q = p + 5 + elem * count
+            else:
+                break                         # unknown type byte: stop clean
+            if q > end:
+                break                         # truncated payload: stop clean
+            present.add(tag)
+            p = q
+        mask[i] = all(t in present for t in wanted)
+    return mask
+
+
 def _apply_filter(
     batch: ReadBatch,
     header,
     loci: LociSet | str | None,
     flags_required: int,
     flags_forbidden: int,
+    tags_required=None,
 ) -> ReadBatch:
-    """Narrow a batch's ``valid`` mask by loci/flags (shared by the whole-
-    file and streaming loads). Flag-only filtering is a pure flag predicate
-    — unmapped reads pass unless a flag excludes them; only a loci filter
-    imposes the reference's unmapped-reads-never-overlap rule
-    (CanLoadBam.scala:109-133)."""
+    """Narrow a batch's ``valid`` mask by loci/flags/tag-presence (the
+    pushdown shared by the whole-file and streaming loads and the serve
+    ``batch``/``aggregate`` ops). Flag-only filtering is a pure flag
+    predicate — unmapped reads pass unless a flag excludes them; only a
+    loci filter imposes the reference's unmapped-reads-never-overlap
+    rule (CanLoadBam.scala:109-133). ``tags_required`` is an iterable of
+    two-character tag names that must ALL be present in a record's tag
+    region (e.g. ``("NM",)``)."""
+    if tags_required:
+        for t in tags_required:
+            if not isinstance(t, str) or len(t) != 2:
+                raise ValueError(
+                    f"Bad tag name {t!r}: expected two characters (e.g. 'NM')"
+                )
+        batch.columns["valid"] = (
+            batch.columns["valid"] & _tag_presence_mask(batch, tags_required)
+        )
     if loci is None:
         flag = batch.columns["flag"]
         ok = ((flag & flags_required) == flags_required) & (

@@ -22,15 +22,18 @@ from __future__ import annotations
 #: Layer prefixes (the segment before the first dot). A new layer means
 #: a new subsystem — add it here alongside its names.
 LAYERS = frozenset({
-    "account", "bgzf", "cache", "chaos", "check", "cli", "columnar",
-    "compress", "deflate", "fabric", "faults", "funnel", "guard",
-    "inflate", "load", "mesh", "progress", "remote", "sampler", "serve",
-    "slo", "timer", "ts",
+    "account", "agg", "bgzf", "cache", "chaos", "check", "cli",
+    "columnar", "compress", "deflate", "fabric", "faults", "funnel",
+    "guard", "inflate", "load", "mesh", "progress", "remote", "sampler",
+    "serve", "slo", "timer", "ts",
 })
 
 NAMES = frozenset({
     # account — per-request cost accounting (obs/account.py)
     "account.requests", "account.tenants",
+    # agg — fused on-device aggregation plane (docs/analytics.md)
+    "agg.bytes_out", "agg.encode", "agg.host_fallbacks", "agg.reduce",
+    "agg.requests", "agg.rows",
     # bgzf — block streaming (docs/design.md)
     "bgzf.blocks_read", "bgzf.blocks_scanned", "bgzf.bytes_inflated",
     "bgzf.bytes_read", "bgzf.read",
@@ -46,8 +49,9 @@ NAMES = frozenset({
     "check.escaped", "check.find_record_start", "check.positions",
     "check.window", "check.windows",
     # cli — root spans, one per subcommand (cli/main.py)
-    "cli.check-bam", "cli.check-blocks", "cli.compare-splits",
-    "cli.compute-splits", "cli.count-reads", "cli.export", "cli.fabric",
+    "cli.aggregate", "cli.check-bam", "cli.check-blocks",
+    "cli.compare-splits", "cli.compute-splits", "cli.count-reads",
+    "cli.export", "cli.fabric",
     "cli.full-check", "cli.fuzz-decode", "cli.htsjdk-rewrite",
     "cli.index", "cli.index-bam", "cli.index-blocks", "cli.index-records",
     "cli.lint", "cli.metrics-report", "cli.rewrite", "cli.serve",

@@ -140,6 +140,20 @@ class MeshSteps:
             ),
         )
 
+    def agg_step(self, plan, nc: int):
+        """Sharded aggregate-reduction carry step (agg/kernels.py) for
+        one (plan, contig-count) shape — the serve ``aggregate`` op's
+        compiled-once tick. The plan is a frozen ``AggConfig`` and so
+        hashes into the registry key like any other static param."""
+        from spark_bam_tpu.agg.kernels import make_shard_map_agg_step
+
+        return self._get(
+            ("agg", plan, nc),
+            lambda: make_shard_map_agg_step(
+                self.mesh, plan, nc, axis=self.axis
+            ),
+        )
+
 
 _mesh_steps: dict = {}
 _mesh_steps_lock = threading.Lock()

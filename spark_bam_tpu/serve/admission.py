@@ -21,6 +21,7 @@ CLASS_OF = {
     "count": "scan",
     "fleet": "scan",
     "batch": "scan",
+    "aggregate": "scan",
     "rewrite": "scan",
 }
 

@@ -13,8 +13,9 @@ latency, not failure, exactly like the partition executor's transient
 handling (docs/serving.md "Backpressure"). Pass ``policy=None`` to
 fail fast instead.
 
-``batch`` requests additionally survive MID-STREAM connection loss: the
-client keeps every frame it has already read, reconnects, and re-issues
+``batch`` and ``aggregate`` requests additionally survive MID-STREAM
+connection loss: the client keeps every frame it has already read,
+reconnects, and re-issues
 the request with ``resume_from=<frames held>`` — the frame-sequence
 resume token of docs/robustness.md. Against a streaming fabric router
 the replacement worker serves only the missing tail; the reassembled
@@ -81,7 +82,7 @@ class ServeClient:
 
     def request(self, op: str, **fields) -> dict:
         """Send one request and block for its response payload. Responses
-        announcing ``binary_frames`` (the ``batch`` op) have that many
+        announcing ``binary_frames`` (``batch``/``aggregate``) have that many
         u64-length-prefixed frames read off the socket and attached as a
         list of bytes under ``"_binary"`` — concatenated they are a
         native columnar container (columnar/native.py). ``Overloaded``
@@ -91,7 +92,9 @@ class ServeClient:
         retries = self.policy.max_retries if self.policy is not None else 0
         # Frames survive across resume attempts: a mid-stream loss keeps
         # what arrived and asks only for the tail.
-        progress: "list[bytes]" = [] if op == "batch" else None
+        progress: "list[bytes]" = (
+            [] if op in ("batch", "aggregate") else None
+        )
         for attempt in range(retries + 1):
             try:
                 return self._request_once(op, fields, progress=progress)

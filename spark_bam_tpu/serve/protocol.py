@@ -58,7 +58,7 @@ import json
 
 #: ops answered by the service; anything else is a ProtocolError.
 OPS = ("ping", "stats", "plan", "record_starts", "count", "fleet", "batch",
-       "rewrite", "drain", "tune", "telemetry", "alerts")
+       "aggregate", "rewrite", "drain", "tune", "telemetry", "alerts")
 
 
 class ProtocolError(ValueError):

@@ -73,6 +73,22 @@ class ServiceError(Exception):
         super().__init__(message)
 
 
+def _norm_tags(raw) -> "tuple[str, ...]":
+    """Normalize a request's ``tags_required`` (string or list) into the
+    tuple of two-char tag names ``_apply_filter`` takes. Raises
+    ``ValueError`` on malformed names so callers map it to a
+    ProtocolError before any work happens."""
+    if not raw:
+        return ()
+    if isinstance(raw, str):
+        raw = [t for t in raw.replace(";", ",").split(",") if t]
+    tags = tuple(str(t).strip() for t in raw)
+    for t in tags:
+        if len(t) != 2:
+            raise ValueError(f"tag names are exactly two chars: {t!r}")
+    return tags
+
+
 class _FileState:
     """Warm per-file tier: flat view, contig dictionary, lazy starts."""
 
@@ -646,6 +662,7 @@ class SplitService:
         loci = req.get("intervals") or None
         flags_required = int(req.get("flags_required") or 0)
         flags_forbidden = int(req.get("flags_forbidden") or 0)
+        tags_required = _norm_tags(req.get("tags_required"))
         warm = fs.read_batch(self.config)
         if deadline_ts is not None and time.monotonic() > deadline_ts:
             obs.count("serve.shed")
@@ -656,9 +673,10 @@ class SplitService:
         # warm tier keeps the unfiltered mask for the next request.
         batch = ReadBatch(dict(warm.columns), warm.starts, buf=warm.buf)
         batch.columns["valid"] = np.array(warm.columns["valid"], copy=True)
-        if loci or flags_required or flags_forbidden:
+        if loci or flags_required or flags_forbidden or tags_required:
             _apply_filter(
-                batch, fs.header, loci, flags_required, flags_forbidden
+                batch, fs.header, loci, flags_required, flags_forbidden,
+                tags_required=tags_required,
             )
         meta = container_meta(
             columns, codec=ccfg.codec, level=ccfg.level, contigs=fs.contigs
@@ -695,6 +713,96 @@ class SplitService:
             "rows": int(rows),
             "columns": list(columns),
             "batch_rows": int(batch_rows),
+            "binary_frames": len(chunks),
+            "binary_bytes": int(nbytes),
+            "_binary": chunks,
+        })
+        return out
+
+    def _handle_aggregate(self, req: dict, deadline_ts) -> dict:
+        """Fused on-device aggregation over the warm parsed planes
+        (agg/kernels.py): the same predicate pushdown as ``batch``
+        (intervals / flag masks / tag presence) narrows ``valid``, then
+        the whole plan reduces inside the compiled mesh tick and only
+        the int64 result vectors come back — kilobytes instead of a
+        record stream, byte-equal to the host oracle
+        (docs/analytics.md "Aggregation"). Scan-class: the reduction
+        holds the device like count/batch do."""
+        from spark_bam_tpu.agg.host import host_aggregate
+        from spark_bam_tpu.agg.kernels import aggregate_planes
+        from spark_bam_tpu.agg.plan import AggConfig, encode_result
+        from spark_bam_tpu.load.tpu_load import _apply_filter
+        from spark_bam_tpu.tpu.parser import ReadBatch
+
+        fs = self.file_state(req["path"])
+        try:
+            plan = AggConfig.parse(req.get("agg") or self.config.agg)
+            tags_required = _norm_tags(req.get("tags_required"))
+            chunk = req.get("chunk")
+            if chunk is not None:
+                chunk = int(chunk)
+                if chunk < 1:
+                    raise ValueError(f"agg chunk must be >= 1: {chunk}")
+        except (TypeError, ValueError) as exc:
+            raise ServiceError("ProtocolError", str(exc)) from exc
+        loci = req.get("intervals") or None
+        flags_required = int(req.get("flags_required") or 0)
+        flags_forbidden = int(req.get("flags_forbidden") or 0)
+        warm = fs.read_batch(self.config)
+        if deadline_ts is not None and time.monotonic() > deadline_ts:
+            obs.count("serve.shed")
+            raise ServiceError(
+                "DeadlineExceeded", "aggregate deadline expired during parse"
+            )
+        batch = ReadBatch(dict(warm.columns), warm.starts, buf=warm.buf)
+        batch.columns["valid"] = np.array(warm.columns["valid"], copy=True)
+        if loci or flags_required or flags_forbidden or tags_required:
+            _apply_filter(
+                batch, fs.header, loci, flags_required, flags_forbidden,
+                tags_required=tags_required,
+            )
+        rows = int(np.count_nonzero(batch.columns["valid"]))
+        with obs.span("agg.reduce", path=fs.path):
+            try:
+                vectors = aggregate_planes(
+                    batch.columns, plan, fs.nc,
+                    steps=self.steps, chunk=chunk,
+                )
+            except Exception:
+                # Device path down (no mesh step for this shape, XLA
+                # failure): the numpy oracle answers identically, just
+                # slower — availability over speed, counted so the
+                # dashboard surfaces the regression.
+                obs.count("agg.host_fallbacks")
+                vectors = host_aggregate(batch.columns, plan, fs.nc)
+        with obs.span("agg.encode", path=fs.path):
+            meta, payload = encode_result(plan, fs.nc, fs.contigs, vectors)
+        chunks = [payload]
+        total_frames = len(chunks)
+        # Same frame-sequence resume token as ``batch``: a single
+        # deterministic frame, so a failover either re-serves it or
+        # serves nothing (the client already holds it).
+        resume_from = int(req.get("resume_from") or 0)
+        out = {}
+        if resume_from:
+            if not 0 <= resume_from < total_frames:
+                raise ServiceError(
+                    "ProtocolError",
+                    f"resume_from={resume_from} out of range "
+                    f"(0..{total_frames - 1})",
+                )
+            chunks = chunks[resume_from:]
+            out["resume_from"] = resume_from
+            out["total_frames"] = total_frames
+        nbytes = sum(len(c) for c in chunks)
+        obs.count("agg.requests")
+        obs.count("agg.rows", rows)
+        obs.count("agg.bytes_out", nbytes)
+        out.update({
+            "path": fs.path,
+            "rows": rows,
+            "agg": plan.canonical(),
+            "result": meta,
             "binary_frames": len(chunks),
             "binary_bytes": int(nbytes),
             "_binary": chunks,

@@ -1,0 +1,340 @@
+"""Fused on-device aggregation plane (docs/analytics.md "Aggregation").
+
+The contract under test: the device reduction (agg/kernels.py, running
+through the shard_map mesh step over 8 virtual devices — conftest.py)
+must produce vectors byte-identical to the numpy record oracle
+(agg/host.py) for every metric and every predicate combination, the
+result must round-trip the wire schema exactly, and the serve/CLI
+surfaces must expose the same numbers.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from spark_bam_tpu.agg import (
+    AggConfig,
+    aggregate_planes,
+    columns_from_records,
+    combine,
+    decode_result,
+    encode_result,
+    host_aggregate,
+)
+from spark_bam_tpu.bam.header import read_header
+from spark_bam_tpu.bam.record import BamRecord, encode_tag
+from spark_bam_tpu.bam.writer import write_bam
+from spark_bam_tpu.load import api
+from spark_bam_tpu.load.api import load_bam
+
+from tests.bam_factories import random_bam
+
+pytestmark = pytest.mark.agg
+
+PLAN = AggConfig.parse("")
+
+
+# ------------------------------------------------------------- fixtures
+@pytest.fixture(scope="module")
+def bam(tmp_path_factory):
+    p = str(tmp_path_factory.mktemp("agg") / "plain.bam")
+    random_bam(p, seed=7, index=True, sort=True)
+    return p
+
+
+@pytest.fixture(scope="module")
+def tagged(tmp_path_factory):
+    """A BAM whose records carry a deterministic mix of NM/RG/BC tags
+    (every 3rd/5th/7th record), mapped and unmapped, for predicate
+    tests. Returns (path, records)."""
+    src = str(tmp_path_factory.mktemp("agg_tag_src") / "seed.bam")
+    random_bam(src, seed=11, index=False, sort=True)
+    header = read_header(src)
+    rng = np.random.default_rng(3)
+    recs = []
+    # Coordinate order (the .bai builder refuses unsorted input): 200
+    # mapped reads split across the two contigs, then 40 unmapped.
+    for i in range(240):
+        n = int(rng.integers(20, 150))
+        mapped = i < 200
+        tags = b""
+        if i % 3 == 0:
+            tags += encode_tag(f"NM:i:{int(rng.integers(0, 5))}")
+        if i % 5 == 0:
+            tags += encode_tag("RG:Z:grp1")
+        if i % 7 == 0:
+            tags += encode_tag("BC:B:I,1,2,3")
+        recs.append(BamRecord(
+            ref_id=(i // 100) if mapped else -1,
+            pos=5 + 13 * (i % 100) if mapped else -1,
+            mapq=int(rng.integers(0, 61)) if mapped else 0, bin=0,
+            flag=(16 if i % 2 else 0) if mapped else 4,
+            next_ref_id=-1, next_pos=-1,
+            tlen=int(rng.integers(-900, 900)),
+            read_name=f"r{i}", cigar=[(n, 0)] if mapped else [],
+            seq="A" * n, qual=bytes([30] * n), tags=tags,
+        ))
+    p = str(tmp_path_factory.mktemp("agg_tag") / "tagged.bam")
+    write_bam(p, header, recs, block_payload=5000)
+    from spark_bam_tpu.bam.bai import index_bam
+
+    index_bam(p)
+    return p, recs
+
+
+def _records(path):
+    recs = list(load_bam(path))
+    return [r[-1] if isinstance(r, tuple) else r for r in recs]
+
+
+def _nc(path):
+    return len(read_header(path).contig_lengths.lengths_list())
+
+
+def _assert_equal(metrics, oracle):
+    assert set(metrics) == set(oracle)
+    for k in oracle:
+        got = np.asarray(metrics[k]).reshape(-1)
+        assert got.dtype == np.int64
+        assert np.array_equal(got, oracle[k]), k
+
+
+# ------------------------------------------------------------- grammar
+def test_parse_default_spec():
+    plan = AggConfig.parse("")
+    assert plan.canonical() == "count;flagstat;mapq;tlen;coverage"
+    assert plan is AggConfig.parse("")          # lru-cached identity
+    assert plan.total_length(2) == 3 + 13 + 256 + 2002 + 2 * 512
+
+
+def test_parse_params_roundtrip():
+    plan = AggConfig.parse("coverage:bins=64,bin=500,cap=4 ; count")
+    assert plan.canonical() == "coverage:bin=500,bins=64,cap=4;count"
+    cov = plan.specs[0]
+    assert (cov.get("bin"), cov.get("bins"), cov.get("cap")) == (500, 64, 4)
+    assert cov.shape(3) == (3, 64)
+    # Canonical form reparses to the same plan.
+    assert AggConfig.parse(plan.canonical()).canonical() == plan.canonical()
+
+
+@pytest.mark.parametrize("bad", [
+    "bogus",                      # unknown metric
+    "coverage:widths=3",          # unknown param
+    "tlen:max=abc",               # non-integer value
+    "coverage:bins",              # missing =
+    "mapq;mapq",                  # duplicate metric
+    "tlen:max=0",                 # below 1
+    ";;",                         # empty after split
+])
+def test_parse_rejects(bad):
+    with pytest.raises(ValueError):
+        AggConfig.parse(bad)
+
+
+def test_wire_roundtrip_and_validation():
+    plan = AggConfig.parse("count;mapq")
+    contigs = [("chr1", 1000), ("chr2", 500)]
+    vectors = {
+        "count": np.arange(3, dtype=np.int64),
+        "mapq": np.arange(256, dtype=np.int64),
+    }
+    meta, payload = encode_result(plan, 2, contigs, vectors)
+    assert meta["agg"] == "count;mapq"
+    assert meta["elements"] * 8 == len(payload)
+    json.dumps(meta)                              # JSON-able contract
+    dec = decode_result(meta, payload)
+    _assert_equal(dec, {k: v.reshape(-1) for k, v in vectors.items()})
+    with pytest.raises(ValueError):
+        decode_result(meta, payload[:-8])         # truncated payload
+    with pytest.raises(ValueError):
+        encode_result(plan, 2, contigs, {
+            "count": np.zeros(4, np.int64),       # wrong length
+            "mapq": vectors["mapq"],
+        })
+
+
+# -------------------------------------------- device vs oracle (planes)
+def test_device_matches_oracle_whole_file(bam):
+    res = api.aggregate(bam)
+    oracle = host_aggregate(
+        columns_from_records(_records(bam)), PLAN, _nc(bam)
+    )
+    _assert_equal(res["metrics"], oracle)
+    assert res["rows"] == int(oracle["count"][0])
+    assert res["agg"] == PLAN.canonical()
+
+
+def test_device_matches_oracle_small_chunks(bam):
+    """Multi-window carry: a tiny chunk forces many device steps with
+    int32 carry + int64 flushes; answers must not move."""
+    base = api.aggregate(bam)
+    small = api.aggregate(bam, chunk=64)
+    _assert_equal(small["metrics"], base["metrics"])
+
+
+def test_device_matches_oracle_filtered(tagged):
+    path, recs = tagged
+    nc = _nc(path)
+    # flags: mapped, reverse-strand only.
+    res = api.aggregate(path, flags_required=16, flags_forbidden=4)
+    sub = [r for r in recs if (r.flag & 16) and not (r.flag & 4)]
+    _assert_equal(res["metrics"], host_aggregate(
+        columns_from_records(sub), PLAN, nc))
+    # tag presence (single, and conjunction).
+    res = api.aggregate(path, tags_required=("NM",))
+    sub = [r for i, r in enumerate(recs) if i % 3 == 0]
+    assert res["rows"] == len(sub)
+    _assert_equal(res["metrics"], host_aggregate(
+        columns_from_records(sub), PLAN, nc))
+    res = api.aggregate(path, tags_required=("NM", "RG"))
+    sub = [r for i, r in enumerate(recs) if i % 3 == 0 and i % 5 == 0]
+    assert res["rows"] == len(sub)
+    _assert_equal(res["metrics"], host_aggregate(
+        columns_from_records(sub), PLAN, nc))
+
+
+def test_device_empty_selection(tagged):
+    path, _ = tagged
+    res = api.aggregate(path, agg="count;flagstat", flags_required=2048)
+    assert res["rows"] == 0
+    assert all(int(v.sum()) == 0 for v in res["metrics"].values())
+
+
+def test_bad_tag_name_rejected(bam):
+    with pytest.raises(ValueError):
+        api.aggregate(bam, tags_required=("NMX",))
+
+
+def test_combine_matches_single_pass(tagged):
+    path, recs = tagged
+    nc = _nc(path)
+    whole = host_aggregate(columns_from_records(recs), PLAN, nc)
+    parts = [
+        host_aggregate(columns_from_records(recs[:100]), PLAN, nc),
+        None,                                     # dead partition
+        host_aggregate(columns_from_records(recs[100:]), PLAN, nc),
+    ]
+    _assert_equal(combine(parts, PLAN, nc), whole)
+
+
+def test_aggregate_planes_rejects_bad_chunk(bam):
+    with pytest.raises(ValueError):
+        api.aggregate(bam, chunk=-1)
+
+
+# --------------------------------------------------- record path (CRAM)
+def test_cram_dataset_matches_bam(tagged, tmp_path):
+    from spark_bam_tpu.cram import CramWriter
+
+    path, recs = tagged
+    header = read_header(path)
+    cram = tmp_path / "tagged.cram"
+    with CramWriter(cram, header.contig_lengths, header.text) as w:
+        w.write_all(recs)
+    bam_res = api.aggregate(path)
+    cram_res = api.aggregate(str(cram))
+    _assert_equal(cram_res["metrics"], {
+        k: np.asarray(v).reshape(-1) for k, v in bam_res["metrics"].items()
+    })
+    assert cram_res["rows"] == bam_res["rows"]
+
+
+# ----------------------------------------------------------- serve op
+def test_serve_aggregate_roundtrip(tagged):
+    from spark_bam_tpu.serve.service import ServiceError, SplitService
+
+    path, recs = tagged
+    nc = _nc(path)
+    svc = SplitService()
+    try:
+        out = svc._handle_aggregate({"path": path}, None)
+        assert out["binary_frames"] == len(out["_binary"]) == 1
+        assert out["binary_bytes"] == len(out["_binary"][0])
+        dec = decode_result(out["result"], out["_binary"][0])
+        _assert_equal(dec, host_aggregate(
+            columns_from_records(recs), PLAN, nc))
+        # Predicates compose; answers stay oracle-equal.
+        out2 = svc._handle_aggregate({
+            "path": path, "agg": "count;mapq",
+            "flags_forbidden": 4, "tags_required": "NM",
+        }, None)
+        plan2 = AggConfig.parse("count;mapq")
+        sub = [
+            r for i, r in enumerate(recs)
+            if i % 3 == 0 and not (r.flag & 4)
+        ]
+        _assert_equal(
+            decode_result(out2["result"], out2["_binary"][0]),
+            host_aggregate(columns_from_records(sub), plan2, nc),
+        )
+        assert out2["rows"] == len(sub)
+        # Protocol errors, not stack traces.
+        with pytest.raises(ServiceError):
+            svc._handle_aggregate({"path": path, "agg": "bogus"}, None)
+        with pytest.raises(ServiceError):
+            svc._handle_aggregate({"path": path, "chunk": 0}, None)
+        with pytest.raises(ServiceError):
+            svc._handle_aggregate(
+                {"path": path, "tags_required": "TOOLONG"}, None
+            )
+    finally:
+        svc.close()
+
+
+def test_serve_aggregate_deterministic_and_resumable(tagged):
+    """Same query ⇒ same bytes (the property the streaming-failover
+    resume token and the chaos byte-equality gates rely on)."""
+    from spark_bam_tpu.serve.service import ServiceError, SplitService
+
+    path, _ = tagged
+    svc = SplitService()
+    try:
+        a = svc._handle_aggregate({"path": path}, None)
+        b = svc._handle_aggregate({"path": path}, None)
+        assert a["_binary"] == b["_binary"]
+        # The result is a single frame, so the only valid resume token
+        # is 0 — out-of-range tokens are protocol errors, same as batch.
+        with pytest.raises(ServiceError):
+            svc._handle_aggregate({"path": path, "resume_from": 1}, None)
+    finally:
+        svc.close()
+
+
+# ---------------------------------------------------------------- CLI
+def test_cli_aggregate_tsv_and_json(tagged, tmp_path):
+    from spark_bam_tpu.cli.main import main
+
+    path, recs = tagged
+    nc = _nc(path)
+    out = tmp_path / "agg.tsv"
+    assert main(["aggregate", "-a", "count;flagstat", str(path),
+                 "-o", str(out)]) == 0
+    rows = dict()
+    for line in out.read_text().splitlines():
+        metric, key, value = line.split("\t")
+        rows[(metric, key)] = int(value)
+    oracle = host_aggregate(
+        columns_from_records(recs), AggConfig.parse("count;flagstat"), nc
+    )
+    assert rows[("count", "records")] == int(oracle["count"][0])
+    assert rows[("count", "mapped")] == int(oracle["count"][1])
+    assert rows[("flagstat", "total")] == int(oracle["flagstat"][0])
+
+    out_json = tmp_path / "agg.json"
+    assert main(["aggregate", "--format", "json", str(path),
+                 "-o", str(out_json)]) == 0
+    doc = json.loads(out_json.read_text())
+    full = host_aggregate(columns_from_records(recs), PLAN, nc)
+    for k, vec in doc["metrics"].items():
+        assert vec == [int(x) for x in full[k]], k
+    assert doc["agg"] == PLAN.canonical()
+
+
+def test_cli_aggregate_bad_spec_is_usage_error(tagged, tmp_path):
+    from spark_bam_tpu.cli.main import main
+
+    path, _ = tagged
+    assert main(["aggregate", "-a", "bogus", str(path),
+                 "-o", str(tmp_path / "x")]) == 2

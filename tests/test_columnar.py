@@ -401,3 +401,88 @@ def test_batch_builder_empty_build():
     batch = b.build()
     assert batch.num_rows == 0
     assert list(iter_rows(batch)) == []
+
+
+# --------------------------------------------- dictionary columns (kind 2)
+
+
+def _var_col(strings):
+    import numpy as np
+
+    from spark_bam_tpu.columnar.schema import VarColumn
+
+    offs = np.zeros(len(strings) + 1, dtype=np.int64)
+    np.cumsum([len(s) for s in strings], out=offs[1:])
+    blob = b"".join(s.encode() if isinstance(s, str) else s for s in strings)
+    return VarColumn(offs, np.frombuffer(blob, dtype=np.uint8).copy())
+
+
+def test_dict_encoding_smaller_only_when_repetitive():
+    from spark_bam_tpu.columnar.native import _dict_parts, _var_parts
+
+    repetitive = _var_col(["100M"] * 200 + ["51M2D49M"] * 56)
+    unique = _var_col([f"read-{i:06d}" for i in range(256)])
+    for col, wins in ((repetitive, True), (unique, False)):
+        dict_bytes = sum(map(len, _dict_parts(col, "none", 6)))
+        var_bytes = sum(map(len, _var_parts(col, "none", 6)))
+        assert (dict_bytes < var_bytes) == wins
+
+
+def test_dict_encoding_roundtrips_and_shrinks(bam_path):
+    """Real fixtures collapse CIGARs to a handful of shapes, so the
+    kind-2 path engages on export — content must survive unchanged."""
+    from spark_bam_tpu.columnar.native import (
+        batch_frame,
+        container_head,
+        container_meta,
+        end_frame,
+    )
+
+    recs = list(load_bam(bam_path))[:300]
+    whole = concat_batches(list(batches_from_records(recs, batch_rows=128)))
+    meta = container_meta(COLUMNS)
+    blob = (container_head(meta) + batch_frame(whole, meta)
+            + end_frame(whole.num_rows, 1))
+    _, batches = read_container(blob)
+    back = concat_batches(batches)
+    assert list(iter_rows(back)) == list(iter_rows(whole))
+    # The dictionary must actually have paid for itself on cigar.
+    from spark_bam_tpu.columnar.native import _dict_parts, _var_parts
+
+    cig = whole.columns["cigar"]
+    assert (sum(map(len, _dict_parts(cig, "none", 6)))
+            < sum(map(len, _var_parts(cig, "none", 6))))
+
+
+def test_dict_decode_rejects_malformed():
+    import numpy as np
+
+    from spark_bam_tpu.columnar import native as N
+
+    col = _var_col(["100M"] * 8)
+    good = N._dict_parts(col, "none", 6)
+
+    def payload(parts):
+        return memoryview(N._BATCH.pack(8, 1) + b"".join(parts))
+
+    # Sanity: the crafted payload decodes as-is.
+    batch = N._decode_batch(payload(good), ["cigar"])
+    assert batch.columns["cigar"].value(0) == b"100M"
+
+    out_of_range = np.full(8, 7, dtype=np.int32)  # dictionary has 1 entry
+    bad_codes = [good[0], N._encode_buffer(out_of_range.tobytes(), "none", 6),
+                 good[2], good[3]]
+    with pytest.raises(ColumnarFormatError):
+        N._decode_batch(payload(bad_codes), ["cigar"])
+
+    short_codes = [good[0],
+                   N._encode_buffer(np.zeros(3, np.int32).tobytes(), "none", 6),
+                   good[2], good[3]]
+    with pytest.raises(ColumnarFormatError):
+        N._decode_batch(payload(short_codes), ["cigar"])
+
+    crooked = np.array([0, 2, 1], dtype=np.int64)  # non-monotone offsets
+    bad_offs = [good[0], good[1],
+                N._encode_buffer(crooked.tobytes(), "none", 6), good[3]]
+    with pytest.raises(ColumnarFormatError):
+        N._decode_batch(payload(bad_offs), ["cigar"])

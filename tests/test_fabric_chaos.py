@@ -831,6 +831,9 @@ def test_seeded_storm_zero_lost_merged_traces_bounded_amplification(
                     ref = b"".join(
                         c.request("batch", path=bam_path)["_binary"]
                     )
+                    agg_ref = b"".join(
+                        c.request("aggregate", path=bam_path)["_binary"]
+                    )
 
                 storm = ChaosStorm(pool, seed, spec)
 
@@ -846,12 +849,19 @@ def test_seeded_storm_zero_lost_merged_traces_bounded_amplification(
                             i = 0
                             while (storm._thread.is_alive() or i < 12) \
                                     and i < 400:
-                                if i % 2:
+                                if i % 3 == 1:
                                     got = b"".join(c.request(
                                         "batch", path=bam_path
                                     )["_binary"])
                                     results.append(
                                         ("batch", got == ref)
+                                    )
+                                elif i % 3 == 2:
+                                    got = b"".join(c.request(
+                                        "aggregate", path=bam_path
+                                    )["_binary"])
+                                    results.append(
+                                        ("aggregate", got == agg_ref)
                                     )
                                 else:
                                     results.append((
@@ -894,12 +904,14 @@ def test_seeded_storm_zero_lost_merged_traces_bounded_amplification(
     finally:
         obs.shutdown()
 
-    # Gate 1: zero lost requests, zero wrong answers.
+    # Gate 1: zero lost requests, zero wrong answers — every batch AND
+    # every aggregate byte-equal to its undisturbed reference.
     assert not errors, f"storm lost requests: {errors}"
     assert len(results) >= 48 and all(ok for _op, ok in results)
+    assert any(op == "aggregate" for op, _ok in results)
     # Gate 2: retry amplification ≤ 2× — upstream dispatches over
     # admitted requests.
-    admitted = len(results) + 4 + 3   # load + tagged + warm-up
+    admitted = len(results) + 4 + 4   # load + tagged + warm-up
     dispatches = counters.get("routed", 0) + counters.get("failovers", 0)
     assert dispatches / admitted <= 2.0, counters
     assert counters.get("failovers", 0) >= 1      # the storm actually bit
