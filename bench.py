@@ -2183,6 +2183,172 @@ def aggregate_leg():
     return out
 
 
+def _child_jobs(kill_rounds: int = 2, checkpoint: int = 1000):
+    """Durable-job leg (docs/robustness.md "Durable jobs & scrubbing"):
+    the interrupted-vs-clean rewrite A/B.
+
+    Clean side: one uninterrupted ``run_rewrite_job``. Interrupted side:
+    the SAME spec driven in a grandchild process that gets a real
+    SIGKILL mid-interval (the parent polls the WAL for fresh ``ckpt``
+    frames and kills once new ones land — deterministic-enough placement
+    without any in-process cooperation, which would run the
+    ``JobCancelled`` cleanup path and hide the crash cost), repeated
+    ``kill_rounds`` times, then resumed in-process to completion.
+
+    Gates, all fatal: the two outputs are **byte-identical**; the work
+    re-done after the last crash is bounded by one checkpoint interval
+    (``redone_bytes / checkpoint_bytes <= 1.0`` where checkpoint_bytes
+    is the largest committed segment); and the integrity scrubber
+    (record parity against the source included) reports **clean**.
+
+    Own child for the same reason as ``--child-serve``: the synthetic
+    fixture + virtual devices must not leak into the parent's jax."""
+    _emit_stage("start")
+    from spark_bam_tpu.core.platform import force_cpu_devices
+
+    force_cpu_devices(8)
+    enable_compile_cache()
+    import jax
+
+    _emit_stage("backend_ok:" + jax.devices()[0].platform)
+
+    import shutil
+    import signal
+
+    from spark_bam_tpu.benchmarks.synth import synthetic_fixture
+    from spark_bam_tpu.jobs.journal import read_journal
+    from spark_bam_tpu.jobs.runner import run_rewrite_job
+    from spark_bam_tpu.jobs.scrub import scrub_paths
+
+    path = str(synthetic_fixture(reads=20_000))
+    root = tempfile.mkdtemp(prefix="sbt_jobs_leg_")
+    try:
+        # --- clean side -------------------------------------------------
+        out_clean = os.path.join(root, "clean.bam")
+        spec_clean = {"op": "rewrite", "path": path, "out": out_clean,
+                      "block_payload": 0xFF00, "level": 6, "index": True}
+        t0 = time.perf_counter()
+        clean = run_rewrite_job(spec_clean, os.path.join(root, "jd-clean"),
+                                checkpoint=checkpoint)
+        clean_wall = time.perf_counter() - t0
+        _emit_stage("jobs_clean_done")
+
+        # --- interrupted side ------------------------------------------
+        out_int = os.path.join(root, "interrupted.bam")
+        spec = {"op": "rewrite", "path": path, "out": out_int,
+                "block_payload": 0xFF00, "level": 6, "index": True}
+        jd = os.path.join(root, "jd-int")
+        journal_path = os.path.join(jd, "journal.sbj")
+        script = (
+            "import json, sys\n"
+            "from spark_bam_tpu.jobs.runner import run_rewrite_job\n"
+            "run_rewrite_job(json.loads(sys.argv[1]), sys.argv[2],"
+            " checkpoint=int(sys.argv[3]))\n"
+        )
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+        def ckpts_on_disk() -> int:
+            try:
+                return sum(
+                    1 for r in read_journal(journal_path)
+                    if r.get("t") == "ckpt"
+                )
+            except Exception:
+                return 0
+
+        kills = 0
+        t0 = time.perf_counter()
+        for _ in range(kill_rounds):
+            seen = ckpts_on_disk()
+            proc = subprocess.Popen(
+                [sys.executable, "-c", script,
+                 json.dumps(spec), jd, str(checkpoint)],
+                cwd=str(Path(__file__).resolve().parent), env=env,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            )
+            deadline = time.monotonic() + 120
+            while proc.poll() is None and time.monotonic() < deadline:
+                if ckpts_on_disk() >= seen + 2:
+                    # Let the writer get back INTO the next interval —
+                    # far enough that whole BGZF members have flushed to
+                    # the .part (so the crash leaves real bytes to
+                    # discard), but short of the next commit edge.
+                    time.sleep(0.06)
+                    proc.send_signal(signal.SIGKILL)
+                    proc.wait()
+                    kills += 1
+                    break
+                time.sleep(0.02)
+            else:
+                if proc.poll() is None:  # wedged past the deadline
+                    proc.kill()
+                proc.wait()
+                if proc.returncode == 0:
+                    break  # rewrite outran the poller: already done
+        # Resume in-process to completion (idempotent if a fast grandchild
+        # already finished — the journaled `done` record answers).
+        result = run_rewrite_job(spec, jd, checkpoint=checkpoint)
+        interrupted_wall = time.perf_counter() - t0
+        _emit_stage("jobs_interrupted_done")
+
+        # --- gates ------------------------------------------------------
+        if Path(out_clean).read_bytes() != Path(out_int).read_bytes():
+            raise AssertionError(
+                "interrupted+resumed rewrite diverged from the clean run"
+            )
+        ckpt_bytes = max(
+            (r["seg_bytes"] for r in read_journal(journal_path)
+             if r.get("t") == "ckpt"), default=0,
+        )
+        redone = int(result.get("redone_bytes") or 0)
+        ratio = redone / ckpt_bytes if ckpt_bytes else 0.0
+        if ratio > 1.0:
+            raise AssertionError(
+                f"redone {redone}B exceeds one checkpoint interval "
+                f"({ckpt_bytes}B): ratio {ratio:.2f} > 1.0"
+            )
+        scrub = scrub_paths([out_int], source=path)
+        if not scrub.clean:
+            raise AssertionError(
+                "scrub found damage in the resumed artifact: "
+                + "; ".join(f.error for f in scrub.findings)
+            )
+        _emit_result("jobs", {
+            "jobs_count": int(clean["count"]),
+            "jobs_bytes_out": int(clean["bytes_out"]),
+            "jobs_kills": kills,
+            "jobs_resumed": bool(result.get("resumed")),
+            "jobs_equal": True,
+            "jobs_redone_bytes": redone,
+            "jobs_checkpoint_bytes": int(ckpt_bytes),
+            "jobs_redone_ratio": round(ratio, 3),
+            "jobs_scrub_clean": True,
+            "jobs_scrub_records_checked": int(scrub.records_checked),
+            "jobs_clean_s": round(clean_wall, 2),
+            "jobs_interrupted_s": round(interrupted_wall, 2),
+            "jobs_resume_overhead": (
+                round(interrupted_wall / clean_wall, 2) if clean_wall else None
+            ),
+        })
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def jobs_leg():
+    """Parent wrapper for the durable-job crash-resume leg (own child:
+    SIGKILLs a grandchild rewrite). Budget env-tunable; 0 skips."""
+    budget = int(os.environ.get("SB_BENCH_JOBS_CHILD_S", "300"))
+    if budget <= 0:
+        return {}
+    results, stages, err = _run_child(["--child-jobs"], budget)
+    out = results.get("jobs")
+    if out is None:
+        raise RuntimeError(
+            f"jobs child produced no result: {err or 'stages=' + str(stages)}"
+        )
+    return out
+
+
 def _run_cli_smoke(backend: str):
     """check-bam with backend=tpu must be byte-identical to the golden —
     proves the device engine is CLI-reachable (VERDICT r3 weak #5)."""
@@ -3183,6 +3349,9 @@ def main():
     if len(sys.argv) > 1 and sys.argv[1] == "--child-fabric":
         _child_fabric()
         return
+    if len(sys.argv) > 1 and sys.argv[1] == "--child-jobs":
+        _child_jobs()
+        return
     if len(sys.argv) > 1 and sys.argv[1] == "--child-fabric-chaos":
         _child_fabric_chaos()
         return
@@ -3665,6 +3834,14 @@ def _main_measure(record, warnings, errors):
         record.update(fabric_chaos_leg())
     except Exception as e:
         warnings.append(f"fabric chaos leg: {type(e).__name__}: {e}")
+    # Durable-job leg: interrupted-vs-clean rewrite A/B with real
+    # SIGKILLs — byte-identical resume, redo bounded by one checkpoint
+    # interval, scrub-clean verdict (own child process —
+    # docs/robustness.md "Durable jobs & scrubbing").
+    try:
+        record.update(jobs_leg())
+    except Exception as e:
+        warnings.append(f"jobs leg: {type(e).__name__}: {e}")
     # Host-zlib vs two-phase device inflate on identical windows
     # (in-process backend). setdefault: the inflate child's TPU-measured
     # first-class fields win when they landed; this leg guarantees the

@@ -181,6 +181,8 @@ def write_bam_result(
         from spark_bam_tpu.compress.codec import make_codec
 
         codec = make_codec(deflate, level=level)
+    from spark_bam_tpu.core.guard import map_write_error
+
     result = WriteResult()
     out = AtomicFile(path)
     try:
@@ -195,10 +197,20 @@ def write_bam_result(
                 result.count += 1
         result.blocks = w.blocks
         result.bytes_out = w._offset
+    except OSError as exc:
+        # ENOSPC/EIO/EDQUOT mid-write become the guard taxonomy's
+        # retryable ResourceExhausted instead of a raw OSError escaping
+        # the fault model's classification entirely.
+        out.abort()
+        raise map_write_error(exc, "BAM write", path=path) from exc
     except BaseException:
         out.abort()
         raise
-    out.commit()
+    try:
+        out.commit()
+    except OSError as exc:
+        out.abort()
+        raise map_write_error(exc, "BAM commit", path=path) from exc
     return result
 
 

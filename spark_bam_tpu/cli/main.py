@@ -14,7 +14,9 @@
         ahead-of-time .sbi split-index cache builder, docs/caching.md)
     spark-bam-tpu index-blocks PATH
     spark-bam-tpu index-records PATH
-    spark-bam-tpu htsjdk-rewrite IN OUT
+    spark-bam-tpu htsjdk-rewrite [--durable] [--disk-chaos SEED:SPEC] IN OUT
+    spark-bam-tpu scrub [--source BAM] [--quarantine] PATHS...
+        (beyond the 10: end-to-end integrity scrubber, docs/robustness.md)
 """
 
 from __future__ import annotations
@@ -62,6 +64,43 @@ def _add_faults(sub):
         help="deterministic fault injection on every opened channel, e.g. "
              "'7:io=0.1,latency=0.05x10,short=0.02,corrupt=1e-6' — same "
              "seed replays the same faults (docs/robustness.md)",
+    )
+
+
+def _add_disk_chaos(sub):
+    sub.add_argument(
+        "--disk-chaos", default=None, metavar="SEED:SPEC",
+        help="deterministic filesystem-fault injection on every guarded "
+             "write, e.g. '7:enospc=0.02+eio=0.01+short=0.01+torn=0.01+"
+             "rename=0.05' — same seed replays the same faults; fabric "
+             "workers inherit it via SPARK_BAM_DISK_CHAOS "
+             "(docs/robustness.md)",
+    )
+
+
+def _add_durable(sub):
+    sub.add_argument(
+        "--durable", action="store_true",
+        help="run through the journaled job runner: checkpoints to a "
+             "write-ahead log, a re-run after a crash resumes from the "
+             "last durable checkpoint and produces a byte-identical "
+             "artifact (SPARK_BAM_JOBS tunes the job dir/cadence; "
+             "docs/robustness.md)",
+    )
+    sub.add_argument(
+        "--checkpoint", type=_positive_int, default=None, metavar="N",
+        help="with --durable: checkpoint cadence (records for rewrite, "
+             "frames for export; default from SPARK_BAM_JOBS)",
+    )
+    _add_jobs(sub)
+
+
+def _add_jobs(sub):
+    sub.add_argument(
+        "--jobs", default=None, metavar="SPEC",
+        help="durable-job plane knobs, e.g. 'dir=/var/jobs,checkpoint="
+             "5000,frames=8,mem=0.92,max=2' (SPARK_BAM_JOBS env var "
+             "works too; docs/robustness.md)",
     )
 
 
@@ -253,6 +292,8 @@ def build_parser() -> argparse.ArgumentParser:
     sub = sp.add_parser("export")
     _add_metrics(sub)
     _add_faults(sub)
+    _add_disk_chaos(sub)
+    _add_durable(sub)
     _add_cache(sub)
     _add_limits(sub)
     _add_remote(sub)
@@ -366,6 +407,8 @@ def build_parser() -> argparse.ArgumentParser:
     _add_metrics(sub)
     _add_cache(sub)
     _add_deflate(sub)
+    _add_disk_chaos(sub)
+    _add_durable(sub)
     sub.add_argument("-o", "--out", default=None, help="write output to file")
     sub.add_argument("-b", "--block-payload", default="65280")
     sub.add_argument("--level", type=int, default=6,
@@ -392,12 +435,45 @@ def build_parser() -> argparse.ArgumentParser:
     sub.add_argument("-o", "--out", default=None,
                      help="write the JSON summary here instead of stdout")
 
+    # End-to-end integrity scrubber over rewritten artifacts: BGZF frame
+    # CRCs, sidecar cross-checks, native-container validation, spot
+    # record-parity against the source (docs/robustness.md).
+    sub = sp.add_parser("scrub")
+    _add_metrics(sub)
+    _add_limits(sub)
+    sub.add_argument(
+        "--source", default=None, metavar="BAM",
+        help="original BAM the artifacts were rewritten from — enables "
+             "spot record-parity (every --stride'th record compared "
+             "byte-for-byte)",
+    )
+    sub.add_argument(
+        "--quarantine", action="store_true",
+        help="rename artifacts with findings to <path>.quarantined so "
+             "downstream pipelines cannot consume them",
+    )
+    sub.add_argument(
+        "--stride", type=_positive_int, default=16, metavar="N",
+        help="record-parity sampling stride (default 16; 1 = compare "
+             "every record)",
+    )
+    sub.add_argument("-o", "--out", default=None,
+                     help="write the JSON report here instead of stdout")
+    sub.add_argument("-w", "--warn", action="store_true",
+                     help="root log level WARN")
+    sub.add_argument(
+        "paths", nargs="+",
+        help="artifacts to scrub (BAM pulls its .blocks/.records/.sbi "
+             "sidecars in automatically; native containers stand alone)",
+    )
+
     # Long-running split/record daemon over the device mesh: warm steps,
     # warm flat views, warm .sbi tier; newline-JSON protocol
     # (docs/serving.md).
     sub = sp.add_parser("serve")
     _add_metrics(sub)
     _add_faults(sub)
+    _add_disk_chaos(sub)
     _add_cache(sub)
     _add_limits(sub)
     _add_remote(sub)
@@ -405,6 +481,7 @@ def build_parser() -> argparse.ArgumentParser:
     _add_columnar(sub)
     _add_deflate(sub)
     _add_slo(sub)
+    _add_jobs(sub)
     sub.add_argument(
         "--serve", default=None, metavar="SPEC",
         help="serving knobs, e.g. 'batch=16,tick=2,plan_queue=64,"
@@ -425,6 +502,7 @@ def build_parser() -> argparse.ArgumentParser:
     sub = sp.add_parser("fabric")
     _add_metrics(sub)
     _add_faults(sub)
+    _add_disk_chaos(sub)
     _add_slo(sub)
     sub.add_argument(
         "--fabric", default=None, metavar="SPEC",
@@ -634,10 +712,14 @@ def main(argv=None) -> int:
         if value is not None:
             config = config.replace(**{knob: value})
 
-    from spark_bam_tpu.core.faults import FaultPolicy, install_chaos, uninstall_chaos
+    from spark_bam_tpu.core.faults import (
+        FaultPolicy, install_chaos, install_disk_chaos, uninstall_chaos,
+        uninstall_disk_chaos,
+    )
     from spark_bam_tpu.parallel.executor import last_report, reset_last_report
 
     chaos_state = None
+    disk_state = None
     try:
         if getattr(args, "faults", None):
             FaultPolicy.parse(args.faults)  # fail before any work starts
@@ -696,6 +778,11 @@ def main(argv=None) -> int:
 
             SloConfig.parse(args.slo)  # fail before any work starts
             config = config.replace(slo=args.slo)
+        if getattr(args, "jobs", None) is not None:
+            from spark_bam_tpu.jobs.manager import JobsConfig
+
+            JobsConfig.parse(args.jobs)  # fail before any work starts
+            config = config.replace(jobs=args.jobs)
         if getattr(args, "dashboard", None):
             from spark_bam_tpu.obs.dashboard import parse_listen
 
@@ -706,8 +793,15 @@ def main(argv=None) -> int:
             ServeAddress(args.listen)  # fail before any work starts
         if getattr(args, "chaos", None):
             chaos_state = install_chaos(args.chaos)
+        if getattr(args, "disk_chaos", None):
+            # In-process seam for rewrite/export/serve; the fabric branch
+            # additionally exports SPARK_BAM_DISK_CHAOS so every launched
+            # worker installs the same seeded schedule.
+            disk_state = install_disk_chaos(args.disk_chaos)
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
+        if chaos_state is not None:
+            uninstall_chaos()
         return 2
     reset_last_report()
     # Cache-status events are per-run (module-global): clear leftovers so
@@ -823,10 +917,39 @@ def main(argv=None) -> int:
                     normalize_columns(args.columns)
                 except ValueError as e:
                     raise UsageError(str(e)) from e
-            export_cmd.run(
-                args.path, p, config, args.export_out, fmt=args.format,
-                loci=loci, columns=args.columns, reference=args.reference,
-            )
+            if args.durable:
+                # Journaled export: checkpoints at container-frame
+                # boundaries, crash-resumable (docs/robustness.md). The
+                # runner streams whole-file native frames, so the knobs
+                # that change the frame list are out of scope here.
+                if args.format != "native":
+                    raise UsageError(
+                        "--durable export supports --format native only"
+                    )
+                if loci or args.reference:
+                    raise UsageError(
+                        "--durable export does not take -i/--reference"
+                    )
+                import json as _json
+
+                from spark_bam_tpu.jobs.manager import job_id_of
+                from spark_bam_tpu.jobs.runner import run_export_job
+
+                spec = {"op": "export", "path": args.path,
+                        "out": args.export_out, "columns": args.columns}
+                spec = {k: v for k, v in spec.items() if v is not None}
+                jcfg = config.jobs_config
+                res = run_export_job(
+                    spec, os.path.join(jcfg.root(), job_id_of(spec)),
+                    config=config,
+                    checkpoint=args.checkpoint or jcfg.frames,
+                )
+                p.echo(_json.dumps(res, indent=2, sort_keys=True))
+            else:
+                export_cmd.run(
+                    args.path, p, config, args.export_out, fmt=args.format,
+                    loci=loci, columns=args.columns, reference=args.reference,
+                )
         elif cmd == "aggregate":
             from spark_bam_tpu.agg.plan import AggConfig
             from spark_bam_tpu.cli import aggregate as aggregate_cmd
@@ -887,16 +1010,40 @@ def main(argv=None) -> int:
                 file=sys.stderr,
             )
         elif cmd in ("htsjdk-rewrite", "rewrite"):
-            from spark_bam_tpu.cli import rewrite
+            if args.durable:
+                # Journaled rewrite: the WAL + segment files live under
+                # the job dir keyed by the spec hash, so re-running the
+                # same command after a crash resumes from the last
+                # checkpoint and emits a byte-identical artifact.
+                import json as _json
 
-            rewrite.run(
-                args.in_path, args.out_path, p,
-                block_payload=parse_bytes(args.block_payload),
-                reindex=args.index,
-                level=args.level,
-                deflate=config.deflate,
-                config=config,
-            )
+                from spark_bam_tpu.jobs.manager import job_id_of
+                from spark_bam_tpu.jobs.runner import run_rewrite_job
+
+                spec = {"op": "rewrite", "path": args.in_path,
+                        "out": args.out_path,
+                        "block_payload": parse_bytes(args.block_payload),
+                        "level": args.level,
+                        "index": True if args.index else None}
+                spec = {k: v for k, v in spec.items() if v is not None}
+                jcfg = config.jobs_config
+                res = run_rewrite_job(
+                    spec, os.path.join(jcfg.root(), job_id_of(spec)),
+                    config=config,
+                    checkpoint=args.checkpoint or jcfg.checkpoint,
+                )
+                p.echo(_json.dumps(res, indent=2, sort_keys=True))
+            else:
+                from spark_bam_tpu.cli import rewrite
+
+                rewrite.run(
+                    args.in_path, args.out_path, p,
+                    block_payload=parse_bytes(args.block_payload),
+                    reindex=args.index,
+                    level=args.level,
+                    deflate=config.deflate,
+                    config=config,
+                )
         elif cmd == "fuzz-decode":
             from spark_bam_tpu.tools.fuzz_decode import run_fuzz
 
@@ -912,6 +1059,15 @@ def main(argv=None) -> int:
             p.echo(json.dumps(summary, indent=2, sort_keys=True))
             if summary["violations"]:
                 return 1
+        elif cmd == "scrub":
+            from spark_bam_tpu.cli import scrub as scrub_cmd
+
+            rc = scrub_cmd.run(
+                args.paths, p, source=args.source,
+                quarantine=args.quarantine, stride=args.stride,
+            )
+            if rc:
+                return rc
         elif cmd == "serve":
             from spark_bam_tpu.serve import ServeAddress, SplitService, serve_forever
 
@@ -948,9 +1104,14 @@ def main(argv=None) -> int:
             # Workers inherit the fabric spec via env so a chaos run's
             # seed lands in THEIR flight dumps too (fabric/worker.py).
             worker_env = None
-            if config.fabric:
-                worker_env = dict(os.environ,
-                                  SPARK_BAM_FABRIC=config.fabric)
+            if config.fabric or getattr(args, "disk_chaos", None):
+                worker_env = dict(os.environ)
+                if config.fabric:
+                    worker_env["SPARK_BAM_FABRIC"] = config.fabric
+                if getattr(args, "disk_chaos", None):
+                    # Disk faults ride the env into every launched
+                    # worker (fabric/worker.py installs from it).
+                    worker_env["SPARK_BAM_DISK_CHAOS"] = args.disk_chaos
             pool = WorkerPool(
                 workers=fcfg.workers, devices=args.worker_devices,
                 serve=config.serve, columnar=config.columnar,
@@ -1061,6 +1222,12 @@ def main(argv=None) -> int:
             )
             p.echo(f"chaos(seed={chaos_state.seed}): injected "
                    f"{injected or 'nothing'}")
+        if disk_state is not None:
+            injected = ", ".join(
+                f"{k}={v}" for k, v in disk_state.injected.items() if v
+            )
+            p.echo(f"disk-chaos(seed={disk_state.seed}): injected "
+                   f"{injected or 'nothing'}")
         return 0
     except UsageError as e:
         # Flag-combination errors (e.g. --sharded with -u or CRAM) present
@@ -1072,6 +1239,8 @@ def main(argv=None) -> int:
             os.environ.pop("SPARK_BAM_PROFILE", None)
         if chaos_state is not None:
             uninstall_chaos()
+        if disk_state is not None:
+            uninstall_disk_chaos()
         if getattr(args, "remote", None) is not None:
             from spark_bam_tpu.core.remote_plan import set_remote_config
 

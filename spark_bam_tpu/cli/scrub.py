@@ -1,0 +1,33 @@
+"""``spark-bam-tpu scrub`` — end-to-end artifact integrity scrubbing.
+
+Walks rewritten BAMs, their ``.blocks``/``.records``/``.sbi`` sidecars
+and native columnar containers through jobs/scrub.py: per-frame CRCs,
+structural validation, sidecar cross-checks against the actual BGZF
+member table, and (with ``--source``) spot record-parity against the
+file the artifact was rewritten from. Exit code 0 means every artifact
+came back clean; 3 means findings (listed in the JSON report), with
+``--quarantine`` additionally renaming damaged artifacts to
+``<path>.quarantined`` so a pipeline can't consume them by accident
+(docs/robustness.md "Durable jobs & scrubbing").
+"""
+
+from __future__ import annotations
+
+import json
+
+from spark_bam_tpu.cli.output import Printer
+
+#: exit code when the scrub found (and reported) integrity findings —
+#: distinct from 2 (usage error) and 1 (crash) so CI can branch on it.
+RC_FINDINGS = 3
+
+
+def run(paths, p: Printer, source: "str | None" = None,
+        quarantine: bool = False, stride: int = 16) -> int:
+    from spark_bam_tpu.jobs.scrub import scrub_paths
+
+    report = scrub_paths(
+        paths, source=source, quarantine=quarantine, stride=stride
+    )
+    p.echo(json.dumps(report.summary(), indent=2, sort_keys=True))
+    return 0 if report.clean else RC_FINDINGS

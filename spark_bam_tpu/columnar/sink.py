@@ -14,7 +14,10 @@ schema's large-offset layout is exactly ``large_utf8``/``large_binary``.
 
 from __future__ import annotations
 
+import contextlib
+
 from spark_bam_tpu.core.atomic import AtomicFile as _AtomicFile
+from spark_bam_tpu.core.guard import map_write_error
 from spark_bam_tpu.columnar.native import (
     batch_frame,
     container_head,
@@ -31,6 +34,17 @@ FORMATS = ("native", "arrow", "parquet")
 
 class ColumnarUnavailable(RuntimeError):
     """Requested an Arrow/Parquet sink without pyarrow installed."""
+
+
+@contextlib.contextmanager
+def _guarded(what: str, path: str):
+    """Classify OSErrors escaping a sink write/commit: exhaustion errnos
+    (ENOSPC/EDQUOT/EIO) become the guard taxonomy's retryable
+    ``ResourceExhausted`` instead of bypassing fault classification."""
+    try:
+        yield
+    except OSError as exc:
+        raise map_write_error(exc, what, path=path) from exc
 
 
 def _pyarrow():
@@ -50,25 +64,29 @@ class NativeSink:
 
     def __init__(self, out_path: str, meta: dict):
         self.meta = meta
+        self.out_path = str(out_path)
         self._file = _AtomicFile(out_path)
         head = container_head(meta)
-        self._file.f.write(head)
+        with _guarded("container write", self.out_path):
+            self._file.f.write(head)
         self.rows = 0
         self.batches = 0
         self.bytes_out = len(head)
 
     def write(self, batch: RecordBatch) -> None:
         frame = batch_frame(batch, self.meta)
-        self._file.f.write(frame)
+        with _guarded("container write", self.out_path):
+            self._file.f.write(frame)
         self.rows += batch.num_rows
         self.batches += 1
         self.bytes_out += len(frame)
 
     def close(self) -> None:
         tail = end_frame(self.rows, self.batches)
-        self._file.f.write(tail)
-        self.bytes_out += len(tail)
-        self._file.commit()
+        with _guarded("container commit", self.out_path):
+            self._file.f.write(tail)
+            self.bytes_out += len(tail)
+            self._file.commit()
 
     def abort(self) -> None:
         self._file.abort()
@@ -99,6 +117,7 @@ class ArrowSink:
     def __init__(self, out_path: str, meta: dict):
         self.pa = _pyarrow()
         self.meta = meta
+        self.out_path = str(out_path)
         self._file = _AtomicFile(out_path)
         self._writer = None
         self.rows = 0
@@ -107,24 +126,27 @@ class ArrowSink:
 
     def write(self, batch: RecordBatch) -> None:
         ab = to_arrow_batch(batch)
-        if self._writer is None:
-            self._writer = self.pa.ipc.new_file(self._file.f, ab.schema)
-        self._writer.write_batch(ab)
+        with _guarded("arrow write", self.out_path):
+            if self._writer is None:
+                self._writer = self.pa.ipc.new_file(self._file.f, ab.schema)
+            self._writer.write_batch(ab)
         self.rows += batch.num_rows
         self.batches += 1
 
     def close(self) -> None:
-        if self._writer is None:
-            # Zero batches: still a valid (empty) IPC file with the schema.
-            from spark_bam_tpu.columnar.schema import BatchBuilder
+        with _guarded("arrow commit", self.out_path):
+            if self._writer is None:
+                # Zero batches: still a valid (empty) IPC file with the
+                # schema.
+                from spark_bam_tpu.columnar.schema import BatchBuilder
 
-            empty = BatchBuilder(self.meta["columns"]).build()
-            self._writer = self.pa.ipc.new_file(
-                self._file.f, to_arrow_batch(empty).schema
-            )
-        self._writer.close()
-        self.bytes_out = self._file.f.tell()
-        self._file.commit()
+                empty = BatchBuilder(self.meta["columns"]).build()
+                self._writer = self.pa.ipc.new_file(
+                    self._file.f, to_arrow_batch(empty).schema
+                )
+            self._writer.close()
+            self.bytes_out = self._file.f.tell()
+            self._file.commit()
 
     def abort(self) -> None:
         self._file.abort()
@@ -140,6 +162,7 @@ class ParquetSink:
 
         self.pq = pq
         self.meta = meta
+        self.out_path = str(out_path)
         self._file = _AtomicFile(out_path)
         self._writer = None
         self.rows = 0
@@ -148,23 +171,25 @@ class ParquetSink:
 
     def write(self, batch: RecordBatch) -> None:
         ab = to_arrow_batch(batch)
-        if self._writer is None:
-            self._writer = self.pq.ParquetWriter(self._file.f, ab.schema)
-        self._writer.write_table(self.pa.Table.from_batches([ab]))
+        with _guarded("parquet write", self.out_path):
+            if self._writer is None:
+                self._writer = self.pq.ParquetWriter(self._file.f, ab.schema)
+            self._writer.write_table(self.pa.Table.from_batches([ab]))
         self.rows += batch.num_rows
         self.batches += 1
 
     def close(self) -> None:
-        if self._writer is None:
-            from spark_bam_tpu.columnar.schema import BatchBuilder
+        with _guarded("parquet commit", self.out_path):
+            if self._writer is None:
+                from spark_bam_tpu.columnar.schema import BatchBuilder
 
-            empty = BatchBuilder(self.meta["columns"]).build()
-            ab = to_arrow_batch(empty)
-            self._writer = self.pq.ParquetWriter(self._file.f, ab.schema)
-            self._writer.write_table(self.pa.Table.from_batches([ab]))
-        self._writer.close()
-        self.bytes_out = self._file.f.tell()
-        self._file.commit()
+                empty = BatchBuilder(self.meta["columns"]).build()
+                ab = to_arrow_batch(empty)
+                self._writer = self.pq.ParquetWriter(self._file.f, ab.schema)
+                self._writer.write_table(self.pa.Table.from_batches([ab]))
+            self._writer.close()
+            self.bytes_out = self._file.f.tell()
+            self._file.commit()
 
     def abort(self) -> None:
         self._file.abort()

@@ -7,11 +7,40 @@ BAM, that would be a truncated file with no EOF sentinel that readers
 would trust). The temp name is pid-suffixed so concurrent writers to
 the same target cannot interleave; the loser of the final ``os.replace``
 race simply overwrites the winner with an equally complete file.
+
+Commit durability is two fsyncs: the file's bytes *and* the containing
+directory after the rename — ``os.replace`` alone only updates the
+directory in the page cache, so a power loss after "commit" could roll
+the rename back (the file would still be at its temp name, or gone).
+Writes, the rename and the fsyncs route through the disk-chaos seam
+(core/faults.py ``wrap_disk``/``disk_replace``) so the durable-job
+tests can inject ENOSPC/torn-write/rename failures deterministically.
 """
 
 from __future__ import annotations
 
 import os
+
+from spark_bam_tpu.core import faults as _faults
+
+
+def fsync_dir(path: str) -> None:
+    """fsync the directory containing ``path`` — the half of a durable
+    rename ``os.replace`` doesn't do. Best-effort: platforms that refuse
+    ``open()`` on directories (or fsync on them) skip silently; the
+    rename is still atomic there, just not power-loss durable."""
+    parent = os.path.dirname(os.path.abspath(str(path))) or "."
+    flags = os.O_RDONLY | getattr(os, "O_DIRECTORY", 0)
+    try:
+        fd = os.open(parent, flags)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
 
 
 class AtomicFile:
@@ -20,13 +49,14 @@ class AtomicFile:
     def __init__(self, out_path: str):
         self.out_path = str(out_path)
         self.tmp_path = f"{self.out_path}.tmp.{os.getpid()}"
-        self.f = open(self.tmp_path, "wb")
+        self.f = _faults.wrap_disk(open(self.tmp_path, "wb"))
 
     def commit(self) -> None:
         self.f.flush()
         os.fsync(self.f.fileno())
         self.f.close()
-        os.replace(self.tmp_path, self.out_path)
+        _faults.disk_replace(self.tmp_path, self.out_path)
+        fsync_dir(self.out_path)
 
     def abort(self) -> None:
         try:

@@ -163,6 +163,22 @@ class Config:
     # health probe/eject pacing, and the SLO autoscaler's target and
     # actuation floors/ceilings.
     fabric: str = ""
+    # --- durable job plane (jobs/; docs/robustness.md) ---
+    # Compact JobsConfig spec ("dir=/var/jobs,checkpoint=5000,frames=8,
+    # mem=0.92,max=2"; "" = defaults). Same string-spec pattern;
+    # ``jobs_config`` parses it. Governs the WAL job directory, the
+    # checkpoint cadence for journaled rewrite/export/transcode, and the
+    # manager's admission watermarks (max concurrent jobs, host-memory
+    # fraction above which submits defer).
+    jobs: str = ""
+    # --- disk-fault chaos seam (core/faults.py; docs/robustness.md) ---
+    # "SEED:SPEC" (e.g. "9:enospc=0.05+torn=0.01"; "" = off). Carried as
+    # a Config knob so SPARK_BAM_DISK_CHAOS round-trips through
+    # ``Config.from_env`` into pool workers; installation itself happens
+    # at process entry (``maybe_install_disk_chaos_from_env`` /
+    # ``--disk-chaos``), not lazily — a seam that appears mid-run would
+    # make the seeded fault schedule depend on call order.
+    disk_chaos: str = ""
     # --- on-device aggregation plane (agg/; docs/analytics.md) ---
     # Compact AggConfig spec ("coverage:bin=1000,bins=512;flagstat;mapq;
     # tlen:max=2000;count"; "" = every metric at defaults). Same
@@ -278,6 +294,21 @@ class Config:
         from spark_bam_tpu.fabric.config import FabricConfig
 
         return FabricConfig.parse(self.fabric)
+
+    @property
+    def jobs_config(self):
+        """The parsed ``JobsConfig`` for this config's ``jobs`` spec."""
+        from spark_bam_tpu.jobs.manager import JobsConfig
+
+        return JobsConfig.parse(self.jobs)
+
+    @property
+    def disk_chaos_config(self):
+        """The parsed ``(seed, DiskChaosSpec)`` for this config's
+        ``disk_chaos`` spec, or ``None`` when off."""
+        from spark_bam_tpu.core.faults import parse_disk_chaos
+
+        return parse_disk_chaos(self.disk_chaos) if self.disk_chaos else None
 
     @property
     def agg_config(self):

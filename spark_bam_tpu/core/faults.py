@@ -467,3 +467,209 @@ def chaos(arg: str | tuple[int, ChaosSpec]):
         yield state
     finally:
         uninstall_chaos()
+
+
+# -------------------------------------------------------------- disk chaos
+# Filesystem-seam fault injection (the write-side mirror of ChaosChannel):
+# the durable-job plane (jobs/) and AtomicFile route their writes, fsyncs
+# and renames through these hooks, so ENOSPC mid-segment, a torn journal
+# append or a failed commit rename are all reproducible from one seed.
+# Decisions are op-indexed (the Nth write/rename of the process rolls
+# kind-keyed splitmix64), not offset-keyed: write streams have no stable
+# offsets the way read requests do.
+_K_ENOSPC, _K_EIO, _K_SHORTW, _K_TORN, _K_RENAME = 21, 22, 23, 24, 25
+
+
+@dataclass(frozen=True)
+class DiskChaosSpec:
+    """Which filesystem faults to inject and how often (rates are per
+    operation: write calls for the first four kinds, renames for the
+    last)."""
+
+    enospc: float = 0.0   # raise ENOSPC before writing anything
+    eio: float = 0.0      # raise EIO before writing anything
+    short: float = 0.0    # write a prefix, then raise EIO ("failed mid-write")
+    torn: float = 0.0     # write a prefix, report success (power-loss tail)
+    rename: float = 0.0   # os.replace raises EIO
+
+    _KINDS = {
+        "enospc": _K_ENOSPC, "eio": _K_EIO, "short": _K_SHORTW,
+        "torn": _K_TORN, "rename": _K_RENAME,
+    }
+
+    @staticmethod
+    def parse(spec: str) -> "DiskChaosSpec":
+        """``"enospc=0.05+eio=0.02+short=0.02+torn=0.01+rename=0.1"`` —
+        ``+``-separated like the fabric chaos grammar, so the whole spec
+        embeds in ``,``-separated config strings (``disk=SEED:SPEC``)."""
+        kw: dict = {}
+        for part in (spec or "").split("+"):
+            part = part.strip()
+            if not part:
+                continue
+            if "=" not in part:
+                raise ValueError(f"Bad disk-chaos entry {part!r} in {spec!r}")
+            key, value = (t.strip() for t in part.split("=", 1))
+            if key not in DiskChaosSpec._KINDS:
+                raise ValueError(
+                    f"Unknown disk-chaos key {key!r}: expected one of "
+                    f"{', '.join(sorted(DiskChaosSpec._KINDS))}"
+                )
+            kw[key] = float(value)
+        return DiskChaosSpec(**kw)
+
+
+def parse_disk_chaos(arg: str) -> "tuple[int, DiskChaosSpec]":
+    """``"SEED:SPEC"`` (the ``--disk-chaos`` argument / ``disk=`` key)."""
+    seed, _, spec = arg.partition(":")
+    try:
+        seed_i = int(seed)
+    except ValueError:
+        raise ValueError(
+            f"Bad disk-chaos seed {seed!r} in {arg!r} (want SEED:SPEC)"
+        )
+    return seed_i, DiskChaosSpec.parse(spec)
+
+
+class DiskChaosState:
+    """One installation's decision state: a monotone per-kind op counter
+    (so the fault schedule is a pure function of the seed and the
+    process's operation order) plus injected tallies for assertions."""
+
+    def __init__(self, seed: int, spec: DiskChaosSpec):
+        self.seed = seed
+        self.spec = spec
+        self.lock = threading.Lock()
+        self._n = {k: 0 for k in DiskChaosSpec._KINDS.values()}
+        self.injected: dict[str, int] = {k: 0 for k in DiskChaosSpec._KINDS}
+
+    def roll(self, name: str) -> bool:
+        rate = getattr(self.spec, name)
+        kind = DiskChaosSpec._KINDS[name]
+        with self.lock:
+            n = self._n[kind]
+            self._n[kind] = n + 1
+        if not _roll(self.seed, kind, n, rate):
+            return False
+        with self.lock:
+            self.injected[name] += 1
+        return True
+
+
+_disk: DiskChaosState | None = None
+
+
+def install_disk_chaos(arg: "str | tuple[int, DiskChaosSpec]") -> DiskChaosState:
+    global _disk
+    seed, spec = parse_disk_chaos(arg) if isinstance(arg, str) else arg
+    _disk = DiskChaosState(seed, spec)
+    from spark_bam_tpu.obs import flight
+    flight.set_context(
+        disk_chaos_seed=seed,
+        disk_chaos_spec=arg if isinstance(arg, str) else f"{seed}:{spec}",
+    )
+    return _disk
+
+
+def uninstall_disk_chaos() -> None:
+    global _disk
+    _disk = None
+    from spark_bam_tpu.obs import flight
+    flight.clear_context("disk_chaos_seed", "disk_chaos_spec")
+
+
+def installed_disk_chaos() -> DiskChaosState | None:
+    return _disk
+
+
+def maybe_install_disk_chaos_from_env(env=None) -> DiskChaosState | None:
+    """Install from ``SPARK_BAM_DISK_CHAOS`` when set (how fabric workers
+    inherit the seam from the pool's environment); no-op otherwise."""
+    import os
+
+    arg = (env or os.environ).get("SPARK_BAM_DISK_CHAOS", "")
+    return install_disk_chaos(arg) if arg else None
+
+
+@contextlib.contextmanager
+def disk_chaos(arg: "str | tuple[int, DiskChaosSpec]"):
+    """``with disk_chaos("7:enospc=0.1"): ...`` — scoped, for tests."""
+    state = install_disk_chaos(arg)
+    try:
+        yield state
+    finally:
+        uninstall_disk_chaos()
+
+
+class _DiskChaosFile:
+    """Write-through wrapper applying the installed disk faults to one
+    file object. Only constructed when chaos is installed (``wrap_disk``)
+    — the unconfigured write path keeps zero chaos branches."""
+
+    def __init__(self, f, state: DiskChaosState):
+        self._f = f
+        self._state = state
+
+    def write(self, data) -> int:
+        import errno as _errno
+
+        state = self._state
+        n = len(data)
+        if n and state.roll("enospc"):
+            obs.count("chaos.disk_enospc")
+            raise OSError(
+                _errno.ENOSPC,
+                f"disk chaos(seed={state.seed}): injected ENOSPC",
+            )
+        if n and state.roll("eio"):
+            obs.count("chaos.disk_eio")
+            raise OSError(
+                _errno.EIO, f"disk chaos(seed={state.seed}): injected EIO"
+            )
+        if n > 1 and state.roll("short"):
+            obs.count("chaos.disk_short_writes")
+            self._f.write(data[: n // 2])
+            raise OSError(
+                _errno.EIO,
+                f"disk chaos(seed={state.seed}): write failed after "
+                f"{n // 2}/{n} bytes",
+            )
+        if n > 1 and state.roll("torn"):
+            # The power-loss signature: the call "succeeds" but only a
+            # prefix is durable. Only recovery-time CRC/size validation
+            # (journal framing, segment-length checks) can see it.
+            obs.count("chaos.disk_torn_writes")
+            self._f.write(data[: n // 2])
+            return n
+        return self._f.write(data)
+
+    def __getattr__(self, name):
+        return getattr(self._f, name)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self._f.close()
+
+
+def wrap_disk(f):
+    """Wrap a binary file object in the installed disk-chaos seam; the
+    identity function when no disk chaos is installed."""
+    return f if _disk is None else _DiskChaosFile(f, _disk)
+
+
+def disk_replace(src, dst) -> None:
+    """``os.replace`` through the rename-fail seam."""
+    import os
+
+    if _disk is not None and _disk.roll("rename"):
+        import errno as _errno
+
+        obs.count("chaos.disk_rename_fails")
+        raise OSError(
+            _errno.EIO,
+            f"disk chaos(seed={_disk.seed}): injected rename failure "
+            f"({src} -> {dst})",
+        )
+    os.replace(src, dst)

@@ -41,6 +41,7 @@ docs/robustness.md ("Malformed inputs").
 from __future__ import annotations
 
 import contextlib
+import errno
 import os
 import threading
 from dataclasses import dataclass
@@ -103,6 +104,64 @@ class RecordGapError(IOError, Unrecoverable):
         super().__init__(f"unreadable BAM record at {pos}: {reason}")
         self.pos = pos
         self.reason = reason
+
+
+class ResourceExhausted(OSError):
+    """The environment ran out of a resource mid-operation — disk space
+    (``ENOSPC``), quota (``EDQUOT``), a failing device (``EIO``) — while
+    writing an artifact. Retryable by the fault model (an ``OSError``
+    that is *not* ``Unrecoverable``): space gets freed, quotas get
+    raised, devices get replaced. The durable-job plane (jobs/) pauses
+    a journaled job on this instead of failing it; resume picks up from
+    the last committed checkpoint."""
+
+    def __init__(self, msg: str, *, errno_: "int | None" = None, path=None):
+        super().__init__(errno_ or 0, msg, str(path) if path else None)
+
+
+#: errnos that mean "the environment is out of a resource" rather than
+#: "these bytes/paths are wrong" — the write-side mirror of the
+#: read-side transient set.
+_EXHAUSTED_ERRNOS = frozenset(
+    getattr(errno, name)
+    for name in ("ENOSPC", "EDQUOT", "EIO", "ENOMEM")
+    if hasattr(errno, name)
+)
+
+
+def map_write_error(exc: OSError, what: str, path=None) -> OSError:
+    """Classify an ``OSError`` escaping a writer: exhaustion errnos become
+    :class:`ResourceExhausted` (retryable, job-pausing); anything else is
+    returned unchanged so deterministic errors (``EACCES``, ``ENOENT``)
+    keep their type. Callers ``raise map_write_error(e, ...) from e``."""
+    if isinstance(exc, ResourceExhausted):
+        return exc
+    if exc.errno in _EXHAUSTED_ERRNOS:
+        return ResourceExhausted(
+            f"{what}: {exc.strerror or exc}", errno_=exc.errno, path=path
+        )
+    return exc
+
+
+def preflight_space(path, need_bytes: int, margin: float = 1.1) -> None:
+    """ENOSPC preflight: refuse to *start* a write that cannot fit.
+    ``need_bytes`` is the caller's estimate; ``margin`` covers metadata
+    and estimate error. Best-effort — filesystems without ``statvfs``
+    skip the check and rely on the mid-write mapping instead."""
+    if need_bytes <= 0:
+        return
+    target = os.path.dirname(os.path.abspath(str(path))) or "."
+    try:
+        st = os.statvfs(target)
+    except (OSError, AttributeError):
+        return
+    free = st.f_bavail * st.f_frsize
+    if free < need_bytes * margin:
+        raise ResourceExhausted(
+            f"preflight: {path} needs ~{int(need_bytes * margin)} bytes, "
+            f"filesystem has {free} free",
+            errno_=errno.ENOSPC, path=path,
+        )
 
 
 # ------------------------------------------------------------------- limits

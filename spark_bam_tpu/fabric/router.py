@@ -69,10 +69,18 @@ from spark_bam_tpu.serve.server import MAX_LINE, ServeAddress
 
 #: ops safe to re-dispatch after a mid-request worker death: pure reads
 #: whose answers are deterministic for unchanged files, plus ``rewrite``
-#: (its output commit is atomic — a re-run overwrites, never interleaves).
+#: (its output commit is atomic — a re-run overwrites, never interleaves)
+#: and the durable-job control ops (``submit`` keys jobs by a
+#: deterministic spec hash and resumes from the journal, so a replayed
+#: submit re-attaches instead of double-running; status/cancel are pure
+#: table lookups).
 IDEMPOTENT_OPS = frozenset(
-    {"plan", "record_starts", "count", "batch", "aggregate", "rewrite"}
+    {"plan", "record_starts", "count", "batch", "aggregate", "rewrite",
+     "submit", "job_status", "job_cancel"}
 )
+
+#: job states the orphan watchdog stops tracking.
+_JOB_TERMINAL = frozenset({"done", "failed", "cancelled"})
 
 
 class WorkerLost(ConnectionError):
@@ -300,6 +308,11 @@ class Router:
         # cites the firing SLO objective when one drove the move, so the
         # ``alerts`` op answers "why did the fleet downscale" by itself.
         self.moves: "deque[dict]" = deque(maxlen=256)
+        # Durable-job ownership: job_id → {"req": original submit,
+        # "wid": owning worker, "state": last seen}. The watchdog
+        # re-dispatches jobs whose owner died (journal resume on the
+        # survivor makes that safe); status/cancel route to the owner.
+        self._job_owners: "dict[str, dict]" = {}
         self._tasks: "list[asyncio.Task]" = []
         self._start_task: "asyncio.Task | None" = None
         self._loop: "asyncio.AbstractEventLoop | None" = None
@@ -336,6 +349,7 @@ class Router:
                                  note_move=self._note_move,
                                  hold=self._autoscale_hold)
             ))
+        self._tasks.append(asyncio.ensure_future(self._job_watchdog()))
 
     async def aclose(self) -> None:
         for t in self._tasks:
@@ -447,6 +461,8 @@ class Router:
             return error_response(
                 req, "Draining", "fabric is draining; route elsewhere",
             )
+        if op in ("submit", "job_status", "job_cancel"):
+            return await self._route_job(req)
         return await self._route(req)
 
     async def _relay(self, link: WorkerLink, req: dict,
@@ -548,6 +564,118 @@ class Router:
             )
         self._count("relayed_overload")
         return shed_resp
+
+    # ------------------------------------------------------------ job plane
+    def _link_by_wid(self, wid: str) -> "WorkerLink | None":
+        return next((l for l in self.links if l.wid == wid), None)
+
+    def _note_job(self, jid: str, resp: dict, req=None, wid=None) -> None:
+        """Update the ownership table from a job response."""
+        entry = self._job_owners.get(jid)
+        if entry is None:
+            if req is None or wid is None:
+                return
+            entry = self._job_owners[jid] = {"req": dict(req), "wid": wid}
+        if wid is not None:
+            entry["wid"] = wid
+        state = resp.get("state")
+        if state:
+            entry["state"] = state
+
+    async def _route_job(self, req: dict) -> dict:
+        """Durable-job control routing: ``submit`` places by path
+        affinity (failing over across workers — the deterministic job id
+        + shared journal dir make a re-dispatch resume, not restart);
+        ``job_status``/``job_cancel`` go to the job's owning worker."""
+        op = req.get("op")
+        ctx = obs_trace.from_carrier(req.get("trace"))
+        if ctx is None and obs.enabled():
+            ctx = obs_trace.mint()
+        self.budget.note_request()
+        if op == "submit":
+            tried: set = set()
+            while True:
+                link = self.pick(req.get("path"), exclude=tried)
+                if link is None:
+                    return error_response(
+                        req, "WorkerLost",
+                        "no healthy workers in the fabric",
+                    )
+                tried.add(link.wid)
+                try:
+                    resp = await self._relay(link, req, ctx)
+                except WorkerLost:
+                    if not self.budget.try_spend():
+                        self._count("lost")
+                        self._count("budget_exhausted")
+                        return error_response(
+                            req, "WorkerLost",
+                            f"worker {link.wid} died mid-submit; "
+                            "retry budget exhausted",
+                        )
+                    self._count("failovers")
+                    self._count("budget_spent")
+                    continue
+                if resp.get("ok") and resp.get("job_id"):
+                    self._note_job(
+                        resp["job_id"], resp, req=req, wid=link.wid
+                    )
+                self._count("routed")
+                return resp
+        # status / cancel: prefer the owner; any healthy worker can
+        # answer after a rescue re-homed the job.
+        jid = req.get("job_id")
+        entry = self._job_owners.get(jid) if jid else None
+        link = None
+        if entry is not None:
+            owner = self._link_by_wid(entry["wid"])
+            if owner is not None and owner.healthy and not owner.draining:
+                link = owner
+        if link is None:
+            link = self.pick(None)
+        if link is None:
+            return error_response(
+                req, "WorkerLost", "no healthy workers in the fabric",
+            )
+        try:
+            resp = await self._relay(link, req, ctx)
+        except WorkerLost:
+            return error_response(
+                req, "WorkerLost", f"worker {link.wid} died mid-{op}",
+            )
+        if resp.get("ok") and jid:
+            self._note_job(jid, resp)
+        self._count("routed")
+        return resp
+
+    async def _job_watchdog(self) -> None:
+        """Orphan rescue: a tracked, non-terminal job whose owning link
+        is down gets its original ``submit`` re-dispatched to a
+        survivor, which resumes it from the journal (shared jobs dir).
+        Budget-gated like any failover."""
+        interval = max(self.fcfg.probe_ms / 1000.0, 0.05)
+        while True:
+            await asyncio.sleep(interval)
+            for jid, entry in list(self._job_owners.items()):
+                if entry.get("state") in _JOB_TERMINAL:
+                    continue
+                owner = self._link_by_wid(entry["wid"])
+                if owner is not None and owner.healthy:
+                    continue
+                nxt = self.pick(entry["req"].get("path"),
+                                exclude={entry["wid"]})
+                if nxt is None or not self.budget.try_spend():
+                    continue
+                self._count("budget_spent")
+                try:
+                    resp = await self._relay(nxt, dict(entry["req"]), None)
+                except WorkerLost:
+                    continue
+                if resp.get("ok"):
+                    self._count("job_rescues")
+                    flight.record("job_rescue", job_id=jid,
+                                  worker=nxt.wid, was=entry["wid"])
+                    self._note_job(jid, resp, wid=nxt.wid)
 
     # ------------------------------------------------------------ streaming
     async def _stream_open(self, link: WorkerLink, req: dict,

@@ -82,6 +82,14 @@ def serve_worker(
     if not obs.enabled():
         obs.configure()
 
+    # Disk-fault chaos rides the environment into pool workers exactly
+    # like fabric chaos rides SPARK_BAM_FABRIC: the storm tests set
+    # SPARK_BAM_DISK_CHAOS before spawning, every worker injects the
+    # same seeded fault schedule, and the flight context names it.
+    from spark_bam_tpu.core.faults import maybe_install_disk_chaos_from_env
+
+    maybe_install_disk_chaos_from_env()
+
     config = Config.from_env()
     if serve:
         config = config.replace(serve=serve)

@@ -1,0 +1,17 @@
+"""Durable job plane: crash-resumable rewrite/export/transcode.
+
+Long-running mutations (re-compress a BAM, export a flowcell to
+columnar) get a write-ahead journal (``journal.py``), checkpointed
+segment output, a manager with serve-op admission (``manager.py``) and
+an end-to-end integrity scrubber (``scrub.py``). A job killed at any
+point — SIGKILL, ENOSPC, a yanked disk — resumes from its last durable
+checkpoint and produces a final artifact byte-identical to an
+uninterrupted run (docs/robustness.md, "Durable jobs & scrubbing").
+"""
+
+from spark_bam_tpu.jobs.journal import (  # noqa: F401
+    Journal,
+    JournalError,
+    SegmentedOutput,
+)
+from spark_bam_tpu.jobs.manager import JobManager, JobsConfig  # noqa: F401

@@ -1,0 +1,328 @@
+"""CRC32-framed append-only write-ahead journal + segmented output.
+
+The journal is line-oriented JSONL with a per-line checksum frame::
+
+    SBJ1 <crc32:08x> <json>\\n
+
+- Every record is a JSON object carrying a ``"t"`` tag ("spec",
+  "ckpt", "seg", "done", ...). Readers skip records whose tag they do
+  not recognize — the same unknown-tag forward-compat discipline as the
+  ``.sbi`` container — so an old scrubber can walk a new journal.
+- Recovery truncates the torn tail: appends land with fsync, but a
+  crash (or injected torn write, core/faults.py) can leave a partial
+  final line. The first line that fails its frame (bad magic, bad CRC,
+  bad JSON, no newline) ends the valid prefix; everything after it is
+  discarded and the file is truncated back to the durable prefix.
+- A non-empty file that does not *start* with the magic is not a
+  journal at all — that is a clean reject (:class:`JournalError`),
+  never a truncate-to-zero of somebody else's file.
+
+Output never goes to the final artifact path directly: it lands as
+committed segment files (``seg-00000``, ``seg-00001``, ...) via
+:class:`SegmentedOutput`, each renamed into place only after an
+fsync + size check, with a journal checkpoint recorded *after* the
+segment is durable. Resume replays the journal, keeps every committed
+segment, deletes orphaned ``.part`` files (work after the last
+checkpoint, counted as ``jobs.redone_bytes``) and restarts the
+producer from the checkpointed state — so the assembled artifact is
+byte-identical to an uninterrupted run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import zlib
+
+from spark_bam_tpu import obs
+from spark_bam_tpu.core import faults as _faults
+from spark_bam_tpu.core.atomic import AtomicFile, fsync_dir
+from spark_bam_tpu.core.faults import Unrecoverable
+from spark_bam_tpu.core.guard import map_write_error
+
+MAGIC = "SBJ1"
+#: tags this version understands; anything else is skipped on read.
+KNOWN_TAGS = frozenset({"spec", "ckpt", "seg", "done", "note"})
+
+
+class JournalError(ValueError, Unrecoverable):
+    """The file at the journal path is not a journal (wrong magic at
+    offset 0) or a record violates the format in a way recovery must
+    not paper over. Deterministic damage — never retried, never
+    auto-truncated."""
+
+
+def _frame(record: dict) -> bytes:
+    payload = json.dumps(
+        record, separators=(",", ":"), sort_keys=True
+    ).encode("utf-8")
+    crc = zlib.crc32(payload) & 0xFFFFFFFF
+    return b"%s %08x %s\n" % (MAGIC.encode(), crc, payload)
+
+
+def _parse_line(line: bytes) -> "dict | None":
+    """One framed line → record, or ``None`` when the frame is invalid
+    (torn tail / flipped bytes). Caller decides whether ``None`` means
+    truncate-here (tail) or reject (head)."""
+    if not line.endswith(b"\n"):
+        return None
+    body = line[:-1]
+    parts = body.split(b" ", 2)
+    if len(parts) != 3 or parts[0] != MAGIC.encode():
+        return None
+    try:
+        crc = int(parts[1], 16)
+    except ValueError:
+        return None
+    if len(parts[1]) != 8 or (zlib.crc32(parts[2]) & 0xFFFFFFFF) != crc:
+        return None
+    try:
+        record = json.loads(parts[2])
+    except ValueError:
+        return None
+    return record if isinstance(record, dict) else None
+
+
+def read_journal(path) -> "list[dict]":
+    """Parse the durable prefix of a journal without modifying the file.
+    Returns the known-tag records in order; unknown tags are counted
+    (``jobs.journal_skipped``) and dropped. Raises :class:`JournalError`
+    if the file exists, is non-empty, and does not start with the
+    magic."""
+    records, _ = _scan(path)
+    return records
+
+
+def _scan(path) -> "tuple[list[dict], int]":
+    """(known-tag records of the valid prefix, byte length of that
+    prefix)."""
+    try:
+        with open(path, "rb") as f:
+            raw = f.read()
+    except FileNotFoundError:
+        return [], 0
+    if raw and not raw.startswith(MAGIC.encode() + b" "):
+        raise JournalError(
+            f"{path} is not a job journal (missing {MAGIC!r} magic); "
+            "refusing to recover over a foreign file"
+        )
+    records: "list[dict]" = []
+    good = 0
+    pos = 0
+    while pos < len(raw):
+        nl = raw.find(b"\n", pos)
+        line = raw[pos: nl + 1] if nl >= 0 else raw[pos:]
+        record = _parse_line(line)
+        if record is None:
+            break  # torn tail (or flipped byte): durable prefix ends here
+        pos = nl + 1
+        good = pos
+        tag = record.get("t")
+        if tag in KNOWN_TAGS:
+            records.append(record)
+        else:
+            obs.count("jobs.journal_skipped")
+    return records, good
+
+
+class Journal:
+    """Append-only, fsync-per-record journal with torn-tail recovery.
+
+    ``Journal.open`` recovers: it truncates any torn tail back to the
+    last valid line (counting ``jobs.journal_truncated``) and exposes
+    the surviving records as ``.records``. Appends go through the
+    disk-chaos seam so the fault-injection tests can tear them."""
+
+    def __init__(self, path, records: "list[dict]", f):
+        self.path = str(path)
+        self.records = records
+        self._f = f
+
+    @classmethod
+    def open(cls, path) -> "Journal":
+        records, good = _scan(path)
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            size = 0
+        if size > good:
+            # Torn tail: cut back to the durable prefix. The magic check
+            # in _scan already guaranteed this is our file.
+            with open(path, "r+b") as f:
+                f.truncate(good)
+                f.flush()
+                os.fsync(f.fileno())
+            obs.count("jobs.journal_truncated")
+        f = _faults.wrap_disk(open(path, "ab"))
+        return cls(path, records, f)
+
+    def append(self, record: dict) -> None:
+        """Durably append one record: write + flush + fsync, mapped into
+        the guard taxonomy on failure (a full disk pauses the job, it
+        does not corrupt the journal — the torn frame is cut on the
+        next recovery)."""
+        data = _frame(record)
+        try:
+            self._f.write(data)
+            self._f.flush()
+            os.fsync(self._f.fileno())
+        except OSError as exc:
+            raise map_write_error(
+                exc, "journal append", path=self.path
+            ) from exc
+        self.records.append(record)
+        obs.count("jobs.journal_appends")
+
+    def last(self, tag: str) -> "dict | None":
+        for record in reversed(self.records):
+            if record.get("t") == tag:
+                return record
+        return None
+
+    def close(self) -> None:
+        try:
+            self._f.close()
+        except OSError:
+            pass
+
+
+class SegmentedOutput:
+    """Checkpointed output: bytes land in ``seg-NNNNN`` files, each
+    committed (fsync + size check + rename + dir fsync) before the
+    journal records the checkpoint that covers it."""
+
+    def __init__(self, directory):
+        self.dir = str(directory)
+        os.makedirs(self.dir, exist_ok=True)
+        self._f = None
+        self._index = -1
+        self._written = 0
+
+    def _name(self, index: int) -> str:
+        return os.path.join(self.dir, f"seg-{index:05d}")
+
+    def committed(self) -> "list[str]":
+        """Committed segment paths in order, stopping at the first gap
+        (a gap means the journal checkpoint sequence ends there too)."""
+        out = []
+        i = 0
+        while os.path.exists(self._name(i)):
+            out.append(self._name(i))
+            i += 1
+        return out
+
+    def discard_parts(self) -> int:
+        """Delete orphaned ``.part`` files (work lost past the last
+        durable checkpoint); returns the byte count discarded — the
+        resume's ``redone_bytes``."""
+        lost = 0
+        try:
+            entries = os.listdir(self.dir)
+        except OSError:
+            return 0
+        for name in entries:
+            if name.endswith(".part"):
+                full = os.path.join(self.dir, name)
+                try:
+                    lost += os.path.getsize(full)
+                    os.unlink(full)
+                except OSError:
+                    pass
+        return lost
+
+    def begin(self, index: int):
+        """Open ``seg-<index>.part`` for writing; returns the chaos-
+        wrapped file object."""
+        assert self._f is None, "previous segment not committed/aborted"
+        self._index = index
+        self._written = 0
+        path = self._name(index) + ".part"
+        try:
+            self._f = _faults.wrap_disk(open(path, "wb"))
+        except OSError as exc:
+            raise map_write_error(
+                exc, "segment open", path=path
+            ) from exc
+        return self._f
+
+    def write(self, data: bytes) -> None:
+        try:
+            self._f.write(data)
+        except OSError as exc:
+            raise map_write_error(
+                exc, "segment write", path=self._name(self._index) + ".part"
+            ) from exc
+        self._written += len(data)
+
+    def commit(self) -> "tuple[str, int]":
+        """Durably commit the open segment: flush + fsync, verify the
+        on-disk size matches the bytes handed to :meth:`write` (catches
+        silently-torn writes), rename ``.part`` → final, fsync the
+        directory. Returns (path, bytes)."""
+        part = self._name(self._index) + ".part"
+        final = self._name(self._index)
+        try:
+            self._f.flush()
+            os.fsync(self._f.fileno())
+            size = os.fstat(self._f.fileno()).st_size
+            self._f.close()
+            if size != self._written:
+                raise OSError(
+                    5,  # EIO: the device lied about a write
+                    f"segment {part}: wrote {self._written} bytes, "
+                    f"disk holds {size}",
+                )
+            _faults.disk_replace(part, final)
+            fsync_dir(final)
+        except OSError as exc:
+            self.abort()
+            raise map_write_error(exc, "segment commit", path=part) from exc
+        self._f = None
+        n, self._written = self._written, 0
+        return final, n
+
+    def abort(self) -> None:
+        if self._f is None:
+            return
+        try:
+            self._f.close()
+        except OSError:
+            pass
+        try:
+            os.unlink(self._name(self._index) + ".part")
+        except OSError:
+            pass
+        self._f = None
+
+    def assemble(self, out_path) -> int:
+        """Concatenate the committed segments into the final artifact,
+        atomically (core/atomic.py). Returns total bytes."""
+        total = 0
+        out = AtomicFile(out_path)
+        try:
+            for seg in self.committed():
+                with open(seg, "rb") as f:
+                    while True:
+                        chunk = f.read(1 << 20)
+                        if not chunk:
+                            break
+                        out.f.write(chunk)
+                        total += len(chunk)
+            out.commit()
+        except OSError as exc:
+            out.abort()
+            raise map_write_error(
+                exc, "artifact assembly", path=out_path
+            ) from exc
+        except BaseException:
+            out.abort()
+            raise
+        return total
+
+    def remove(self) -> None:
+        """Delete the segment files (after a successful assembly)."""
+        for seg in self.committed():
+            try:
+                os.unlink(seg)
+            except OSError:
+                pass

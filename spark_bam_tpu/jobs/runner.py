@@ -1,0 +1,382 @@
+"""Job runners: checkpointed rewrite / export / transcode.
+
+Each runner drives its producer into a :class:`SegmentedOutput`,
+journaling a checkpoint at every durable segment boundary. Checkpoints
+sit on boundaries the producer can re-enter exactly:
+
+- **rewrite/transcode** — BGZF member boundaries. The codec pipeline is
+  force-flushed (every complete payload becomes a member on disk), and
+  the checkpoint records the writer's residual buffer (the <1-block
+  tail that has not been carved into a payload yet), flat/compressed
+  offsets, and the per-segment block/record-start deltas. Resume skips
+  the already-written records on the input side and seeds a fresh
+  ``BgzfWriter`` with the recorded residue — payloads are carved and
+  compressed independently, so the remaining members come out
+  byte-identical to an uninterrupted run (host zlib and fixed device
+  modes; ``mode=auto`` demotion can differ per run and is documented
+  as non-reproducible in docs/robustness.md).
+- **export** — native-container frame boundaries. Frames are a pure
+  function of (query, columnar config) (columnar/export.py), so resume
+  recomputes the stream and skips the first N frames without
+  re-encoding them.
+
+A mid-run ``ResourceExhausted`` (ENOSPC/EIO, real or injected) leaves
+the journal and committed segments intact — the manager pauses the job;
+a later run of the same spec resumes instead of restarting.
+"""
+
+from __future__ import annotations
+
+import base64
+import itertools
+import os
+
+from spark_bam_tpu import obs
+from spark_bam_tpu.bam.writer import BgzfWriter, WriteResult, encode_bam_header
+from spark_bam_tpu.bgzf.block import Metadata
+from spark_bam_tpu.core.config import Config
+from spark_bam_tpu.jobs.journal import Journal, SegmentedOutput
+
+
+class JobCancelled(RuntimeError):
+    """The manager's cancel flag was set; the job stopped at the next
+    record/frame boundary. Committed checkpoints survive — a resubmit
+    resumes, it does not restart."""
+
+
+class _SegSink:
+    """File-object facade over :class:`SegmentedOutput` for writers that
+    expect ``.write()``/``.flush()`` (BgzfWriter, frame emitters)."""
+
+    def __init__(self, segout: SegmentedOutput):
+        self._segout = segout
+
+    def write(self, data: bytes) -> int:
+        self._segout.write(data)
+        return len(data)
+
+    def flush(self) -> None:
+        pass
+
+
+def _flush_members(w: BgzfWriter) -> None:
+    """Force every complete payload through the codec and onto disk,
+    leaving only the residual (<1 block) tail in ``w.buf`` — the state a
+    checkpoint can serialize."""
+    w._dispatch_batch()
+    while w._pending:
+        w._write_oldest()
+
+
+def _drop_uncovered_segments(segout: SegmentedOutput, first: int) -> int:
+    """Delete committed segments the journal does not cover (a crash
+    between segment commit and checkpoint append); returns bytes
+    discarded. The re-run regenerates them byte-identically anyway —
+    deleting keeps 'segments on disk' == 'checkpoints in journal'."""
+    lost = 0
+    i = first
+    while True:
+        path = os.path.join(segout.dir, f"seg-{i:05d}")
+        if not os.path.exists(path):
+            return lost
+        try:
+            lost += os.path.getsize(path)
+            os.unlink(path)
+        except OSError:
+            pass
+        i += 1
+
+
+def _open_job(job_dir: str, spec: dict) -> "tuple[Journal, SegmentedOutput, dict | None, int]":
+    """Recover the journal + segment directory for ``spec``; returns
+    (journal, segout, last checkpoint or None, redone bytes)."""
+    os.makedirs(job_dir, exist_ok=True)
+    journal = Journal.open(os.path.join(job_dir, "journal.sbj"))
+    if journal.last("spec") is None:
+        journal.append({"t": "spec", "spec": spec})
+    segout = SegmentedOutput(os.path.join(job_dir, "segments"))
+    redone = segout.discard_parts()
+    ck = journal.last("ckpt")
+    redone += _drop_uncovered_segments(
+        segout, (ck["seq"] + 1) if ck is not None else 0
+    )
+    if redone:
+        obs.count("jobs.redone_bytes", redone)
+    if ck is not None:
+        obs.count("jobs.resumed")
+    return journal, segout, ck, redone
+
+
+def _note_checkpoint(nbytes: int) -> None:
+    obs.count("jobs.checkpoints")
+    obs.count("jobs.checkpoint_bytes", nbytes)
+
+
+# ----------------------------------------------------------------- rewrite
+
+def run_rewrite_job(
+    spec: dict,
+    job_dir: str,
+    config: Config = Config(),
+    checkpoint: int = 5000,
+    cancel=None,
+) -> dict:
+    """Checkpointed ``htsjdk-rewrite``: re-block + re-compress
+    ``spec["path"]`` into ``spec["out"]``, journaled every
+    ``checkpoint`` records. ``spec`` keys mirror the serve ``rewrite``
+    op: ``path``, ``out``, ``block_payload``, ``level``, ``deflate``,
+    ``index``. Returns the result dict (also journaled in the ``done``
+    record); raises :class:`JobCancelled` if ``cancel`` fires."""
+    from spark_bam_tpu.bam.iterators import RecordStream
+    from spark_bam_tpu.cli.rewrite import emit_sidecars
+    from spark_bam_tpu.compress.codec import make_codec
+    from spark_bam_tpu.core.channel import open_channel
+
+    journal, segout, ck, redone = _open_job(job_dir, spec)
+    done = journal.last("done")
+    if done is not None:
+        journal.close()
+        return dict(done["result"], resumed=True, redone_bytes=0)
+
+    block_payload = int(spec.get("block_payload") or 0xFF00)
+    level = int(spec.get("level") or 6)
+    dspec = spec.get("deflate")
+    if dspec is None:
+        dspec = config.deflate
+    codec = make_codec(dspec, level=level)
+
+    blocks: "list[Metadata]" = []
+    flats: "list[int]" = []
+    flats_new: "list[int]" = []
+    skip = 0
+    seg_next = 0
+    header_len = 0
+    checkpoints = 0
+    if ck is not None:
+        skip = int(ck["records"])
+        seg_next = int(ck["seq"]) + 1
+        header_len = int(ck["header_len"])
+        for record in journal.records:
+            if record.get("t") == "ckpt":
+                blocks.extend(Metadata(*b) for b in record["blocks"])
+                flats.extend(record["flats"])
+                checkpoints += 1
+
+    sink = _SegSink(segout)
+    w = BgzfWriter(sink, block_payload, level, codec=codec)
+    if ck is not None:
+        w.buf = bytearray(base64.b64decode(ck["buf"]))
+        w._flat = int(ck["flat"])
+        w._offset = int(ck["offset"])
+    mark = 0
+    count = skip
+    segout.begin(seg_next)
+    try:
+        with obs.span("jobs.rewrite", path=str(spec["path"]), resumed=skip):
+            with open_channel(spec["path"]) as channel:
+                stream = RecordStream.open(channel)
+                if ck is None:
+                    w.write(encode_bam_header(stream.header))
+                    header_len = w.flat_tell
+                for rec in itertools.islice(stream, skip, None):
+                    rec = rec[1] if isinstance(rec, tuple) else rec
+                    flats_new.append(w.flat_tell)
+                    w.write(rec.encode())
+                    count += 1
+                    if count % checkpoint == 0:
+                        _flush_members(w)
+                        _, nbytes = segout.commit()
+                        delta = w.blocks[mark:]
+                        journal.append({
+                            "t": "ckpt", "seq": seg_next, "records": count,
+                            "flat": w._flat, "offset": w._offset,
+                            "buf": base64.b64encode(bytes(w.buf)).decode(),
+                            "header_len": header_len, "seg_bytes": nbytes,
+                            "blocks": [
+                                [m.start, m.compressed_size,
+                                 m.uncompressed_size]
+                                for m in delta
+                            ],
+                            "flats": flats_new,
+                        })
+                        _note_checkpoint(nbytes)
+                        checkpoints += 1
+                        blocks.extend(delta)
+                        flats.extend(flats_new)
+                        mark = len(w.blocks)
+                        flats_new = []
+                        seg_next += 1
+                        segout.begin(seg_next)
+                    if cancel is not None and cancel.is_set():
+                        raise JobCancelled(f"job cancelled at {count} records")
+            w.close()
+            _, nbytes = segout.commit()
+            blocks.extend(w.blocks[mark:])
+            flats.extend(flats_new)
+            total = segout.assemble(spec["out"])
+            result = WriteResult(
+                count=count, header_len=header_len, blocks=blocks,
+                record_flats=flats, bytes_out=w._offset,
+            )
+            sidecars = (
+                emit_sidecars(spec["out"], result, config)
+                if spec.get("index") else {}
+            )
+    except BaseException:
+        segout.abort()
+        journal.close()
+        raise
+    res = {
+        "path": str(spec["path"]), "out": str(spec["out"]),
+        "count": count, "n_blocks": len(blocks), "bytes_out": total,
+        "sidecars": dict(sidecars), "checkpoints": checkpoints,
+        "redone_bytes": redone, "resumed": bool(ck is not None),
+    }
+    journal.append({"t": "done", "result": res})
+    segout.remove()
+    journal.close()
+    return res
+
+
+# ------------------------------------------------------------------ export
+
+def run_export_job(
+    spec: dict,
+    job_dir: str,
+    config: Config = Config(),
+    checkpoint: int = 8,
+    cancel=None,
+    parallel=None,
+) -> dict:
+    """Checkpointed BAM → native-container export, journaled every
+    ``checkpoint`` frames. The frame stream is a pure function of
+    (path, columns, columnar config) so resume recomputes and skips.
+    ``spec``: ``path``, ``out``, optional ``columns`` (list) and
+    ``batch_rows``."""
+    from spark_bam_tpu.bam.header import read_header
+    from spark_bam_tpu.columnar.export import _partition_batch_stream
+    from spark_bam_tpu.columnar.native import (
+        batch_frame,
+        container_head,
+        container_meta,
+        end_frame,
+    )
+    from spark_bam_tpu.columnar.schema import Rebatcher, normalize_columns
+    from spark_bam_tpu.load.api import load_bam
+    from spark_bam_tpu.parallel.executor import ParallelConfig
+
+    journal, segout, ck, redone = _open_job(job_dir, spec)
+    done = journal.last("done")
+    if done is not None:
+        journal.close()
+        return dict(done["result"], resumed=True, redone_bytes=0)
+
+    ccfg = config.columnar_config
+    if spec.get("batch_rows"):
+        from dataclasses import replace
+
+        ccfg = replace(ccfg, batch_rows=int(spec["batch_rows"]))
+    columns = normalize_columns(spec.get("columns") or ccfg.columns)
+    header = read_header(spec["path"])
+    contigs = [
+        (name, length)
+        for _, (name, length) in sorted(header.contig_lengths.items())
+    ]
+    meta = container_meta(
+        columns, codec=ccfg.codec, level=ccfg.level, contigs=contigs
+    )
+
+    skip = int(ck["frames"]) if ck is not None else 0
+    seg_next = int(ck["seq"]) + 1 if ck is not None else 0
+    rows = int(ck["rows"]) if ck is not None else 0
+    offset = int(ck["offset"]) if ck is not None else 0
+    frames = 0
+    checkpoints = sum(1 for r in journal.records if r.get("t") == "ckpt")
+
+    parallel = parallel if parallel is not None else ParallelConfig()
+    ds = load_bam(spec["path"], config=config, parallel=parallel)
+    reports: list = []
+    rebatcher = Rebatcher(ccfg.batch_rows)
+
+    def frame_stream():
+        for batch in _partition_batch_stream(
+            ds, ccfg.batch_rows, columns, reports
+        ):
+            yield from rebatcher.feed(batch)
+        yield from rebatcher.flush()
+
+    segout.begin(seg_next)
+    try:
+        with obs.span("jobs.export", path=str(spec["path"]), resumed=skip):
+            if ck is None:
+                head = container_head(meta)
+                segout.write(head)
+                offset += len(head)
+            for frame in frame_stream():
+                frames += 1
+                if frames <= skip:
+                    # Already durable (rows restored from the checkpoint);
+                    # recompute-and-skip without re-encoding.
+                    continue
+                encoded = batch_frame(frame, meta)
+                segout.write(encoded)
+                rows += frame.num_rows
+                offset += len(encoded)
+                if (frames - skip) % checkpoint == 0:
+                    _, nbytes = segout.commit()
+                    journal.append({
+                        "t": "ckpt", "seq": seg_next, "frames": frames,
+                        "rows": rows, "offset": offset, "seg_bytes": nbytes,
+                    })
+                    _note_checkpoint(nbytes)
+                    checkpoints += 1
+                    seg_next += 1
+                    segout.begin(seg_next)
+                if cancel is not None and cancel.is_set():
+                    raise JobCancelled(
+                        f"job cancelled at {frames} frames"
+                    )
+            tail = end_frame(rows, frames)
+            segout.write(tail)
+            offset += len(tail)
+            _, nbytes = segout.commit()
+            total = segout.assemble(spec["out"])
+    except BaseException:
+        segout.abort()
+        journal.close()
+        raise
+    res = {
+        "path": str(spec["path"]), "out": str(spec["out"]),
+        "format": "native", "columns": list(columns), "rows": rows,
+        "batches": frames, "bytes_out": total,
+        "checkpoints": checkpoints, "redone_bytes": redone,
+        "resumed": bool(ck is not None),
+    }
+    journal.append({"t": "done", "result": res})
+    segout.remove()
+    journal.close()
+    return res
+
+
+# --------------------------------------------------------------- transcode
+
+def run_transcode_job(
+    spec: dict,
+    job_dir: str,
+    config: Config = Config(),
+    checkpoint: int = 5000,
+    cancel=None,
+) -> dict:
+    """Fleet re-compression: a rewrite job with sidecar emission forced
+    on, so the transcoded output serves warm loads immediately."""
+    return run_rewrite_job(
+        dict(spec, index=True), job_dir,
+        config=config, checkpoint=checkpoint, cancel=cancel,
+    )
+
+
+RUNNERS = {
+    "rewrite": run_rewrite_job,
+    "export": run_export_job,
+    "transcode": run_transcode_job,
+}

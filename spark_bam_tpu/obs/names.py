@@ -24,8 +24,8 @@ from __future__ import annotations
 LAYERS = frozenset({
     "account", "agg", "bgzf", "cache", "chaos", "check", "cli",
     "columnar", "compress", "deflate", "fabric", "faults", "funnel",
-    "guard", "inflate", "load", "mesh", "progress", "remote", "sampler",
-    "serve", "slo", "timer", "ts",
+    "guard", "inflate", "jobs", "load", "mesh", "progress", "remote",
+    "sampler", "scrub", "serve", "slo", "timer", "ts",
 })
 
 NAMES = frozenset({
@@ -39,10 +39,13 @@ NAMES = frozenset({
     "bgzf.bytes_read", "bgzf.read",
     # cache — .sbi split-index sidecars (docs/caching.md)
     "cache.bytes", "cache.evictions", "cache.hits", "cache.invalidations",
-    "cache.misses", "cache.read_ms", "cache.write_ms",
-    # chaos — deterministic fault injection (docs/robustness.md)
+    "cache.misses", "cache.read_ms", "cache.write_errors", "cache.write_ms",
+    # chaos — deterministic fault injection (docs/robustness.md);
+    # chaos.disk_* are the filesystem-seam kinds (core/faults.py)
     "chaos.corrupted_bytes", "chaos.io_errors", "chaos.latency_spikes",
     "chaos.short_reads",
+    "chaos.disk_enospc", "chaos.disk_eio", "chaos.disk_short_writes",
+    "chaos.disk_torn_writes", "chaos.disk_rename_fails",
     # check — record-boundary checker
     "check.accepted", "check.candidates", "check.count_escape_retries",
     "check.defer_resolved", "check.defer_retries", "check.deferred",
@@ -54,8 +57,8 @@ NAMES = frozenset({
     "cli.export", "cli.fabric",
     "cli.full-check", "cli.fuzz-decode", "cli.htsjdk-rewrite",
     "cli.index", "cli.index-bam", "cli.index-blocks", "cli.index-records",
-    "cli.lint", "cli.metrics-report", "cli.rewrite", "cli.serve",
-    "cli.time-load", "cli.top",
+    "cli.lint", "cli.metrics-report", "cli.rewrite", "cli.scrub",
+    "cli.serve", "cli.time-load", "cli.top",
     # columnar — record-batch analytics plane (docs/analytics.md)
     "columnar.build_ms", "columnar.bytes_out", "columnar.encode_ms",
     "columnar.export", "columnar.rows",
@@ -75,10 +78,11 @@ NAMES = frozenset({
     # fabric.breaker — per-link circuit breakers (docs/robustness.md)
     "fabric.breaker.opened", "fabric.breaker.half_open",
     "fabric.breaker.closed", "fabric.breaker.holddowns",
-    # fabric resilience: retry budget, brownout, streaming failover
+    # fabric resilience: retry budget, brownout, streaming failover,
+    # durable-job orphan rescue (docs/robustness.md)
     "fabric.budget_spent", "fabric.budget_exhausted",
     "fabric.brownout_shed", "fabric.streamed", "fabric.stream_frames",
-    "fabric.resumed",
+    "fabric.resumed", "fabric.job_rescues",
     # fabric.chaos — fleet-seam fault injection (fabric/chaos.py)
     "fabric.chaos.drops", "fabric.chaos.delays", "fabric.chaos.dups",
     "fabric.chaos.truncs", "fabric.chaos.slowed",
@@ -101,6 +105,14 @@ NAMES = frozenset({
     "inflate.tokenize_demotions", "inflate.tokenize_device",
     "inflate.tokenize_device_ms", "inflate.tokenize_host_ms",
     "inflate.window", "inflate.windows",
+    # jobs — durable job plane: WAL + crash-resumable runners
+    # (docs/robustness.md "Durable jobs & scrubbing")
+    "jobs.cancelled", "jobs.checkpoint_bytes", "jobs.checkpoints",
+    "jobs.completed", "jobs.deferred", "jobs.export", "jobs.failed",
+    "jobs.journal_appends", "jobs.journal_skipped",
+    "jobs.journal_truncated", "jobs.paused", "jobs.preflight_rejects",
+    "jobs.redone_bytes", "jobs.resumed", "jobs.rewrite", "jobs.scrub",
+    "jobs.submitted",
     # load — partition execution
     "load.count", "load.fleet_files", "load.parse", "load.partition",
     "load.partitions", "load.record_starts", "load.records",
@@ -118,6 +130,9 @@ NAMES = frozenset({
     "remote.stalls", "remote.unplanned_gets",
     # sampler — tail-based trace sampling (obs/sampler.py)
     "sampler.dropped", "sampler.exemplars", "sampler.kept",
+    # scrub — end-to-end integrity scrubber (jobs/scrub.py)
+    "scrub.artifacts", "scrub.findings", "scrub.quarantined",
+    "scrub.records_checked",
     # serve — split-service daemon (docs/serving.md)
     "serve.batch_encode", "serve.batch_rows", "serve.batches",
     "serve.connections", "serve.device_dispatch", "serve.errors",

@@ -329,6 +329,26 @@ class SloEngine:
             self._statuses = statuses
         return statuses
 
+    def note_event(self, name: str, **fields) -> dict:
+        """Out-of-band ledger entry for conditions the burn-rate loop
+        cannot see — a durable job pausing on ``ResourceExhausted``, a
+        quarantined scrub artifact. Lands in the same ledger (and the
+        flight recorder) as an objective alert so the ``alerts`` op and
+        the CI failure artifact surface it, but never toggles
+        objective firing state."""
+        from spark_bam_tpu import obs
+        from spark_bam_tpu.obs import flight
+
+        entry = dict(
+            fields, objective=name, state="firing", event=name,
+            t=round(time.time(), 3), **flight.context(),
+        )
+        with self._lock:
+            self.ledger.append(entry)
+        obs.count("slo.alerts")
+        flight.record("slo_alert", **entry)
+        return entry
+
     # ------------------------------------------------------------- readers
     @property
     def alerting(self) -> bool:

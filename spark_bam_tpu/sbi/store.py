@@ -38,8 +38,10 @@ import time
 from dataclasses import dataclass
 
 from spark_bam_tpu import obs
+from spark_bam_tpu.core import faults
 from spark_bam_tpu.core.channel import is_url, open_channel, path_exists
 from spark_bam_tpu.core.faults import FaultPolicy, Unrecoverable, with_retries
+from spark_bam_tpu.core.guard import ResourceExhausted, map_write_error
 from spark_bam_tpu.sbi.format import (
     SbiFormatError,
     SbiIndex,
@@ -141,6 +143,25 @@ def cache_status_line(path, config) -> str:
 
 # ------------------------------------------------------------------- store
 _TMP_SEQ = itertools.count()
+
+# Process-wide cache-write degrade latch: after a ResourceExhausted write
+# (ENOSPC/EDQUOT/EIO on the sidecar filesystem) further write-through is
+# pointless churn, so the cache degrades to read-only until reset. A
+# cache write must NEVER fail the load it rides on — the index is a pure
+# acceleration.
+_write_disabled = False
+_write_disabled_lock = threading.Lock()
+
+
+def cache_writes_disabled() -> bool:
+    return _write_disabled
+
+
+def reset_cache_write_degrade() -> None:
+    """Re-arm write-through (tests; operators after freeing space)."""
+    global _write_disabled
+    with _write_disabled_lock:
+        _write_disabled = False
 
 
 class CacheStore:
@@ -289,18 +310,43 @@ class CacheStore:
                 str(bam_path),
             )
             return None
+        global _write_disabled
+        if _write_disabled:
+            _record(
+                "skipped", "cache writes disabled after earlier write error",
+                str(bam_path),
+            )
+            return None
         sidecar = self.sidecar_path(bam_path)
         t0 = time.perf_counter()
         blob = encode_sbi(index)
-        if self.cache_dir:
-            os.makedirs(self.cache_dir, exist_ok=True)
         # pid + in-process sequence: unique even for threads racing on the
         # same sidecar; os.replace keeps every reader's view untorn.
         tmp = f"{sidecar}.tmp{os.getpid()}.{next(_TMP_SEQ)}"
         try:
-            with open(tmp, "wb") as f:
+            if self.cache_dir:
+                os.makedirs(self.cache_dir, exist_ok=True)
+            with faults.wrap_disk(open(tmp, "wb")) as f:
                 f.write(blob)
-            os.replace(tmp, sidecar)
+            faults.disk_replace(tmp, sidecar)
+        except OSError as exc:
+            # A cache write never fails the load it accelerates: count it,
+            # and on resource exhaustion latch the cache to read-only so
+            # a full disk doesn't get hammered once per load.
+            obs.count("cache.write_errors")
+            mapped = map_write_error(exc, "sidecar write", path=sidecar)
+            if isinstance(mapped, ResourceExhausted):
+                with _write_disabled_lock:
+                    _write_disabled = True
+                log.warning(
+                    "split-index cache degraded to read-only: %s", mapped
+                )
+                _record("skipped", f"write degraded to cache-off: {mapped}",
+                        sidecar)
+            else:
+                log.info("split-index cache write failed: %s", mapped)
+                _record("skipped", f"write failed: {mapped}", sidecar)
+            return None
         finally:
             if os.path.exists(tmp):  # failure path only; replace moved it
                 os.unlink(tmp)

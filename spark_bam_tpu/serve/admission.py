@@ -1,9 +1,11 @@
 """Admission control: bounded per-class inflight limits with load shedding.
 
-Two request classes share the daemon: *plan* (split plans, record-start
-indexes — bursty, index-bound) and *scan* (count verdicts, fleet loads,
-rewrites — device-bound). Each has its own inflight cap so a flood of one class
-cannot starve the other. Over-limit arrivals are rejected synchronously
+Three request classes share the daemon: *plan* (split plans,
+record-start indexes — bursty, index-bound), *scan* (count verdicts,
+fleet loads, rewrites — device-bound) and *control* (durable-job
+submit/status/cancel — cheap bookkeeping whose real admission happens
+in jobs/manager.py). Each has its own inflight cap so a flood of one
+class cannot starve the other. Over-limit arrivals are rejected synchronously
 with :class:`Overloaded` carrying a Retry-After hint derived from the
 observed service-latency median (``FaultPolicy.LatencyTracker``).
 """
@@ -23,6 +25,9 @@ CLASS_OF = {
     "batch": "scan",
     "aggregate": "scan",
     "rewrite": "scan",
+    "submit": "control",
+    "job_status": "control",
+    "job_cancel": "control",
 }
 
 

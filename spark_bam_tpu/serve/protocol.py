@@ -35,6 +35,14 @@ events, time-series rings, and flight-recorder ring, and ``alerts``
 returns the SLO engine's statuses, burn rates and alert ledger
 (docs/observability.md).
 
+Job ops (``submit``, ``job_status``, ``job_cancel``) are the durable
+job plane's control surface (jobs/manager.py): ``submit`` admits (or
+idempotently re-attaches to) a journaled rewrite/export/transcode and
+answers immediately with the job's id + state; the other two poll and
+cancel it. They ride a small ``control`` admission class so a burst of
+job control can never displace plan/scan work. A deferred or paused job
+answers with the typed ``ResourceExhausted`` error + ``retry_after_ms``.
+
 Requests may carry an optional ``tenant`` string — a client-chosen
 identity the per-request cost accountant (obs/account.py) rolls up by,
 so ``stats``/``top`` can answer "who is spending the fleet". Absent
@@ -49,7 +57,8 @@ carrier shapes rather than erroring.
 
 Error types are stable strings (``Overloaded``, ``DeadlineExceeded``,
 ``ProtocolError``, ``NotFound``, ``Unsupported``, ``Internal``,
-``Draining``, ``WorkerLost``) — docs/serving.md tabulates them.
+``Draining``, ``WorkerLost``, ``ResourceExhausted``) — docs/serving.md
+tabulates them.
 """
 
 from __future__ import annotations
@@ -58,7 +67,8 @@ import json
 
 #: ops answered by the service; anything else is a ProtocolError.
 OPS = ("ping", "stats", "plan", "record_starts", "count", "fleet", "batch",
-       "aggregate", "rewrite", "drain", "tune", "telemetry", "alerts")
+       "aggregate", "rewrite", "drain", "tune", "telemetry", "alerts",
+       "submit", "job_status", "job_cancel")
 
 
 class ProtocolError(ValueError):

@@ -39,6 +39,7 @@ from spark_bam_tpu.obs.timeseries import RingStore
 from spark_bam_tpu.bgzf.flat import flatten_file
 from spark_bam_tpu.core.config import Config
 from spark_bam_tpu.core.faults import LatencyTracker
+from spark_bam_tpu.core.guard import ResourceExhausted
 from spark_bam_tpu.parallel.mesh import make_mesh, mesh_steps
 from spark_bam_tpu.serve.admission import CLASS_OF, AdmissionGate
 from spark_bam_tpu.serve.batcher import Batcher, RowTask
@@ -178,7 +179,13 @@ class SplitService:
         self.gate = AdmissionGate({
             "plan": self.serve_cfg.plan_queue,
             "scan": self.serve_cfg.scan_queue,
+            # Durable-job control ops: cheap table lookups + thread
+            # spawns; real capacity gating lives in the JobManager.
+            "control": 8,
         })
+        from spark_bam_tpu.jobs.manager import JobManager
+
+        self.jobs = JobManager(config=config, alert_fn=self._job_alert)
         self.pool = ThreadPoolExecutor(
             max_workers=self.serve_cfg.workers, thread_name_prefix="serve-worker"
         )
@@ -257,9 +264,20 @@ class SplitService:
         if rings is not None:
             rings.stop()
 
+    def _job_alert(self, name: str, **fields) -> None:
+        """A paused job pages where burn-rate alerts land: the SLO
+        ledger when the engine is live, the flight recorder always."""
+        engine = self.slo_engine
+        if engine is not None:
+            engine.note_event(name, **fields)
+        else:
+            flight.record("slo_alert", objective=name, state="firing",
+                          **fields)
+
     # ------------------------------------------------------------ lifecycle
     def close(self) -> None:
         self._closed = True
+        self.jobs.close(timeout=1.0)
         if self.rings is not None:
             self.rings.stop()
         self.batcher.close()
@@ -352,6 +370,16 @@ class SplitService:
         except TimeoutError as exc:
             obs.count("serve.shed")
             resp = error_response(req, "DeadlineExceeded", str(exc))
+        except ResourceExhausted as exc:
+            # Retryable environment exhaustion (disk/memory), typed so
+            # clients and the fabric router can pace a retry instead of
+            # treating it as an Internal failure.
+            resp = error_response(
+                req, "ResourceExhausted", str(exc),
+                retry_after_ms=round(getattr(
+                    exc, "retry_after_ms", self.retry_after_ms()
+                ), 3),
+            )
         except FileNotFoundError as exc:
             resp = error_response(req, "NotFound", str(exc))
         except Exception as exc:
@@ -628,6 +656,58 @@ class SplitService:
             "bytes_out": res.bytes_out,
             "sidecars": dict(res.sidecars),
         }
+
+    # ----------------------------------------------------------- job plane
+    #: request fields forwarded into a job spec, per job op.
+    _JOB_FIELDS = ("path", "out", "block_payload", "level", "deflate",
+                   "index", "columns", "batch_rows")
+
+    def _handle_submit(self, req: dict, deadline_ts) -> dict:
+        """Admit a durable job (jobs/manager.py). ``job`` selects the
+        runner (rewrite/export/transcode); the spec fields mirror the
+        one-shot ops. Deterministic job ids make retries idempotent —
+        resubmitting a spec whose journal survives RESUMES it."""
+        from spark_bam_tpu.jobs.runner import RUNNERS
+
+        job = req.get("job")
+        if job not in RUNNERS:
+            raise ServiceError(
+                "ProtocolError",
+                f"submit needs job ∈ {{{', '.join(sorted(RUNNERS))}}}, "
+                f"got {job!r}",
+            )
+        spec = {"op": job}
+        spec.update(
+            (k, req[k]) for k in self._JOB_FIELDS
+            if req.get(k) is not None
+        )
+        try:
+            status = self.jobs.submit(spec)
+        except ValueError as exc:
+            raise ServiceError("ProtocolError", str(exc)) from exc
+        return status
+
+    def _job_or_404(self, req: dict) -> str:
+        jid = req.get("job_id")
+        if not jid:
+            raise ServiceError("ProtocolError", "missing 'job_id'")
+        return str(jid)
+
+    def _handle_job_status(self, req: dict, deadline_ts) -> dict:
+        status = self.jobs.status(self._job_or_404(req))
+        if status is None:
+            raise ServiceError(
+                "NotFound", f"no job {req.get('job_id')!r} on this worker"
+            )
+        return status
+
+    def _handle_job_cancel(self, req: dict, deadline_ts) -> dict:
+        status = self.jobs.cancel(self._job_or_404(req))
+        if status is None:
+            raise ServiceError(
+                "NotFound", f"no job {req.get('job_id')!r} on this worker"
+            )
+        return status
 
     def _handle_batch(self, req: dict, deadline_ts) -> dict:
         """Columnar record batches for a (possibly interval/flag-filtered)
@@ -938,6 +1018,10 @@ class SplitService:
             "latency_p99_ms": _percentile(all_lat, 0.99),
             "split_resolutions": resolutions,
             "ops": ops,
+            # Durable-job table: id → state (full detail via job_status).
+            "jobs": {
+                j["job_id"]: j["state"] for j in self.jobs.jobs()
+            },
             "accounting": self.accountant.snapshot(),
             # The compact SLO block the fabric autoscaler steers on
             # (max_burn_fast + firing objective names); None without

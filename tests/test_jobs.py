@@ -1,0 +1,881 @@
+"""Durable job plane: WAL journal, crash-resume byte-identity, chaos.
+
+The journal tests hammer the framing invariants (torn tails truncate,
+byte flips end the durable prefix, foreign files are rejected, unknown
+tags skip without truncating). The resume tests interrupt rewrite and
+export runners at and around every checkpoint boundary and require the
+reassembled artifact to be byte-identical to an uninterrupted run — the
+property the whole subsystem exists for. Manager tests cover admission
+(memory watermark, max-active, ENOSPC preflight), pause-on-exhaustion
+and cancel; serve tests drive the submit/job_status/job_cancel ops over
+a real socket; the slow storm test SIGKILLs the rendezvous-primary
+worker mid-rewrite under disk chaos and requires the fabric watchdog's
+rescue to finish the job byte-identically (docs/robustness.md).
+"""
+
+import json
+import os
+import subprocess
+import time
+
+import pytest
+
+from spark_bam_tpu import obs
+from spark_bam_tpu.core import faults as _faults
+from spark_bam_tpu.core.config import Config
+from spark_bam_tpu.core.faults import DiskChaosSpec, disk_chaos, parse_disk_chaos
+from spark_bam_tpu.core.guard import ResourceExhausted
+from spark_bam_tpu.jobs.journal import (
+    Journal,
+    JournalError,
+    SegmentedOutput,
+    _frame,
+    read_journal,
+)
+from spark_bam_tpu.jobs.manager import JobManager, JobsConfig, _Job, job_id_of
+from spark_bam_tpu.jobs.runner import (
+    RUNNERS,
+    JobCancelled,
+    run_export_job,
+    run_rewrite_job,
+    run_transcode_job,
+)
+from spark_bam_tpu.jobs.scrub import scrub_paths
+from tests.bam_factories import random_bam
+
+pytestmark = pytest.mark.jobs
+
+#: Small enough that the ~400-record fixture crosses several checkpoints.
+CKPT = 60
+BLOCK = 4096
+
+SERVE_SPEC = "window=64KB,halo=8KB,batch=8,tick=5,workers=4"
+
+
+@pytest.fixture(scope="module")
+def bam_path(tmp_path_factory):
+    p = tmp_path_factory.mktemp("jobs_fixture") / "in.bam"
+    random_bam(p, seed=29, n_records=(380, 420), read_len=(20, 600))
+    return str(p)
+
+
+@pytest.fixture(scope="module")
+def baseline(bam_path, tmp_path_factory):
+    """Plain (non-journaled, non-segmented) rewrite — the byte-identity
+    oracle every interrupted/resumed run must reproduce exactly."""
+    from spark_bam_tpu.cli.rewrite import rewrite_bam
+
+    out = tmp_path_factory.mktemp("jobs_baseline") / "out.bam"
+    res = rewrite_bam(bam_path, out, block_payload=BLOCK, level=6)
+    return {"bytes": out.read_bytes(), "count": res.count}
+
+
+@pytest.fixture
+def reg():
+    obs.shutdown()
+    r = obs.configure()
+    yield r
+    obs.shutdown()
+
+
+def _counters(r):
+    return {c["name"]: c["value"] for c in r.snapshot()["counters"]}
+
+
+def _spec(bam, out):
+    return {"op": "rewrite", "path": str(bam), "out": str(out),
+            "block_payload": BLOCK, "level": 6}
+
+
+class _TripAt:
+    """Cancel-event stand-in tripping after ``n`` per-record (or
+    per-frame) checks of the CURRENT run — a deterministic in-process
+    stand-in for SIGKILL at a chosen point in the stream."""
+
+    def __init__(self, n: int):
+        self.left = int(n)
+
+    def is_set(self) -> bool:
+        self.left -= 1
+        return self.left <= 0
+
+
+def _wait_state(mgr, jid, states, timeout=30.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        st = mgr.status(jid)
+        if st is not None and st["state"] in states:
+            return st
+        time.sleep(0.02)
+    pytest.fail(f"job {jid} never reached {states}: {mgr.status(jid)}")
+
+
+# ---------------------------------------------------------------- journal
+
+
+def _recs(n=6):
+    # No spaces inside payloads: the byte-flip fuzz relies on the framing
+    # space separators being the only 0x20 bytes on a line.
+    return [{"t": "spec", "spec": {"n": 0}}] + [
+        {"t": "ckpt", "seq": i, "records": (i + 1) * 10} for i in range(n - 2)
+    ] + [{"t": "note", "msg": "tail"}]
+
+
+def test_journal_append_reopen_roundtrip(tmp_path):
+    path = tmp_path / "journal.sbj"
+    j = Journal.open(path)
+    for r in _recs():
+        j.append(r)
+    assert j.last("ckpt")["seq"] == 3
+    assert j.last("done") is None
+    j.close()
+    j2 = Journal.open(path)
+    assert j2.records == _recs()
+    j2.append({"t": "done", "result": {"count": 1}})
+    j2.close()
+    assert read_journal(path)[-1] == {"t": "done", "result": {"count": 1}}
+
+
+def test_journal_unknown_tag_skipped_not_truncated(tmp_path):
+    path = tmp_path / "journal.sbj"
+    j = Journal.open(path)
+    j.append({"t": "spec", "spec": {}})
+    j.append({"t": "v99_hologram", "payload": 1})  # from the future
+    j.append({"t": "done", "result": {}})
+    j.close()
+    size = os.path.getsize(path)
+    j2 = Journal.open(path)
+    assert [r["t"] for r in j2.records] == ["spec", "done"]
+    j2.close()
+    # Skipped on read, but its valid frame survives for newer readers.
+    assert os.path.getsize(path) == size
+
+
+def test_journal_truncates_torn_tail_and_appends_after(tmp_path):
+    path = tmp_path / "journal.sbj"
+    recs = _recs()
+    raw = b"".join(_frame(r) for r in recs)
+    path.write_bytes(raw + b'SBJ1 deadbeef {"t":"ck')  # torn mid-frame
+    j = Journal.open(path)
+    assert j.records == recs
+    assert os.path.getsize(path) == len(raw)  # tail cut back
+    j.append({"t": "note", "msg": "after"})
+    j.close()
+    assert read_journal(path) == recs + [{"t": "note", "msg": "after"}]
+
+
+def test_journal_rejects_foreign_file(tmp_path):
+    path = tmp_path / "journal.sbj"
+    blob = b"BAM\x01 this is somebody else's file\n"
+    path.write_bytes(blob)
+    with pytest.raises(JournalError):
+        Journal.open(path)
+    with pytest.raises(JournalError):
+        read_journal(path)
+    assert path.read_bytes() == blob  # never truncated
+
+
+def test_journal_truncation_fuzz_prefix_property(tmp_path):
+    """Cutting the journal at EVERY byte offset must yield exactly the
+    records whose lines are complete — never garbage, never a crash."""
+    path = tmp_path / "journal.sbj"
+    recs = _recs()
+    raw = b"".join(_frame(r) for r in recs)
+    ends = []
+    pos = 0
+    for r in recs:
+        pos += len(_frame(r))
+        ends.append(pos)
+    for cut in range(len(raw) + 1):
+        path.write_bytes(raw[:cut])
+        if 0 < cut < 5:
+            # Too short to even hold the magic: rejected as foreign.
+            with pytest.raises(JournalError):
+                read_journal(path)
+            continue
+        got = read_journal(path)
+        want = sum(1 for e in ends if e <= cut)
+        assert got == recs[:want], f"cut={cut}"
+
+
+def test_journal_byteflip_fuzz_prefix_property(tmp_path):
+    """Flipping any single byte (xor 0xFF — never produces valid ASCII)
+    must end the durable prefix exactly at the damaged line."""
+    path = tmp_path / "journal.sbj"
+    recs = _recs()
+    raw = b"".join(_frame(r) for r in recs)
+    ends = []
+    pos = 0
+    for r in recs:
+        pos += len(_frame(r))
+        ends.append(pos)
+    for pos in range(len(raw)):
+        flipped = raw[:pos] + bytes([raw[pos] ^ 0xFF]) + raw[pos + 1:]
+        path.write_bytes(flipped)
+        if pos < 5:
+            # Damaged magic at offset 0: rejected, not recovered-over.
+            with pytest.raises(JournalError):
+                read_journal(path)
+            continue
+        got = read_journal(path)
+        bad_line = next(i for i, e in enumerate(ends) if pos < e)
+        assert got == recs[:bad_line], f"pos={pos}"
+
+
+# --------------------------------------------------------------- segments
+
+
+def test_segmented_output_commit_assemble_remove(tmp_path):
+    segout = SegmentedOutput(tmp_path / "segs")
+    segout.begin(0)
+    segout.write(b"alpha-")
+    path0, n0 = segout.commit()
+    assert (os.path.basename(path0), n0) == ("seg-00000", 6)
+    segout.begin(1)
+    segout.write(b"beta")
+    segout.commit()
+    assert [os.path.basename(p) for p in segout.committed()] == \
+        ["seg-00000", "seg-00001"]
+    out = tmp_path / "artifact.bin"
+    assert segout.assemble(out) == 10
+    assert out.read_bytes() == b"alpha-beta"
+    segout.remove()
+    assert segout.committed() == []
+    assert out.read_bytes() == b"alpha-beta"  # artifact survives cleanup
+
+
+def test_segmented_output_gap_and_part_discard(tmp_path):
+    d = tmp_path / "segs"
+    segout = SegmentedOutput(d)
+    (d / "seg-00000").write_bytes(b"aa")
+    (d / "seg-00002").write_bytes(b"cc")  # gap at 1: not committed work
+    (d / "seg-00007.part").write_bytes(b"xxxx")
+    assert [os.path.basename(p) for p in segout.committed()] == ["seg-00000"]
+    assert segout.discard_parts() == 4
+    assert not (d / "seg-00007.part").exists()
+
+
+def test_segment_abort_removes_part(tmp_path):
+    d = tmp_path / "segs"
+    segout = SegmentedOutput(d)
+    segout.begin(0)
+    segout.write(b"zz")
+    segout.abort()
+    assert not any(n.endswith(".part") for n in os.listdir(d))
+    segout.begin(0)
+    segout.write(b"ok")
+    segout.commit()
+    assert (d / "seg-00000").read_bytes() == b"ok"
+
+
+def test_segment_commit_detects_torn_write(tmp_path):
+    """A torn write 'succeeds' at write() time; only the commit-time
+    fsync+size check can see it — and must turn it into a retryable
+    exhaustion error, not a silently short segment."""
+    d = tmp_path / "segs"
+    segout = SegmentedOutput(d)
+    with disk_chaos("5:torn=1.0"):
+        segout.begin(0)
+        segout.write(b"x" * 100_000)
+        with pytest.raises(ResourceExhausted):
+            segout.commit()
+    assert segout.committed() == []
+    assert not any(n.endswith(".part") for n in os.listdir(d))
+
+
+def test_atomic_commit_fsyncs_directory(tmp_path, monkeypatch):
+    import spark_bam_tpu.core.atomic as atomic_mod
+
+    synced = []
+    monkeypatch.setattr(atomic_mod, "fsync_dir",
+                        lambda p: synced.append(str(p)))
+    out = tmp_path / "a.bin"
+    af = atomic_mod.AtomicFile(str(out))
+    af.f.write(b"data")
+    af.commit()
+    assert synced == [str(out)]
+    assert out.read_bytes() == b"data"
+    assert not os.path.exists(af.tmp_path)
+
+
+def test_segment_commit_fsyncs_directory(tmp_path, monkeypatch):
+    import spark_bam_tpu.jobs.journal as journal_mod
+
+    synced = []
+    monkeypatch.setattr(journal_mod, "fsync_dir",
+                        lambda p: synced.append(str(p)))
+    segout = SegmentedOutput(tmp_path / "segs")
+    segout.begin(0)
+    segout.write(b"x")
+    final, _ = segout.commit()
+    assert synced == [final]
+
+
+# ----------------------------------------------------- rewrite crash-resume
+
+
+def test_rewrite_clean_run_matches_plain_writer(tmp_path, bam_path, baseline):
+    out = tmp_path / "out.bam"
+    res = run_rewrite_job(_spec(bam_path, out), str(tmp_path / "job"),
+                          checkpoint=CKPT)
+    assert res["count"] == baseline["count"]
+    assert res["resumed"] is False and res["redone_bytes"] == 0
+    assert res["checkpoints"] >= baseline["count"] // CKPT
+    assert out.read_bytes() == baseline["bytes"]
+
+
+@pytest.mark.parametrize("kill_at", [1, CKPT - 1, CKPT, CKPT + 1, 150])
+def test_rewrite_interrupt_resume_byte_identical(
+    tmp_path, bam_path, baseline, kill_at
+):
+    """Die at/around every checkpoint boundary; the resumed run must
+    reproduce the uninterrupted artifact byte for byte."""
+    jdir = str(tmp_path / "job")
+    out = tmp_path / "out.bam"
+    with pytest.raises(JobCancelled):
+        run_rewrite_job(_spec(bam_path, out), jdir, checkpoint=CKPT,
+                        cancel=_TripAt(kill_at))
+    assert not out.exists()  # nothing at the artifact path until done
+    res = run_rewrite_job(_spec(bam_path, out), jdir, checkpoint=CKPT)
+    assert res["count"] == baseline["count"]
+    assert res["resumed"] is (kill_at >= CKPT)  # did a checkpoint land?
+    assert out.read_bytes() == baseline["bytes"]
+
+
+def test_rewrite_repeated_kills_until_done(tmp_path, bam_path, baseline):
+    """Kill every ~CKPT+10 records, forever: each attempt must bank at
+    least one checkpoint, so the job converges instead of spinning."""
+    jdir = str(tmp_path / "job")
+    out = tmp_path / "out.bam"
+    res = None
+    for _ in range(30):
+        try:
+            res = run_rewrite_job(_spec(bam_path, out), jdir,
+                                  checkpoint=CKPT, cancel=_TripAt(CKPT + 10))
+            break
+        except JobCancelled:
+            continue
+    assert res is not None, "job never converged under repeated kills"
+    assert res["resumed"] is True
+    assert out.read_bytes() == baseline["bytes"]
+
+
+def test_rewrite_done_is_idempotent(tmp_path, bam_path):
+    jdir = str(tmp_path / "job")
+    out = tmp_path / "out.bam"
+    res1 = run_rewrite_job(_spec(bam_path, out), jdir, checkpoint=CKPT)
+    res2 = run_rewrite_job(_spec(bam_path, out), jdir, checkpoint=CKPT)
+    assert res2["resumed"] is True and res2["redone_bytes"] == 0
+    assert (res2["count"], res2["bytes_out"]) == \
+        (res1["count"], res1["bytes_out"])
+
+
+def test_rewrite_orphan_committed_segment_dropped(
+    tmp_path, bam_path, baseline
+):
+    """A crash BETWEEN segment commit and journal append leaves a
+    committed segment the journal doesn't cover; resume must discard it
+    (counting the bytes as redone) and still converge byte-identically."""
+    jdir = str(tmp_path / "job")
+    out = tmp_path / "out.bam"
+    with pytest.raises(JobCancelled):
+        run_rewrite_job(_spec(bam_path, out), jdir, checkpoint=CKPT,
+                        cancel=_TripAt(CKPT + 5))
+    orphan = os.path.join(jdir, "segments", "seg-00001")
+    with open(orphan, "wb") as f:
+        f.write(b"\x00" * 1234)  # committed-looking but uncovered
+    res = run_rewrite_job(_spec(bam_path, out), jdir, checkpoint=CKPT)
+    assert res["redone_bytes"] >= 1234
+    assert not os.path.exists(orphan)
+    assert out.read_bytes() == baseline["bytes"]
+
+
+def test_transcode_emits_sidecars_and_scrubs_clean(tmp_path, bam_path,
+                                                   baseline):
+    out = tmp_path / "out.bam"
+    res = run_transcode_job(_spec(bam_path, out), str(tmp_path / "job"),
+                            checkpoint=CKPT)
+    assert len(res["sidecars"]) == 3
+    for p in res["sidecars"].values():
+        assert os.path.exists(p)
+    report = scrub_paths([str(out)], source=bam_path)
+    assert report.clean, report.summary()
+    assert report.records_checked == baseline["count"]
+    assert len(report.artifacts) == 4  # the BAM pulls its sidecars in
+
+
+# ------------------------------------------------------ export crash-resume
+
+
+def test_export_interrupt_resume_byte_identical(tmp_path, bam_path):
+    from spark_bam_tpu.columnar.native import NativeReader
+
+    cfg = Config(columnar="rows=64")
+    clean_out = tmp_path / "clean.sbcr"
+    res_c = run_export_job(
+        {"op": "export", "path": bam_path, "out": str(clean_out)},
+        str(tmp_path / "job_clean"), config=cfg, checkpoint=2,
+    )
+    assert res_c["rows"] > 0 and res_c["batches"] >= 4
+
+    out = tmp_path / "out.sbcr"
+    spec = {"op": "export", "path": bam_path, "out": str(out)}
+    with pytest.raises(JobCancelled):
+        run_export_job(spec, str(tmp_path / "job"), config=cfg,
+                       checkpoint=2, cancel=_TripAt(3))
+    res = run_export_job(spec, str(tmp_path / "job"), config=cfg,
+                         checkpoint=2)
+    assert res["resumed"] is True
+    assert res["rows"] == res_c["rows"]
+    assert out.read_bytes() == clean_out.read_bytes()
+    reader = NativeReader(str(out))
+    assert sum(b.num_rows for b in reader.iter_batches()) == res["rows"]
+    report = scrub_paths([str(out)])
+    assert report.clean and report.records_checked == res["rows"]
+
+
+# ------------------------------------------------------------- disk chaos
+
+
+def test_disk_chaos_schedule_is_deterministic(tmp_path):
+    def tally(path):
+        with disk_chaos("11:eio=0.15+short=0.15+torn=0.1") as state:
+            f = _faults.wrap_disk(open(path, "wb"))
+            for _ in range(300):
+                try:
+                    f.write(b"y" * 64)
+                except OSError:
+                    pass
+            f.close()
+            return dict(state.injected)
+
+    a = tally(tmp_path / "a.bin")
+    b = tally(tmp_path / "b.bin")
+    assert a == b
+    assert sum(a.values()) > 0
+
+
+def test_disk_chaos_rejects_bad_specs():
+    with pytest.raises(ValueError):
+        parse_disk_chaos("x:eio=0.1")
+    with pytest.raises(ValueError):
+        DiskChaosSpec.parse("bogus=1")
+    with pytest.raises(ValueError):
+        DiskChaosSpec.parse("eio")
+
+
+def test_enospc_pauses_job_then_resume_completes(tmp_path, bam_path,
+                                                 baseline):
+    """Full disk mid-run: the job PAUSES (journal + segments intact, SLO
+    alert fired), and the idempotent resubmit finishes the work."""
+    alerts = []
+    jcfg = JobsConfig(dir=str(tmp_path / "jobs"), checkpoint=CKPT)
+    mgr = JobManager(
+        jcfg=jcfg, mem_fn=lambda: None,
+        alert_fn=lambda name, **kw: alerts.append((name, kw)),
+    )
+    out = tmp_path / "out.bam"
+    spec = _spec(bam_path, out)
+    try:
+        with disk_chaos("3:enospc=1.0"):
+            jid = mgr.submit(spec)["job_id"]
+            st = _wait_state(mgr, jid, {"paused"}, timeout=15)
+        assert "ENOSPC" in st["error"]
+        assert [a[0] for a in alerts] == ["jobs.paused"]
+        assert alerts[0][1]["job_id"] == jid
+        st = mgr.submit(spec)
+        assert st["job_id"] == jid
+        st = _wait_state(mgr, jid, {"done"}, timeout=30)
+        assert st["result"]["count"] == baseline["count"]
+        assert out.read_bytes() == baseline["bytes"]
+    finally:
+        mgr.close(timeout=2.0)
+
+
+# --------------------------------------------------------------- manager
+
+
+def test_manager_defers_on_memory_watermark(tmp_path, bam_path):
+    mgr = JobManager(jcfg=JobsConfig(dir=str(tmp_path)),
+                     mem_fn=lambda: 0.99)
+    with pytest.raises(ResourceExhausted) as ei:
+        mgr.submit(_spec(bam_path, tmp_path / "o.bam"))
+    assert ei.value.retry_after_ms == 1000.0
+
+
+def test_manager_defers_on_max_active(tmp_path, bam_path):
+    mgr = JobManager(jcfg=JobsConfig(dir=str(tmp_path), max_active=1),
+                     mem_fn=lambda: None)
+    mgr._jobs["feedfeedfeedfeed"] = _Job(
+        "feedfeedfeedfeed", {"op": "rewrite"}, state="running"
+    )
+    with pytest.raises(ResourceExhausted) as ei:
+        mgr.submit(_spec(bam_path, tmp_path / "o.bam"))
+    assert ei.value.retry_after_ms == 1000.0
+
+
+def test_manager_preflight_rejects_without_space(tmp_path, bam_path,
+                                                 monkeypatch):
+    import spark_bam_tpu.jobs.manager as manager_mod
+
+    def boom(path, need, margin=1.1):
+        raise ResourceExhausted("preflight: no space")
+
+    monkeypatch.setattr(manager_mod, "preflight_space", boom)
+    mgr = JobManager(jcfg=JobsConfig(dir=str(tmp_path)), mem_fn=lambda: None)
+    with pytest.raises(ResourceExhausted, match="no space"):
+        mgr.submit(_spec(bam_path, tmp_path / "o.bam"))
+    assert mgr.jobs() == []  # nothing admitted
+
+
+def test_manager_rejects_bad_specs(tmp_path):
+    mgr = JobManager(jcfg=JobsConfig(dir=str(tmp_path)), mem_fn=lambda: None)
+    with pytest.raises(ValueError):
+        mgr.submit({"op": "mine_bitcoin", "path": "a", "out": "b"})
+    with pytest.raises(ValueError):
+        mgr.submit({"op": "rewrite", "path": "a"})
+
+
+def test_manager_cancel_and_unknown_ids(tmp_path, bam_path, monkeypatch):
+    def fake_runner(spec, job_dir, config=None, checkpoint=0, cancel=None):
+        if not cancel.wait(10):
+            return {"late": True}
+        raise JobCancelled("stopped on request")
+
+    monkeypatch.setitem(RUNNERS, "rewrite", fake_runner)
+    mgr = JobManager(jcfg=JobsConfig(dir=str(tmp_path)), mem_fn=lambda: None)
+    try:
+        jid = mgr.submit(_spec(bam_path, tmp_path / "o.bam"))["job_id"]
+        st = mgr.cancel(jid)
+        assert st["job_id"] == jid
+        st = _wait_state(mgr, jid, {"cancelled"})
+        assert "stopped on request" in st["error"]
+        assert mgr.cancel("nope") is None
+        assert mgr.status("nope") is None
+    finally:
+        mgr.close(timeout=2.0)
+
+
+def test_jobs_config_parse():
+    cfg = JobsConfig.parse("dir=/tmp/j,ckpt=100,frames=4,mem=0.5,max=3")
+    assert (cfg.dir, cfg.checkpoint, cfg.frames) == ("/tmp/j", 100, 4)
+    assert (cfg.mem_watermark, cfg.max_active) == (0.5, 3)
+    assert JobsConfig.parse("") == JobsConfig()
+    with pytest.raises(ValueError):
+        JobsConfig.parse("nope=1")
+    with pytest.raises(ValueError):
+        JobsConfig.parse("checkpoint=0")
+    with pytest.raises(ValueError):
+        JobsConfig.parse("mem=1.5")
+
+
+def test_config_carries_jobs_spec(monkeypatch):
+    assert Config(jobs="checkpoint=123").jobs_config.checkpoint == 123
+    monkeypatch.setenv("SPARK_BAM_JOBS", "frames=9")
+    assert Config.from_env().jobs_config.frames == 9
+
+
+def test_config_carries_disk_chaos_spec(monkeypatch):
+    """SPARK_BAM_DISK_CHAOS must round-trip through Config.from_env —
+    pool workers call it with the chaos env installed."""
+    seed, spec = Config(disk_chaos="9:eio=0.5").disk_chaos_config
+    assert (seed, spec.eio) == (9, 0.5)
+    assert Config().disk_chaos_config is None
+    monkeypatch.setenv("SPARK_BAM_DISK_CHAOS", "7:torn=0.25")
+    seed, spec = Config.from_env().disk_chaos_config
+    assert (seed, spec.torn) == (7, 0.25)
+
+
+def test_job_id_is_canonical():
+    a = job_id_of({"op": "rewrite", "path": "x", "out": "y"})
+    assert a == job_id_of({"out": "y", "path": "x", "op": "rewrite"})
+    assert a != job_id_of({"op": "rewrite", "path": "x", "out": "z"})
+
+
+# ------------------------------------------------------------ cache degrade
+
+
+def test_cache_enospc_degrades_to_read_only(tmp_path, reg):
+    import numpy as np
+
+    from spark_bam_tpu.bgzf.block import Metadata
+    from spark_bam_tpu.sbi.format import Fingerprint, SbiIndex, config_digest
+    from spark_bam_tpu.sbi.store import (
+        CacheStore,
+        cache_writes_disabled,
+        reset_cache_write_degrade,
+    )
+
+    idx = SbiIndex(
+        Fingerprint(1000, 2000, 3000, config_digest(Config())),
+        blocks=[Metadata(0, 50, 120)],
+        record_starts=np.array([104], dtype=np.uint64),
+    )
+    store = CacheStore(cache_dir=str(tmp_path / "cache"))
+    reset_cache_write_degrade()
+    try:
+        with disk_chaos("4:enospc=1.0"):
+            assert store.store("a.bam", idx) is None
+            assert cache_writes_disabled()
+            # Latched: no second write attempt hammers the full disk.
+            assert store.store("a.bam", idx) is None
+        assert _counters(reg).get("cache.write_errors") == 1
+        reset_cache_write_degrade()
+        path = store.store("a.bam", idx)
+        assert path is not None and os.path.exists(path)
+    finally:
+        reset_cache_write_degrade()
+
+
+# -------------------------------------------------------------- observability
+
+
+def test_job_counters_registered_and_emitted(tmp_path, bam_path, reg):
+    from spark_bam_tpu.obs.names import NAMES
+
+    out = tmp_path / "out.bam"
+    jdir = str(tmp_path / "job")
+    with pytest.raises(JobCancelled):
+        run_rewrite_job(_spec(bam_path, out), jdir, checkpoint=CKPT,
+                        cancel=_TripAt(CKPT + 5))
+    run_rewrite_job(_spec(bam_path, out), jdir, checkpoint=CKPT)
+    c = _counters(reg)
+    assert c.get("jobs.checkpoints", 0) >= 2
+    assert c.get("jobs.checkpoint_bytes", 0) > 0
+    assert c.get("jobs.resumed") == 1
+    assert c.get("jobs.journal_appends", 0) >= 3
+    for name in ("jobs.submitted", "jobs.paused", "jobs.deferred",
+                 "jobs.redone_bytes", "jobs.journal_truncated",
+                 "scrub.findings", "scrub.quarantined", "chaos.disk_enospc",
+                 "chaos.disk_torn_writes", "fabric.job_rescues",
+                 "cache.write_errors", "cli.scrub"):
+        assert name in NAMES, name
+
+
+# ----------------------------------------------------------------- scrubber
+
+
+def test_scrub_flags_corruption_and_quarantines(tmp_path, bam_path,
+                                                baseline):
+    good = tmp_path / "good.bam"
+    good.write_bytes(baseline["bytes"])
+    assert scrub_paths([str(good)], source=bam_path).clean
+
+    data = bytearray(baseline["bytes"])
+    data[len(data) // 2] ^= 0xFF
+    bad = tmp_path / "damaged.bam"
+    bad.write_bytes(bytes(data))
+    report = scrub_paths([str(bad)], quarantine=True)
+    assert not report.clean
+    assert all(f.kind == "bam" for f in report.findings)
+    assert report.quarantined == [str(bad) + ".quarantined"]
+    assert not bad.exists()
+    assert (tmp_path / "damaged.bam.quarantined").exists()
+
+
+def test_scrub_catches_bogus_sidecar(tmp_path, baseline):
+    out = tmp_path / "art.bam"
+    out.write_bytes(baseline["bytes"])
+    (tmp_path / "art.bam.sbi").write_bytes(b"garbage-sidecar")
+    report = scrub_paths([str(out)])
+    assert not report.clean
+    assert {f.kind for f in report.findings} == {"sbi"}
+    parts = report.job_report().partitions
+    assert [p.status for p in parts].count("quarantined") == 1
+
+
+def test_scrub_catches_truncation(tmp_path, baseline):
+    trunc = tmp_path / "trunc.bam"
+    trunc.write_bytes(baseline["bytes"][:-40])  # cuts the EOF sentinel
+    report = scrub_paths([str(trunc)])
+    assert not report.clean
+
+
+# ---------------------------------------------------------------------- CLI
+
+
+def test_cli_scrub_exit_codes(tmp_path, bam_path, baseline, capsys):
+    from spark_bam_tpu.cli.main import main
+
+    good = tmp_path / "good.bam"
+    good.write_bytes(baseline["bytes"])
+    assert main(["scrub", str(good)]) == 0
+    assert json.loads(capsys.readouterr().out)["clean"] is True
+    assert main(["scrub", "--source", bam_path, str(good)]) == 0
+    capsys.readouterr()
+
+    data = bytearray(baseline["bytes"])
+    data[len(data) // 2] ^= 0xFF
+    bad = tmp_path / "bad.bam"
+    bad.write_bytes(bytes(data))
+    assert main(["scrub", str(bad)]) == 3  # findings, not a crash
+    assert json.loads(capsys.readouterr().out)["clean"] is False
+
+
+def test_cli_durable_rewrite_matches_plain(tmp_path, bam_path):
+    from spark_bam_tpu.cli.main import main
+
+    plain = tmp_path / "plain.bam"
+    assert main(["htsjdk-rewrite", bam_path, str(plain)]) == 0
+    out = tmp_path / "durable.bam"
+    rc = main(["htsjdk-rewrite", "--durable", "--checkpoint", "64",
+               "--jobs", f"dir={tmp_path / 'jobsroot'}",
+               bam_path, str(out)])
+    assert rc == 0
+    assert out.read_bytes() == plain.read_bytes()
+
+
+def test_cli_rejects_bad_disk_chaos_spec(tmp_path, bam_path):
+    from spark_bam_tpu.cli.main import main
+
+    rc = main(["htsjdk-rewrite", "--disk-chaos", "x:bogus",
+               bam_path, str(tmp_path / "z.bam")])
+    assert rc == 2
+
+
+# -------------------------------------------------------------------- serve
+
+
+def test_serve_job_ops_end_to_end(tmp_path, bam_path):
+    from spark_bam_tpu.serve import (
+        ServeClient,
+        ServeClientError,
+        ServerThread,
+        SplitService,
+    )
+
+    out = tmp_path / "out.bam"
+    svc = SplitService(Config(
+        serve=SERVE_SPEC,
+        jobs=f"dir={tmp_path / 'jobs'},checkpoint=64,mem=1.0",
+    ))
+    try:
+        with ServerThread(svc) as srv, ServeClient(srv.address) as c:
+            resp = c.request("submit", job="rewrite",
+                             path=bam_path, out=str(out))
+            jid = resp["job_id"]
+            assert resp["state"] in ("running", "done")
+            deadline = time.time() + 60
+            st = resp
+            while time.time() < deadline and st["state"] != "done":
+                time.sleep(0.05)
+                st = c.request("job_status", job_id=jid)
+                assert st["state"] in ("running", "done"), st
+            assert st["state"] == "done"
+            assert st["result"]["count"] > 0
+            assert os.path.exists(out)
+            # Idempotent resubmit re-attaches to the finished job.
+            again = c.request("submit", job="rewrite",
+                              path=bam_path, out=str(out))
+            assert (again["job_id"], again["state"]) == (jid, "done")
+            assert c.request("stats")["jobs"].get(jid) == "done"
+            assert c.request("job_cancel", job_id=jid)["state"] == "done"
+            with pytest.raises(ServeClientError) as ei:
+                c.request("job_status", job_id="beefbeefbeefbeef")
+            assert ei.value.error == "NotFound"
+            with pytest.raises(ServeClientError) as ei:
+                c.request("submit", job="mine_bitcoin",
+                          path=bam_path, out=str(out))
+            assert ei.value.error == "ProtocolError"
+    finally:
+        svc.close()
+
+
+def test_serve_submit_deferral_is_typed_retryable(tmp_path, bam_path):
+    from spark_bam_tpu.serve import (
+        ServeClient,
+        ServeClientError,
+        ServerThread,
+        SplitService,
+    )
+
+    svc = SplitService(Config(serve=SERVE_SPEC,
+                              jobs=f"dir={tmp_path / 'jobs'}"))
+    try:
+        svc.jobs.mem_fn = lambda: 0.99  # brownout: defer all admissions
+        with ServerThread(svc) as srv, ServeClient(srv.address) as c:
+            with pytest.raises(ServeClientError) as ei:
+                c.request("submit", job="rewrite",
+                          path=bam_path, out=str(tmp_path / "o.bam"))
+            assert ei.value.error == "ResourceExhausted"
+            assert ei.value.retry_after_ms == 1000.0
+    finally:
+        svc.close()
+
+
+# ------------------------------------------------------------------- storm
+
+
+@pytest.mark.slow
+def test_storm_sigkill_mid_rewrite_rescued_byte_identical(tmp_path):
+    """The acceptance storm: SIGKILL the rendezvous-primary worker
+    mid-rewrite under disk chaos. The router watchdog re-dispatches to
+    the survivor, which resumes from the shared journal; the artifact
+    must be byte-identical to a clean run, redone work bounded by about
+    one checkpoint interval, and the scrubber must find nothing."""
+    from spark_bam_tpu.fabric import Router, WorkerPool, rendezvous_weight
+    from spark_bam_tpu.serve import ServeClient, ServeClientError, ServerThread
+
+    bam = tmp_path / "big.bam"
+    random_bam(bam, seed=7, n_records=(5800, 6200), read_len=(60, 400))
+    bam_path = str(bam)
+
+    base_out = tmp_path / "baseline.bam"
+    base = run_rewrite_job(
+        {"op": "rewrite", "path": bam_path, "out": str(base_out)},
+        str(tmp_path / "baseline_job"), checkpoint=400,
+    )
+    want = base_out.read_bytes()
+
+    jobs_root = tmp_path / "jobs"
+    out = tmp_path / "out.bam"
+    env = dict(
+        os.environ,
+        SPARK_BAM_JOBS=f"dir={jobs_root},checkpoint=400,mem=1.0",
+        SPARK_BAM_DISK_CHAOS="9:eio=0.001",
+    )
+    with WorkerPool(workers=2, devices=1,
+                    serve="window=64KB,halo=8KB,batch=8,tick=5",
+                    env=env, stderr=subprocess.DEVNULL) as pool:
+        router = Router(pool.addresses,
+                        config=Config(fabric="probe=100,autoscale=60000"))
+        with ServerThread(router) as rsrv, ServeClient(rsrv.address) as c:
+            jid = c.request("submit", job="rewrite",
+                            path=bam_path, out=str(out))["job_id"]
+            primary = max(range(2),
+                          key=lambda i: rendezvous_weight(f"w{i}", bam_path))
+            time.sleep(0.15)
+            pool.kill(primary, hard=True)
+
+            deadline = time.time() + 120
+            st = None
+            while time.time() < deadline:
+                try:
+                    st = c.request("job_status", job_id=jid)
+                except (ServeClientError, ConnectionError, OSError):
+                    time.sleep(0.25)  # owner dead, rescue in flight
+                    continue
+                if st["state"] == "done":
+                    break
+                if st["state"] == "paused":
+                    # Injected EIO paused the job on the survivor; the
+                    # idempotent resubmit resumes it from the journal.
+                    try:
+                        c.request("submit", job="rewrite",
+                                  path=bam_path, out=str(out))
+                    except (ServeClientError, ConnectionError, OSError):
+                        pass
+                time.sleep(0.25)
+            assert st is not None and st["state"] == "done", st
+            result = st["result"]
+
+    assert out.read_bytes() == want
+    assert result["count"] == base["count"]
+    journal = read_journal(jobs_root / jid / "journal.sbj")
+    seg_bytes = [r["seg_bytes"] for r in journal if r.get("t") == "ckpt"]
+    assert seg_bytes, "no checkpoints banked before completion"
+    # The final resume redid at most ~one checkpoint interval of work
+    # (one in-flight .part plus at most one uncovered segment).
+    assert result["redone_bytes"] <= 2 * max(seg_bytes)
+    report = scrub_paths([str(out)], source=bam_path)
+    assert report.clean, report.summary()

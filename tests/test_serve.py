@@ -307,7 +307,7 @@ def test_stats_reports_percentiles_and_knobs(service, bam_path):
     assert per_op["p99_ms"] >= per_op["p50_ms"]
     assert stats["draining"] is False
     assert stats["queue_depth"] == 0
-    assert stats["limits"] == {"plan": 64, "scan": 64}
+    assert stats["limits"] == {"plan": 64, "scan": 64, "control": 8}
     assert stats["tick_ms"] == pytest.approx(5.0)
 
 
