@@ -1310,6 +1310,129 @@ def _child_serve(clients: int = 8, per_client: int = 3, seq_shots: int = 3):
     })
 
 
+def zerocopy_leg(reads: int = 60000, rounds: int = 20) -> dict:
+    """Zero-copy transport A/B (docs/serving.md "Transport"): the SAME
+    warm service answering whole-file ``batch`` requests over the shm
+    descriptor transport (``transport=auto`` + ``map_frames``) vs the
+    classic u64-framed socket path, at EQUAL BYTES — the frame cache
+    serves both sides identical pre-encoded frames, so the delta is
+    transport, not encode.
+
+    The honest denominator rides along: ``loopback_memcpy`` is a bare
+    echo server pushing the exact same framed byte sequence over
+    loopback TCP with zero protocol above it — if the serve socket
+    side were much slower than that, the zerocopy ratio would be
+    flattering a strawman. Gate: shm ≥ 3× socket (ISSUE/ROADMAP).
+    Byte-identity is asserted across all three reads."""
+    import socket as socklib
+    import struct
+    import threading
+
+    from spark_bam_tpu.core.platform import force_cpu_devices
+
+    force_cpu_devices(8)
+    enable_compile_cache()
+
+    from spark_bam_tpu.benchmarks.synth import synthetic_fixture
+    from spark_bam_tpu.core.config import Config as C
+    from spark_bam_tpu.serve import ServeClient, ServerThread, SplitService
+
+    path = str(synthetic_fixture(reads=reads))
+    service = SplitService(
+        C(serve="window=256KB,halo=8KB,batch=8,tick=5,workers=4")
+    )
+    try:
+        with ServerThread(service) as srv:
+            with ServeClient(srv.address) as c:
+                ref = [bytes(f)
+                       for f in c.request("batch", path=path)["_binary"]]
+                c.request("batch", path=path)       # frame cache warm
+            nbytes = sum(map(len, ref))
+            framed = b"".join(
+                struct.pack("<Q", len(f)) + f for f in ref
+            )
+
+            # --- loopback_memcpy: raw framed bytes over loopback TCP,
+            # no protocol, no service — the socket ceiling at equal
+            # bytes. One trigger byte per round paces the echo.
+            lsock = socklib.socket()
+            lsock.bind(("127.0.0.1", 0))
+            lsock.listen(1)
+
+            def echo():
+                conn, _ = lsock.accept()
+                with conn:
+                    while conn.recv(1):
+                        conn.sendall(framed)
+
+            t = threading.Thread(target=echo, daemon=True)
+            t.start()
+            got = bytearray()
+            with socklib.create_connection(lsock.getsockname()) as cs:
+                cs.sendall(b"x")                    # warm round
+                _drain_exact(cs, len(framed))
+                t0 = time.perf_counter()
+                for _ in range(rounds):
+                    cs.sendall(b"x")
+                    got = _drain_exact(cs, len(framed))
+                loop_dt = time.perf_counter() - t0
+            lsock.close()
+            assert bytes(got) == framed, "loopback echo corrupted bytes"
+            loop_bps = rounds * nbytes / loop_dt
+
+            def timed(transport: str, map_frames: bool):
+                with ServeClient(srv.address, transport=transport,
+                                 map_frames=map_frames) as c:
+                    first = c.request("batch", path=path)["_binary"]
+                    if [bytes(f) for f in first] != ref:
+                        raise AssertionError(
+                            f"{transport} frames diverged from reference"
+                        )
+                    c.release_frames()
+                    t0 = time.perf_counter()
+                    for _ in range(rounds):
+                        r = c.request("batch", path=path)
+                        if len(r["_binary"]) != len(ref):
+                            raise AssertionError("short response")
+                    dt = time.perf_counter() - t0
+                    return rounds * nbytes / dt, r["_transport"]
+
+            sock_bps, sock_mode = timed("socket", False)
+            shm_bps, shm_mode = timed("auto", True)
+            if sock_mode != "socket" or shm_mode != "shm":
+                raise AssertionError(
+                    f"transport negotiation off: {sock_mode}/{shm_mode}"
+                )
+    finally:
+        service.close()
+
+    ratio = shm_bps / max(sock_bps, 1e-9)
+    return {
+        "zerocopy_payload_bytes": nbytes,
+        "zerocopy_frames": len(ref),
+        "zerocopy_rounds": rounds,
+        "loopback_memcpy_GBps": round(loop_bps / 1e9, 3),
+        "serve_socket_GBps": round(sock_bps / 1e9, 3),
+        "serve_shm_GBps": round(shm_bps / 1e9, 3),
+        "serve_zerocopy_vs_socket": round(ratio, 2),
+        "serve_socket_vs_loopback": round(
+            sock_bps / max(loop_bps, 1e-9), 2
+        ),
+        "zerocopy_bytes_equal": True,
+        "zerocopy_gate_ok": ratio >= 3.0,
+    }
+
+
+def _drain_exact(sock, n: int) -> bytearray:
+    buf = bytearray()
+    while len(buf) < n:
+        piece = sock.recv(1 << 20)
+        if not piece:
+            raise AssertionError("echo peer closed early")
+        buf.extend(piece)
+    return buf
+
+
 def _child_fabric(clients: int = 16, per_client: int = 4):
     """Fabric leg (docs/fabric.md): three serve workers behind the
     router vs ONE worker, plus the control-plane proofs.
@@ -3355,6 +3478,37 @@ def main():
     if len(sys.argv) > 1 and sys.argv[1] == "--child-fabric-chaos":
         _child_fabric_chaos()
         return
+    if len(sys.argv) > 1 and sys.argv[1] == "--zerocopy-only":
+        # Zero-copy transport A/B: lands the serve_zerocopy_vs_socket
+        # ratio row AND its honest denominator (loopback_memcpy — raw
+        # framed bytes over loopback TCP at equal bytes) in the history.
+        detail = {}
+        err = None
+        try:
+            detail = zerocopy_leg()
+        except Exception as e:
+            err = f"{type(e).__name__}: {e}"
+        rows = [
+            {"metric": "loopback_memcpy",
+             "value": detail.get("loopback_memcpy_GBps", 0),
+             "unit": "GB/s", "error": err,
+             "zerocopy": {"leg": "loopback_memcpy", **detail}},
+            {"metric": "serve_zerocopy_vs_socket",
+             "value": detail.get("serve_zerocopy_vs_socket", 0),
+             "unit": "x", "error": err,
+             "zerocopy": {"leg": "serve_zerocopy", **detail}},
+        ]
+        for row in rows:
+            print(json.dumps(row))
+        try:
+            hist = Path(__file__).resolve().parent / "BENCH_HISTORY.jsonl"
+            with open(hist, "a") as f:
+                for row in rows:
+                    f.write(json.dumps({"ts": time.time(), **row}) + "\n")
+        except OSError:
+            pass
+        return
+
     if len(sys.argv) > 1 and sys.argv[1] == "--tokenize-only":
         # Standalone read-path entropy-phase A/B: lands a
         # device_tokenize_vs_host row in the history without the 1 GB e2e

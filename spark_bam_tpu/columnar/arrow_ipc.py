@@ -1,0 +1,83 @@
+"""Arrow IPC *stream format* as a serve wire schema (``wire=arrow``).
+
+The SBCR container (native.py) stays the default ``batch`` payload;
+this module renders the same record batches as Arrow IPC **stream**
+messages instead, so an ``[arrow]`` client needs zero deserialization:
+concatenate the frames (or map them straight out of the shm segment —
+docs/serving.md "Transport") and hand the buffer to
+``pa.ipc.open_stream``; the columns come back as zero-copy Arrow
+arrays.
+
+Framing is unchanged — each IPC message is one transport frame, the
+response's ``binary_frames``/``resume_from`` mean exactly what they
+mean for SBCR: frame 0 is the schema message, frames ``1..n`` are the
+record-batch messages, the last frame is the 8-byte end-of-stream
+marker. The sequence is deterministic for an unchanged file + query
+(pyarrow's IPC encoding is), so the resume token and streaming
+failover carry over untouched.
+
+pyarrow is optional everywhere in this repo: :func:`arrow_available`
+gates the path and the service answers ``Unsupported`` without it.
+"""
+
+from __future__ import annotations
+
+from spark_bam_tpu.columnar.schema import (
+    VAR_BYTES_COLUMNS,
+    VAR_STR_COLUMNS,
+)
+from spark_bam_tpu.columnar.sink import _pyarrow, to_arrow_batch
+
+#: Arrow IPC stream end-of-stream marker (continuation sentinel + zero
+#: metadata length) — the final frame of every ``wire=arrow`` response.
+EOS = b"\xff\xff\xff\xff\x00\x00\x00\x00"
+
+
+def arrow_available() -> bool:
+    try:
+        _pyarrow()
+    except Exception:
+        return False
+    return True
+
+
+def arrow_schema(columns):
+    """The projection's Arrow schema from the STATIC type tables —
+    independent of any data, so an empty result still opens as a valid
+    (zero-batch) stream. Types mirror ``sink.to_arrow_batch``: int32
+    fixed planes, ``large_utf8``/``large_binary`` var planes."""
+    pa = _pyarrow()
+    fields = []
+    for name in columns:
+        if name in VAR_STR_COLUMNS:
+            typ = pa.large_utf8()
+        elif name in VAR_BYTES_COLUMNS:
+            typ = pa.large_binary()
+        else:
+            typ = pa.int32()
+        fields.append(pa.field(name, typ))
+    return pa.schema(fields)
+
+
+def stream_frames(batch, batch_rows: int,
+                  columns) -> "tuple[list[bytes], int]":
+    """Render ``batch``'s valid rows as IPC stream frames:
+    ``[schema, record-batch..., EOS]``. Returns ``(frames, rows)``."""
+    from spark_bam_tpu.columnar.from_parser import (
+        read_batch_to_record_batches,
+    )
+
+    frames = [bytes(arrow_schema(columns).serialize())]
+    rows = 0
+    for rb in read_batch_to_record_batches(batch, batch_rows, columns):
+        frames.append(bytes(to_arrow_batch(rb).serialize()))
+        rows += rb.num_rows
+    frames.append(EOS)
+    return frames, rows
+
+
+def open_stream(buf):
+    """Convenience reader: ``open_stream(b"".join(frames))`` (bytes or
+    a mapped memoryview — kept zero-copy either way)."""
+    pa = _pyarrow()
+    return pa.ipc.open_stream(pa.py_buffer(buf))

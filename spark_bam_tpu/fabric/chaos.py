@@ -32,6 +32,17 @@ ZERO chaos branches, so an unconfigured fabric pays nothing):
 - ``slow``   — slow-link throttle: ``slow_ms`` extra latency per send.
 - ``accept`` — delay at the client↔router accept loop (edge latency).
 
+Faults at the shared-memory transport seam (rolled by the SERVE accept
+loop per frame record when any rate is set — serve/server.py builds a
+:class:`FabricChaos` from the same ``chaos=`` spec):
+
+- ``shm_crc``    — corrupt a descriptor's guard crc: the client must
+  detect the mismatch and resume, never trust the frame.
+- ``shm_trunc``  — cut the connection mid-descriptor: a half-written
+  record then a hard abort (the resume-token path).
+- ``shm_unlink`` — unlink the ring segment mid-stream: frames already
+  described stay readable; later frames fall back to inline records.
+
 Process-level storms (:func:`storm_schedule` + :class:`ChaosStorm`,
 driving a ``WorkerPool``): seeded rolling SIGKILL (**crash** — the
 worker vanishes, TCP resets, the router fails over instantly) and
@@ -59,7 +70,8 @@ from spark_bam_tpu.obs import flight
 #: 1..4 for the byte-channel kinds; the fleet kinds extend the space).
 _KINDS = {
     "drop": 11, "delay": 12, "trunc": 13, "dup": 14, "slow": 15,
-    "accept": 16, "storm": 17,
+    "accept": 16, "storm": 17, "shm_crc": 18, "shm_trunc": 19,
+    "shm_unlink": 20,
 }
 
 
@@ -77,12 +89,19 @@ class FabricChaosSpec:
     slow: float = 0.0      # slow-link rate (per request send)
     slow_ms: float = 5.0
     accept: float = 0.0    # accept-loop delay rate (per request)
+    # shm-transport seam (serve/shm.py; rolled per frame RECORD by the
+    # serve accept loop, not the router — the faults live where the
+    # descriptors are minted):
+    shm_crc: float = 0.0     # stale/corrupt descriptor crc rate
+    shm_trunc: float = 0.0   # descriptor truncated mid-record rate
+    shm_unlink: float = 0.0  # segment unlinked mid-stream rate
     kills: int = 0         # storm: SIGKILL events
     wedges: int = 0        # storm: SIGSTOP (wedge) events
     storm_ms: float = 500.0   # storm: pacing between events
     revive_ms: float = 400.0  # storm: kill→respawn / wedge→SIGCONT delay
 
     _FLOAT = ("drop", "delay", "trunc", "dup", "slow", "accept",
+              "shm_crc", "shm_trunc", "shm_unlink",
               "storm_ms", "revive_ms")
     _INT = ("kills", "wedges")
 

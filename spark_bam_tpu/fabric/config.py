@@ -38,6 +38,8 @@ class FabricConfig:
     # --- streaming failover + chaos (both opt-in; zero cost unset) ---
     stream: int = 0               # relay batch frames as they arrive
     chaos: str = ""               # "SEED:SPEC" (fabric/chaos.py grammar)
+    # --- zero-copy descriptor relay (serve/shm.py; needs stream=1) ---
+    shm: int = 1                  # offer transport=shm to router clients
     # --- autoscaler actuation bounds (per worker, via the ``tune`` op) ---
     batch_floor: int = 1          # batch_rows floor (mesh-rounded upward)
     batch_ceil: int = 64          # batch_rows ceiling
@@ -128,6 +130,7 @@ class FabricConfig:
         "brownout_frac": "brownout_frac",
         "stream": "stream",
         "chaos": "chaos",
+        "shm": "shm",
         "batch_floor": "batch_floor",
         "batch_ceil": "batch_ceil",
         "tick_floor": "tick_floor",

@@ -63,6 +63,7 @@ from spark_bam_tpu.fabric.config import FabricConfig
 from spark_bam_tpu.fabric.resilience import RetryBudget, brownout_level
 from spark_bam_tpu.obs import flight
 from spark_bam_tpu.obs import trace as obs_trace
+from spark_bam_tpu.serve import shm
 from spark_bam_tpu.serve.admission import CLASS_OF
 from spark_bam_tpu.serve.protocol import error_response, ok_response
 from spark_bam_tpu.serve.server import MAX_LINE, ServeAddress
@@ -300,6 +301,15 @@ class Router:
                 WorkerLink(f"w{i}", addr) for i, addr in enumerate(addresses)
             ]
         self.budget = RetryBudget(self.fcfg.budget, self.fcfg.budget_rate)
+        # Zero-copy descriptor relay (docs/serving.md "Transport"): the
+        # accept loop reads these to answer ``hello`` exactly as it does
+        # for a worker, so a local client maps the ROUTER's ring; ring
+        # sizing comes from the serve config the fleet already carries.
+        scfg = self.config.serve_config
+        self.shm_enabled = bool(self.fcfg.shm) and bool(scfg.shm)
+        self.shm_bytes = int(scfg.shm_bytes)
+        self.shm_wait_ms = float(scfg.shm_wait_ms)
+        self.shm_chaos = None   # fleet chaos hits links, not the client ring
         self._latency = LatencyTracker(window=128)
         self.pool = pool            # optional WorkerPool (drain → terminate)
         self.draining = False
@@ -425,7 +435,7 @@ class Router:
         capacity the fleet is trying to win back."""
         return self._brownout() > 0
 
-    async def _chaos_submit(self, req: dict) -> dict:
+    async def _chaos_submit(self, req: dict, conn=None) -> dict:
         """Accept-loop chaos (installed as ``self.submit`` when the spec
         sets ``accept>0``): delay a seeded subset of client requests at
         the fleet edge before normal routing."""
@@ -434,12 +444,16 @@ class Router:
             # lint: allow[obs-contract] literal name in obs/names.py
             obs.count("fabric.chaos.accept_delays")
             await asyncio.sleep(chaos.spec.delay_ms / 1000.0)
-        return await Router.submit(self, req)
+        return await Router.submit(self, req, conn=conn)
 
     # -------------------------------------------------------------- serving
-    async def submit(self, req: dict) -> dict:
+    async def submit(self, req: dict, conn=None) -> dict:
         """The accept loop's entry point (awaitable counterpart of
-        ``SplitService.submit``)."""
+        ``SplitService.submit``). ``conn`` is the accept loop's
+        per-connection transport state: when the CLIENT negotiated shm,
+        the streaming relay forwards same-host workers' frame
+        descriptors instead of re-copying bytes (docs/serving.md
+        "Transport")."""
         await self.ensure_started()
         op = req.get("op")
         if op == "ping":
@@ -463,7 +477,7 @@ class Router:
             )
         if op in ("submit", "job_status", "job_cancel"):
             return await self._route_job(req)
-        return await self._route(req)
+        return await self._route(req, conn=conn)
 
     async def _relay(self, link: WorkerLink, req: dict,
                      ctx: "obs_trace.TraceContext | None") -> dict:
@@ -484,7 +498,7 @@ class Router:
                 fwd = dict(req, trace={"id": sp.trace_id, "span": sp.span_id})
                 return await link.request(fwd)
 
-    async def _route(self, req: dict) -> dict:
+    async def _route(self, req: dict, conn=None) -> dict:
         op = req.get("op")
         path = req.get("path")
         # Mint a trace on behalf of bare clients (the router is the fleet
@@ -505,7 +519,7 @@ class Router:
                 retry_after_ms=round(self._shed_hint_ms(), 3),
             )
         if op in ("batch", "aggregate") and self.fcfg.stream:
-            return await self._stream_route(req, ctx)
+            return await self._stream_route(req, ctx, conn=conn)
         idempotent = op in IDEMPOTENT_OPS
         shed_resp = None
         for round_no in range(self.policy.max_retries + 1):
@@ -678,15 +692,31 @@ class Router:
                     self._note_job(jid, resp, wid=nxt.wid)
 
     # ------------------------------------------------------------ streaming
+    @staticmethod
+    def _link_local(link: WorkerLink) -> bool:
+        """Whether the worker plausibly shares this host — the only
+        placement where relaying its shm descriptors can work (the
+        client must be able to map the segment path)."""
+        addr = link.address
+        if addr.kind == "unix":
+            return True
+        host = str(addr.host)
+        return host.startswith("127.") or host in ("::1", "localhost")
+
     async def _stream_open(self, link: WorkerLink, req: dict,
-                           ctx, resume_from: int):
+                           ctx, resume_from: int, shm_offer: bool = False):
         """Open a DEDICATED upstream connection for one streaming
         response and read its head. The multiplexed link must buffer
         complete responses (frames from different requests would
         interleave); a stream gets its own socket so the router can relay
-        frames the moment they arrive. Returns ``(head, reader,
-        writer)``; raises :class:`WorkerLost` when the worker can't be
-        reached or dies before the head."""
+        frames the moment they arrive. With ``shm_offer`` a ``hello``
+        rides the SAME buffered write as the request (one syscall, no
+        extra round-trip); a granted upstream answers with frame
+        descriptors the relay forwards without touching the bytes.
+        Returns ``(head, reader, writer, up_shm)`` — ``up_shm`` is the
+        granted ``{"segment", "segment_id"}`` or None; raises
+        :class:`WorkerLost` when the worker can't be reached or dies
+        before the head."""
         addr = link.address
         try:
             if addr.kind == "unix":
@@ -706,22 +736,37 @@ class Router:
         if ctx is not None:
             fwd["trace"] = obs_trace.carrier(ctx)
         try:
-            writer.write((json.dumps(fwd) + "\n").encode())
+            payload = b""
+            if shm_offer:
+                payload += (json.dumps(
+                    {"op": "hello", "transport": "shm", "id": 0}
+                ) + "\n").encode()
+            payload += (json.dumps(fwd) + "\n").encode()
+            writer.write(payload)
             await writer.drain()
+            up_shm = None
+            if shm_offer:
+                hline = await reader.readline()
+                if not hline:
+                    raise ConnectionError("worker closed during hello")
+                h = json.loads(hline)
+                if h.get("ok") and h.get("transport") == "shm":
+                    up_shm = {"segment": str(h["segment"]),
+                              "segment_id": int(h["segment_id"])}
             line = await reader.readline()
             if not line:
                 raise ConnectionError("worker closed before the stream head")
             head = json.loads(line)
-        except (ConnectionError, OSError, ValueError,
+        except (ConnectionError, OSError, ValueError, KeyError,
                 asyncio.IncompleteReadError) as exc:
             try:
                 writer.close()
             except Exception:
                 pass
             raise WorkerLost(f"worker {link.wid}: {exc}") from exc
-        return head, reader, writer
+        return head, reader, writer, up_shm
 
-    async def _stream_route(self, req: dict, ctx) -> dict:
+    async def _stream_route(self, req: dict, ctx, conn=None) -> dict:
         """Streaming relay for ``batch`` (``stream=1``): forward the head
         as soon as the first worker answers, then hand the accept loop an
         async frame iterator (``_binary_iter``) that relays each frame as
@@ -729,9 +774,23 @@ class Router:
         on a replacement worker with ``resume_from = N`` (plus whatever
         resume base the CLIENT sent — the token composes end-to-end), so
         the delivered frame sequence is byte-identical to an undisturbed
-        run without the router ever buffering the response."""
+        run without the router ever buffering the response.
+
+        When the CLIENT negotiated shm (``conn.transport == "shm"``) and
+        the chosen worker is same-host and grants shm upstream, the
+        relay switches to DESCRIPTOR mode (``_records_iter``): the
+        worker's segment is announced downstream under a router-assigned
+        id and its descriptors are remapped and forwarded — the frame
+        bytes never enter router memory, and the client acks straight
+        into the worker's ring. Any other combination (socket client,
+        remote worker, shm-less worker, failover onto one) degrades to
+        byte relay per frame — inline records downstream cost one copy,
+        exactly the classic path."""
         path = req.get("path")
         client_base = int(req.get("resume_from") or 0)
+        want_shm = (conn is not None
+                    and getattr(conn, "transport", "socket") == "shm"
+                    and bool(self.fcfg.shm))
         tried: set = set()
         shed_resp = None
         while True:
@@ -745,8 +804,9 @@ class Router:
                 )
             tried.add(link.wid)
             try:
-                head, reader, writer = await self._stream_open(
-                    link, req, ctx, client_base
+                head, reader, writer, up_shm = await self._stream_open(
+                    link, req, ctx, client_base,
+                    shm_offer=want_shm and self._link_local(link),
                 )
             except WorkerLost:
                 if not self.budget.try_spend():
@@ -761,6 +821,10 @@ class Router:
                 self._count("budget_spent")
                 continue
             if head.get("ok") is False:
+                try:
+                    writer.close()
+                except Exception:
+                    pass
                 if head.get("error") in ("Overloaded", "Draining"):
                     shed_resp = dict(head, id=req.get("id"))
                     continue        # spill to the next-best worker
@@ -793,10 +857,12 @@ class Router:
                             delivered=delivered, total=total,
                             error=str(exc),
                         )
-                        reader, writer, cur_wid = await self._stream_resume(
-                            req, ctx, cur_wid,
-                            client_base + delivered, total - delivered,
-                            writer,
+                        reader, writer, cur_wid, _ = (
+                            await self._stream_resume(
+                                req, ctx, cur_wid,
+                                client_base + delivered, total - delivered,
+                                writer,
+                            )
                         )
                         continue
                     delivered += 1
@@ -808,20 +874,135 @@ class Router:
                 except Exception:
                     pass
 
+        async def records():
+            # Descriptor relay: upstream RECORDS in, remapped records
+            # out. ``segmap`` translates worker segment ids into this
+            # downstream connection's id space (drawn from the same
+            # allocator as the connection's own ring, so they can never
+            # collide); a failover onto a shm-less upstream downgrades
+            # to wrapping its plain frames as inline records mid-stream.
+            nonlocal reader, writer
+            delivered = 0
+            cur_wid = link.wid
+            chaos = self.chaos
+            up_mode = "records"
+            segmap: "dict[int, int]" = {}
+            ds = conn.alloc_seg_id()
+            segmap[int(up_shm["segment_id"])] = ds
+            obs.count("transport.segment_announces")
+            yield shm.pack_segment(ds, up_shm["segment"])
+            try:
+                while delivered < total:
+                    try:
+                        if chaos is not None and chaos.roll("trunc"):
+                            # lint: allow[obs-contract] in obs/names.py
+                            obs.count("fabric.chaos.truncs")
+                            raise ConnectionError("chaos: stream truncated")
+                        if up_mode == "frames":
+                            hdr = await reader.readexactly(8)
+                            (length,) = struct.unpack("<Q", hdr)
+                            rec = shm.pack_inline(
+                                await reader.readexactly(length)
+                            )
+                        else:
+                            kb = await reader.readexactly(1)
+                            kind = kb[0]
+                            if kind == shm.REC_SEGMENT:
+                                body = await reader.readexactly(
+                                    shm.SEG.size
+                                )
+                                up_id, plen = shm.SEG.unpack(body)
+                                spath = (
+                                    await reader.readexactly(plen)
+                                ).decode()
+                                nds = conn.alloc_seg_id()
+                                segmap[up_id] = nds
+                                obs.count("transport.segment_announces")
+                                yield shm.pack_segment(nds, spath)
+                                continue    # announces aren't frames
+                            if kind == shm.REC_INLINE:
+                                hdr = await reader.readexactly(8)
+                                (length,) = struct.unpack("<Q", hdr)
+                                rec = kb + hdr + (
+                                    await reader.readexactly(length)
+                                )
+                            elif kind == shm.REC_SHM:
+                                body = await reader.readexactly(
+                                    shm.DESC.size
+                                )
+                                up_id, offset, length, crc = (
+                                    shm.DESC.unpack(body)
+                                )
+                                mapped = segmap.get(up_id)
+                                if mapped is None:
+                                    raise ConnectionError(
+                                        "descriptor for unannounced "
+                                        f"segment {up_id}"
+                                    )
+                                obs.count("transport.relay_descriptors")
+                                rec = shm.pack_desc(
+                                    mapped, offset, length, crc
+                                )
+                            else:
+                                raise ConnectionError(
+                                    f"unknown record kind {kind}"
+                                )
+                    except (ConnectionError, OSError,
+                            asyncio.IncompleteReadError) as exc:
+                        flight.record(
+                            "stream_lost", worker=cur_wid,
+                            op=req.get("op", "batch"),
+                            delivered=delivered, total=total,
+                            error=str(exc),
+                        )
+                        reader, writer, cur_wid, new_shm = (
+                            await self._stream_resume(
+                                req, ctx, cur_wid,
+                                client_base + delivered, total - delivered,
+                                writer, shm_offer=True,
+                            )
+                        )
+                        if new_shm is not None:
+                            # Replacement worker's segment, fresh id —
+                            # the failover re-announce of docs/serving.md.
+                            up_mode = "records"
+                            segmap = {}
+                            nds = conn.alloc_seg_id()
+                            segmap[int(new_shm["segment_id"])] = nds
+                            obs.count("transport.segment_announces")
+                            yield shm.pack_segment(nds, new_shm["segment"])
+                        else:
+                            up_mode = "frames"
+                        continue
+                    delivered += 1
+                    self._count("stream_frames")
+                    yield rec
+            finally:
+                try:
+                    writer.close()
+                except Exception:
+                    pass
+
         resp = {k: v for k, v in head.items()
                 if k not in ("resume_from", "total_frames")}
         resp["id"] = req.get("id")
         resp["binary_frames"] = total
-        resp["_binary_iter"] = frames()
+        if want_shm and up_shm is not None:
+            resp["_records_iter"] = records()
+        else:
+            resp["_binary_iter"] = frames()
         return resp
 
     async def _stream_resume(self, req: dict, ctx, dead_wid: str,
-                             resume_from: int, need: int, old_writer):
+                             resume_from: int, need: int, old_writer,
+                             shm_offer: bool = False):
         """Find a replacement worker mid-stream and re-open from the
         resume token. Budget-gated like any failover; raises
         :class:`WorkerLost` when the budget or the fleet runs out (the
         accept loop then ABORTS the client connection — a half-delivered
-        frame sequence must never look complete)."""
+        frame sequence must never look complete). Returns ``(reader,
+        writer, wid, up_shm)`` — ``up_shm`` is the replacement's granted
+        segment when ``shm_offer`` held and the worker is same-host."""
         try:
             old_writer.close()
         except Exception:
@@ -840,8 +1021,9 @@ class Router:
             if nxt is None:
                 raise WorkerLost("no healthy workers to resume the stream")
             try:
-                head, reader, writer = await self._stream_open(
-                    nxt, req, ctx, resume_from
+                head, reader, writer, up_shm = await self._stream_open(
+                    nxt, req, ctx, resume_from,
+                    shm_offer=shm_offer and self._link_local(nxt),
                 )
             except WorkerLost:
                 exclude.add(nxt.wid)
@@ -876,7 +1058,7 @@ class Router:
             self._count("resumed")
             flight.record("stream_resume", worker=nxt.wid,
                           resume_from=resume_from, frames=need)
-            return reader, writer, nxt.wid
+            return reader, writer, nxt.wid, up_shm
 
     # ------------------------------------------------------------ admin ops
     def _admin_targets(self, req: dict) -> "list[WorkerLink]":

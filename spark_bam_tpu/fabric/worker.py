@@ -106,6 +106,11 @@ def serve_worker(
         chaos_spec = ""
     if chaos_spec:
         flight.set_context(chaos=chaos_spec)
+    # A SIGKILL'd predecessor can't unlink its ring segments; sweep any
+    # whose creating pid is dead so a storm can't leak /dev/shm.
+    from spark_bam_tpu.serve.shm import sweep_orphans
+
+    sweep_orphans()
     service = SplitService(config, mesh=local_mesh())
 
     stop = threading.Event()

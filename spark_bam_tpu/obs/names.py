@@ -25,7 +25,7 @@ LAYERS = frozenset({
     "account", "agg", "bgzf", "cache", "chaos", "check", "cli",
     "columnar", "compress", "deflate", "fabric", "faults", "funnel",
     "guard", "inflate", "jobs", "load", "mesh", "progress", "remote",
-    "sampler", "scrub", "serve", "slo", "timer", "ts",
+    "sampler", "scrub", "serve", "slo", "timer", "transport", "ts",
 })
 
 NAMES = frozenset({
@@ -88,6 +88,10 @@ NAMES = frozenset({
     "fabric.chaos.truncs", "fabric.chaos.slowed",
     "fabric.chaos.accept_delays", "fabric.chaos.kills",
     "fabric.chaos.wedges",
+    # fabric.chaos shm seam — rolled per frame record by the serve
+    # accept loop (docs/serving.md "Transport")
+    "fabric.chaos.shm_crcs", "fabric.chaos.shm_truncs",
+    "fabric.chaos.shm_unlinks",
     # faults — retry/hedge/quarantine ledger (docs/robustness.md)
     "faults.attempt_ms", "faults.hedges", "faults.quarantined",
     "faults.quarantined_blocks", "faults.retries",
@@ -140,8 +144,19 @@ NAMES = frozenset({
     "serve.parse", "serve.queue_depth", "serve.queue_ms", "serve.request",
     "serve.requests", "serve.rewrite", "serve.shed", "serve.stream_aborts",
     "serve.tick", "serve.tuned",
+    # serve shm — segment lifecycle + encoded-frame cache
+    # (docs/serving.md "Transport")
+    "serve.frame_cache_hits", "serve.frame_cache_misses",
+    "serve.shm_crc_errors", "serve.shm_orphans_cleaned",
+    "serve.shm_segments",
     # slo — burn-rate objective engine (obs/slo.py)
     "slo.alerts", "slo.burn_rate", "slo.evals", "slo.firing",
+    # transport — zero-copy data plane: shm rings, descriptor relay,
+    # handshake downgrades (docs/serving.md "Transport")
+    "transport.downgrades", "transport.inline_frames",
+    "transport.relay_descriptors", "transport.ring_full_waits",
+    "transport.segment_announces", "transport.shm_bytes",
+    "transport.shm_connections", "transport.shm_frames",
     # ts — time-series ring scraper (obs/timeseries.py)
     "ts.scrapes", "ts.series",
 })

@@ -26,6 +26,12 @@ from spark_bam_tpu.serve.server import (
     start_server,
 )
 from spark_bam_tpu.serve.service import ServiceError, SplitService
+from spark_bam_tpu.serve.shm import (
+    SegmentReader,
+    SegmentWriter,
+    ShmError,
+    sweep_orphans,
+)
 
 __all__ = [
     "AdmissionGate",
@@ -35,12 +41,15 @@ __all__ = [
     "Overloaded",
     "ProtocolError",
     "RowTask",
+    "SegmentReader",
+    "SegmentWriter",
     "ServeAddress",
     "ServeClient",
     "ServeClientError",
     "ServeConfig",
     "ServerThread",
     "ServiceError",
+    "ShmError",
     "SplitService",
     "decode_request",
     "encode",
@@ -48,4 +57,5 @@ __all__ = [
     "ok_response",
     "serve_forever",
     "start_server",
+    "sweep_orphans",
 ]

@@ -20,6 +20,20 @@ the request with ``resume_from=<frames held>`` — the frame-sequence
 resume token of docs/robustness.md. Against a streaming fabric router
 the replacement worker serves only the missing tail; the reassembled
 frame list is byte-identical to an undisturbed response.
+
+With ``transport="auto"`` (the default) the client opens each
+connection with a ``hello`` asking for the shared-memory frame
+transport (docs/serving.md "Transport"); when granted it maps the
+server's ring segment and reads frames by descriptor, zero socket
+copies. Every failure on that path — segment won't map, stale
+descriptor, guard-crc mismatch — raises :class:`~.shm.ShmError`, a
+``ConnectionError``, so it rides the SAME reconnect + ``resume_from``
+loop as a socket cut; after two shm strikes the client stops asking
+and stays on sockets (``transport="socket"`` forces that from the
+start). ``map_frames=True`` returns frames as memoryviews into the
+mapped segment (acks deferred until the next request or
+:meth:`ServeClient.release_frames`) — the ``wire=arrow`` zero-copy
+read path.
 """
 
 from __future__ import annotations
@@ -33,6 +47,7 @@ import time
 from spark_bam_tpu import obs
 from spark_bam_tpu.core.faults import FaultPolicy
 from spark_bam_tpu.obs import trace as obs_trace
+from spark_bam_tpu.serve import shm
 from spark_bam_tpu.serve.server import MAX_LINE, ServeAddress
 
 
@@ -48,15 +63,26 @@ class ServeClientError(RuntimeError):
 
 class ServeClient:
     def __init__(self, address, timeout: float = 120.0,
-                 policy: "FaultPolicy | None" = FaultPolicy()):
+                 policy: "FaultPolicy | None" = FaultPolicy(),
+                 transport: str = "auto", map_frames: bool = False):
         """``address`` is a spec string (``tcp:host:port`` / ``unix:path``),
         a ``(host, port)`` tuple, or a unix socket path. ``policy`` paces
-        Overloaded retries (None = raise immediately)."""
+        Overloaded retries (None = raise immediately). ``transport`` is
+        ``"auto"`` (hello for shm, fall back to sockets) or ``"socket"``
+        (never ask); ``map_frames`` returns shm frames as memoryviews
+        with deferred acks instead of copied bytes."""
         self.policy = policy
         self._address = address
         self._timeout = timeout
-        self._connect()
+        self._want_transport = transport
+        self._map_frames = bool(map_frames)
+        self._transport = "socket"
+        self._segments: "dict[int, shm.SegmentReader]" = {}
+        self._graveyard: "list[shm.SegmentReader]" = []
+        self._deferred: "list[tuple[shm.SegmentReader, int, int]]" = []
+        self._shm_strikes = 0
         self._next_id = 0
+        self._connect()
 
     def _connect(self) -> None:
         address, timeout = self._address, self._timeout
@@ -75,20 +101,81 @@ class ServeClient:
                     (addr.host, addr.port), timeout=timeout
                 )
         self._rfile = self._sock.makefile("rb")
+        self._handshake()
 
     def _reconnect(self) -> None:
-        self.close()
+        self.close(keep_segments=True)
         self._connect()
+
+    # ----- transport negotiation -------------------------------------
+
+    def _roundtrip(self, req: dict) -> dict:
+        """One JSON line out, one in — control exchanges with no frames."""
+        self._next_id += 1
+        self._sock.sendall(
+            (json.dumps({**req, "id": self._next_id}) + "\n").encode()
+        )
+        line = self._rfile.readline(MAX_LINE)
+        if not line:
+            raise ConnectionError("server closed the connection")
+        return json.loads(line)
+
+    def _handshake(self) -> None:
+        """Ask for ``transport=shm`` unless told not to (or burned: two
+        shm strikes pin the client to sockets — the universal fallback)."""
+        self._transport = "socket"
+        if self._want_transport == "socket" or self._shm_strikes >= 2:
+            return
+        resp = self._roundtrip({"op": "hello", "transport": "shm"})
+        if not resp.get("ok") or resp.get("transport") != "shm":
+            return
+        try:
+            self._open_segment(int(resp["segment_id"]), str(resp["segment"]))
+        except (OSError, shm.ShmError, KeyError, ValueError):
+            # Granted but unmappable (container boundary, permissions):
+            # tell the server so it frees the ring and sends plain frames.
+            obs.count("transport.downgrades")
+            self._roundtrip({"op": "hello", "transport": "socket"})
+            return
+        self._transport = "shm"
+
+    def _open_segment(self, seg_id: int, path: str) -> None:
+        old = self._segments.pop(seg_id, None)
+        if old is not None:
+            # Frames already handed out may still view the old mapping
+            # (map_frames / resume progress): keep it mapped until close.
+            self._graveyard.append(old)
+        try:
+            self._segments[seg_id] = shm.SegmentReader(path, seg_id)
+        except OSError as exc:
+            raise shm.ShmError(f"cannot map segment {path}: {exc}") from exc
+
+    @property
+    def transport(self) -> str:
+        """The negotiated transport of the CURRENT connection."""
+        return self._transport
+
+    def release_frames(self) -> None:
+        """Ack every deferred (``map_frames``) range back to the server's
+        reclaim cursor. Called automatically at the next request — by
+        then the previous response's views must no longer be read."""
+        deferred, self._deferred = self._deferred, []
+        for reader, offset, length in deferred:
+            reader.ack(offset, length)
+
+    # ----- requests ---------------------------------------------------
 
     def request(self, op: str, **fields) -> dict:
         """Send one request and block for its response payload. Responses
         announcing ``binary_frames`` (``batch``/``aggregate``) have that many
-        u64-length-prefixed frames read off the socket and attached as a
-        list of bytes under ``"_binary"`` — concatenated they are a
-        native columnar container (columnar/native.py). ``Overloaded``
-        responses honor their Retry-After hint under ``self.policy``;
-        ``batch`` requests that lose the connection mid-stream reconnect
-        and resume from the frames already held (``resume_from``)."""
+        frames read off the transport and attached as a list of bytes
+        under ``"_binary"`` — concatenated they are a native columnar
+        container (columnar/native.py), or an Arrow IPC stream when the
+        request said ``wire=arrow``. ``Overloaded`` responses honor their
+        Retry-After hint under ``self.policy``; ``batch`` requests that
+        lose the connection (or the shm stream) mid-read reconnect and
+        resume from the frames already held (``resume_from``)."""
+        self.release_frames()
         retries = self.policy.max_retries if self.policy is not None else 0
         # Frames survive across resume attempts: a mid-stream loss keeps
         # what arrived and asks only for the tail.
@@ -97,14 +184,20 @@ class ServeClient:
         )
         for attempt in range(retries + 1):
             try:
-                return self._request_once(op, fields, progress=progress)
+                resp = self._request_once(op, fields, progress=progress)
+                resp["_transport"] = self._transport
+                return resp
             except ServeClientError as exc:
                 if exc.error != "Overloaded" or attempt >= retries:
                     raise
                 time.sleep(self._overload_delay(exc, attempt))
-            except (ConnectionError, OSError, json.JSONDecodeError):
+            except (ConnectionError, OSError, json.JSONDecodeError) as exc:
                 # A death mid-JSON-line decodes as garbage; treat it the
-                # same as a mid-frame cut — reconnect and resume.
+                # same as a mid-frame cut — reconnect and resume. Shm
+                # faults land here too (ShmError IS a ConnectionError);
+                # repeated strikes downgrade the reconnect to sockets.
+                if isinstance(exc, shm.ShmError):
+                    self._shm_strikes += 1
                 if progress is None or attempt >= retries:
                     raise
                 self._reconnect()
@@ -120,7 +213,7 @@ class ServeClient:
         return d * (1 - p.jitter + p.jitter * random.random())
 
     def _request_once(self, op: str, fields: dict,
-                      progress: "list[bytes] | None" = None) -> dict:
+                      progress: "list | None" = None) -> dict:
         self._next_id += 1
         req = {"op": op, "id": self._next_id, **fields}
         # Frames held at ENTRY came from a prior severed attempt — only
@@ -148,9 +241,12 @@ class ServeClient:
         n_frames = int(resp.get("binary_frames") or 0)
         if n_frames:
             frames = progress if progress is not None else []
-            for _ in range(n_frames):
-                (length,) = struct.unpack("<Q", self._read_exact(8))
-                frames.append(self._read_exact(length))
+            if self._transport == "shm":
+                self._read_records(n_frames, frames)
+            else:
+                for _ in range(n_frames):
+                    (length,) = struct.unpack("<Q", self._read_exact(8))
+                    frames.append(self._read_exact(length))
             resp["_binary"] = list(frames)
         elif resuming:
             # Resumed with zero frames left to serve (the loss hit after
@@ -163,6 +259,42 @@ class ServeClient:
             resp.pop("total_frames", None)
         return resp
 
+    def _read_records(self, n_frames: int, frames: list) -> None:
+        """Drain ``n_frames`` transport records (serve/shm.py grammar).
+        Segment announces (kind 2) may interleave and don't count."""
+        got = 0
+        while got < n_frames:
+            kind = self._read_exact(1)[0]
+            if kind == shm.REC_SEGMENT:
+                seg_id, plen = shm.SEG.unpack(self._read_exact(shm.SEG.size))
+                self._open_segment(seg_id, self._read_exact(plen).decode())
+                continue
+            if kind == shm.REC_INLINE:
+                (length,) = struct.unpack("<Q", self._read_exact(8))
+                frames.append(self._read_exact(length))
+                got += 1
+                continue
+            if kind == shm.REC_SHM:
+                seg_id, offset, length, crc = shm.DESC.unpack(
+                    self._read_exact(shm.DESC.size)
+                )
+                reader = self._segments.get(seg_id)
+                if reader is None:
+                    raise shm.ShmError(
+                        f"descriptor references unknown segment {seg_id}"
+                    )
+                view = reader.read(offset, length, crc)
+                if self._map_frames:
+                    frames.append(view)
+                    self._deferred.append((reader, offset, length))
+                else:
+                    frames.append(bytes(view))
+                    view.release()
+                    reader.ack(offset, length)
+                got += 1
+                continue
+            raise shm.ShmError(f"unknown transport record kind {kind}")
+
     def _read_exact(self, n: int) -> bytes:
         out = bytearray()
         while len(out) < n:
@@ -174,11 +306,17 @@ class ServeClient:
             out.extend(piece)
         return bytes(out)
 
-    def close(self) -> None:
+    def close(self, keep_segments: bool = False) -> None:
         try:
             self._rfile.close()
         finally:
             self._sock.close()
+        if not keep_segments:
+            self.release_frames()
+            for reader in (*self._segments.values(), *self._graveyard):
+                reader.close()
+            self._segments.clear()
+            self._graveyard.clear()
 
     def __enter__(self) -> "ServeClient":
         return self

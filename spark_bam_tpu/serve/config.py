@@ -33,6 +33,10 @@ class ServeConfig:
     window: int = 1 << 20         # uncompressed bytes per row window
     halo: int = 1 << 16           # trailing lookahead per row
     flat_cache: int = 256 << 20   # resident flat-view byte budget (LRU)
+    # --- zero-copy transport (serve/shm.py; docs/serving.md "Transport")
+    shm: int = 1                  # offer transport=shm in the hello exchange
+    shm_bytes: int = 64 << 20     # ring-segment capacity per connection
+    shm_wait_ms: float = 200.0    # ack wait before a full ring goes inline
 
     def __post_init__(self):
         if self.batch_rows < 1 or self.workers < 1:
@@ -54,6 +58,14 @@ class ServeConfig:
             )
         if self.flat_cache < 1:
             raise ValueError(f"serve flat cache must be >= 1: {self.flat_cache}")
+        if self.shm_bytes < 1 << 16:
+            raise ValueError(
+                f"serve shm_bytes must be >= 64KB: {self.shm_bytes}"
+            )
+        if self.shm_wait_ms < 0:
+            raise ValueError(
+                f"serve shm_wait must be >= 0 ms: {self.shm_wait_ms}"
+            )
 
     _KEYS = {
         "batch": "batch_rows",
@@ -69,8 +81,12 @@ class ServeConfig:
         "halo": "halo",
         "cache": "flat_cache",
         "flat_cache": "flat_cache",
+        "shm": "shm",
+        "shm_bytes": "shm_bytes",
+        "shm_wait": "shm_wait_ms",
+        "shm_wait_ms": "shm_wait_ms",
     }
-    _BYTE_KEYS = ("window", "halo", "flat_cache")
+    _BYTE_KEYS = ("window", "halo", "flat_cache", "shm_bytes")
 
     @staticmethod
     @lru_cache(maxsize=64)
@@ -93,7 +109,7 @@ class ServeConfig:
                 )
             if field in ServeConfig._BYTE_KEYS:
                 kw[field] = parse_bytes(value)
-            elif field == "tick_ms":
+            elif field in ("tick_ms", "shm_wait_ms"):
                 kw[field] = float(value)
             else:
                 kw[field] = int(value)

@@ -16,7 +16,26 @@ same query. Handlers stage the chunks on the in-process response under
 the ``"_binary"`` key; the server pops it before JSON encoding. The
 fabric router's streaming relay stages an ASYNC ITERATOR under
 ``"_binary_iter"`` instead — same wire format, but the server writes
-each frame as it arrives rather than joining a buffered list.
+each frame as it arrives rather than joining a buffered list. A relay
+that has already ENCODED transport records (the router's zero-copy
+descriptor relay) stages them under ``"_records_iter"`` and the server
+forwards the bytes verbatim.
+
+``hello`` is the transport negotiation op, answered by the ACCEPT LOOP
+itself (serve/server.py), never the service — transport is connection
+state, not request state. ``{"op": "hello", "transport": "shm"}`` asks
+for the shared-memory frame transport; a capable server answers
+``{"transport": "shm", "segment": <path>, "segment_id": N,
+"segment_bytes": M}`` and from then on that connection's binary frames
+travel as transport RECORDS (serve/shm.py: inline / shm-descriptor /
+segment-announce), not bare u64-framed bytes. Any other answer (or no
+hello at all) keeps classic socket framing — the universal fallback and
+the only remote path. A later ``hello`` with ``transport=socket``
+downgrades the connection back (the client does this when it cannot map
+the announced segment). ``wire=arrow`` on a ``batch`` request swaps the
+frame payload from the SBCR container to Arrow IPC stream format
+(columnar/arrow_ipc.py) — same framing, resume token and counts either
+way.
 
 ``batch`` (and ``rewrite``, vacuously) accept an optional ``resume_from``
 integer — the frame-sequence resume token (docs/robustness.md): the
@@ -68,7 +87,7 @@ import json
 #: ops answered by the service; anything else is a ProtocolError.
 OPS = ("ping", "stats", "plan", "record_starts", "count", "fleet", "batch",
        "aggregate", "rewrite", "drain", "tune", "telemetry", "alerts",
-       "submit", "job_status", "job_cancel")
+       "submit", "job_status", "job_cancel", "hello")
 
 
 class ProtocolError(ValueError):

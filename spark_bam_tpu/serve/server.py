@@ -4,22 +4,32 @@ The event loop only parses lines and shuttles futures — all real work
 happens on the service's worker pool and the batcher thread, so a slow
 request never stalls accepts. Each connection may pipeline requests;
 responses carry the client's ``id`` and may complete out of order.
+
+Transport negotiation lives HERE, not in the service: ``hello`` is
+answered by the accept loop because transport is per-connection state
+(docs/serving.md "Transport"). A connection that negotiates
+``transport=shm`` gets a ring segment (serve/shm.py) and its binary
+frames leave as descriptor records; everything else keeps the classic
+u64-framed socket path, byte-for-byte as before.
 """
 
 from __future__ import annotations
 
 import asyncio
 import concurrent.futures
+import socket as _socket
 import struct
 import threading
 
 from spark_bam_tpu import obs
+from spark_bam_tpu.serve import shm
 from spark_bam_tpu.serve.admission import Overloaded
 from spark_bam_tpu.serve.protocol import (
     ProtocolError,
     decode_request,
     encode,
     error_response,
+    ok_response,
 )
 from spark_bam_tpu.serve.service import SplitService
 
@@ -27,49 +37,240 @@ from spark_bam_tpu.serve.service import SplitService
 MAX_LINE = 4 << 20
 
 
+class _Conn:
+    """Per-connection transport state (hello-negotiated). Touched only
+    on the event loop — no locks."""
+
+    __slots__ = ("transport", "ring", "wait_s", "chaos", "_next_seg_id")
+
+    def __init__(self):
+        self.transport = "socket"
+        self.ring: "shm.SegmentWriter | None" = None
+        self.wait_s = 0.2
+        self.chaos = None
+        self._next_seg_id = 0
+
+    def alloc_seg_id(self) -> int:
+        """Connection-unique segment ids: the router's descriptor relay
+        announces UPSTREAM segments on the same id space, so both its
+        own ring and remapped worker segments draw from one counter."""
+        self._next_seg_id += 1
+        return self._next_seg_id
+
+    def close_ring(self) -> None:
+        ring, self.ring = self.ring, None
+        if ring is not None:
+            ring.close()
+
+    def detach_ring(self) -> "shm.SegmentWriter | None":
+        ring, self.ring = self.ring, None
+        return ring
+
+
+#: How long a closing connection's ring may wait for the consumer's ack
+#: cursor before it is unlinked regardless (leak bound, not correctness:
+#: a consumer that mapped the segment keeps its pages either way).
+_RING_LINGER_S = 10.0
+
+
+async def _drain_then_close(ring: "shm.SegmentWriter", loop) -> None:
+    deadline = loop.time() + _RING_LINGER_S
+    try:
+        while not ring.drained() and loop.time() < deadline:
+            await asyncio.sleep(0.02)
+    finally:
+        ring.close()
+
+
+def _local_peer(writer) -> bool:
+    """shm segments only work same-host: unix sockets always qualify,
+    TCP only from loopback."""
+    sock = writer.get_extra_info("socket")
+    if sock is not None and sock.family == _socket.AF_UNIX:
+        return True
+    peer = writer.get_extra_info("peername")
+    host = peer[0] if isinstance(peer, (tuple, list)) and peer else None
+    if host is None:
+        return False
+    host = str(host)
+    return host.startswith("127.") or host == "::1"
+
+
+def _hello_response(service, conn: _Conn, req: dict, writer) -> dict:
+    """Negotiate the connection's transport (protocol.py ``hello``).
+    Every refusal is a DOWNGRADE to sockets, never an error — the
+    fallback path must always be reachable."""
+    want = str(req.get("transport") or "socket")
+    conn.close_ring()           # re-negotiation tears down any prior ring
+    conn.transport = "socket"
+    if want != "shm":
+        return ok_response(req, transport="socket")
+    if not getattr(service, "shm_enabled", False):
+        obs.count("transport.downgrades")
+        return ok_response(req, transport="socket",
+                           reason="server does not offer transport=shm")
+    if not _local_peer(writer):
+        obs.count("transport.downgrades")
+        return ok_response(req, transport="socket",
+                           reason="shm transport is same-host only")
+    capacity = int(getattr(service, "shm_bytes", 64 << 20))
+    asked = int(req.get("segment_bytes") or 0)
+    if asked:
+        capacity = min(capacity, asked)
+    try:
+        ring = shm.SegmentWriter(capacity, seg_id=conn.alloc_seg_id())
+    except OSError as exc:
+        obs.count("transport.downgrades")
+        return ok_response(req, transport="socket",
+                           reason=f"segment allocation failed: {exc}")
+    conn.ring = ring
+    conn.transport = "shm"
+    conn.wait_s = float(getattr(service, "shm_wait_ms", 200.0)) / 1000.0
+    conn.chaos = getattr(service, "shm_chaos", None)
+    obs.count("transport.shm_connections")
+    return ok_response(req, transport="shm", segment=ring.path,
+                       segment_id=ring.seg_id, segment_bytes=ring.capacity)
+
+
 async def _handle_connection(service: SplitService, reader, writer) -> None:
     obs.count("serve.connections")
     wlock = asyncio.Lock()
+    conn = _Conn()
+    loop = asyncio.get_running_loop()
+
+    async def record_for(frame) -> bytes:
+        """One frame → one transport record (shm connections only).
+        Ring writes are memcpy-speed and bounded; a full ring waits
+        briefly for the consumer's ack cursor, then goes inline — the
+        transport degrades, it never deadlocks."""
+        ring = conn.ring
+        chaos = conn.chaos
+        if ring is not None and ring.alive:
+            if chaos is not None and chaos.roll("shm_unlink"):
+                # lint: allow[obs-contract] literal name in obs/names.py
+                obs.count("fabric.chaos.shm_unlinks")
+                ring.sever()    # frames after this point go inline
+            else:
+                desc = ring.try_write(frame)
+                if desc is None and len(frame) <= ring.capacity:
+                    obs.count("transport.ring_full_waits")
+                    deadline = loop.time() + conn.wait_s
+                    while desc is None and loop.time() < deadline:
+                        await asyncio.sleep(0.001)
+                        desc = ring.try_write(frame)
+                if desc is not None:
+                    rec = shm.pack_desc(*desc)
+                    if chaos is not None and chaos.roll("shm_crc"):
+                        # lint: allow[obs-contract] name in obs/names.py
+                        obs.count("fabric.chaos.shm_crcs")
+                        # Stale-crc injection: the client must detect
+                        # the mismatch and resume, never trust the frame.
+                        rec = rec[:-1] + bytes([rec[-1] ^ 0xFF])
+                    if chaos is not None and chaos.roll("shm_trunc"):
+                        # lint: allow[obs-contract] name in obs/names.py
+                        obs.count("fabric.chaos.shm_truncs")
+                        raise shm.ChaosTruncation(rec[:len(rec) // 2])
+                    obs.count("transport.shm_frames")
+                    obs.count("transport.shm_bytes", len(frame))
+                    return rec
+        obs.count("transport.inline_frames")
+        return shm.pack_inline(frame)
 
     async def write(resp: dict) -> None:
         # Binary record-batch frames (the batch op) ride after the JSON
-        # line, each with a u64 length prefix; the JSON's binary_frames
-        # field tells the client how many to read (serve/protocol.py).
-        # ``_binary`` is a materialized list; ``_binary_iter`` (the
+        # line: classic connections get u64-length-prefixed bytes, shm
+        # connections get transport records (serve/protocol.py).
+        # ``_binary`` is a materialized list — the JSON line and EVERY
+        # frame coalesce into one buffered write. ``_binary_iter`` (the
         # fabric router's streaming relay) is an async iterator drained
-        # frame-by-frame under the write lock — the frames are relayed
-        # as the upstream worker produces them, never buffered whole.
+        # under the write lock with the head + first frame coalesced;
+        # ``_records_iter`` carries pre-encoded transport records (the
+        # router's descriptor relay) forwarded verbatim.
         chunks = resp.pop("_binary", None)
         frames_iter = resp.pop("_binary_iter", None)
-        data = encode(resp)
+        records_iter = resp.pop("_records_iter", None)
+        head = encode(resp)
+        poison = None
         if chunks:
-            data = b"".join(
-                [data, *(struct.pack("<Q", len(c)) + bytes(c) for c in chunks)]
-            )
-        async with wlock:
-            writer.write(data)
-            await writer.drain()
-            if frames_iter is not None:
+            if conn.transport == "shm":
+                parts = [head]
                 try:
-                    async for c in frames_iter:
-                        writer.write(struct.pack("<Q", len(c)) + bytes(c))
-                        await writer.drain()
-                except asyncio.CancelledError:
-                    raise
-                except Exception:
-                    # The JSON head already promised binary_frames the
-                    # stream can no longer deliver (resume exhausted):
-                    # abort the transport so the client sees a hard
-                    # connection error, never a silently-short response.
+                    for c in chunks:
+                        parts.append(await record_for(c))
+                except shm.ChaosTruncation as exc:
+                    parts.append(exc.partial)
+                    poison = True
+                data = b"".join(parts)
+            else:
+                data = b"".join(
+                    [head, *(struct.pack("<Q", len(c)) + bytes(c)
+                             for c in chunks)]
+                )
+        else:
+            data = head
+        if frames_iter is None and records_iter is None:
+            async with wlock:
+                writer.write(data)
+                await writer.drain()
+                if poison:
                     obs.count("serve.stream_aborts")
                     try:
                         writer.transport.abort()
                     except Exception:
                         pass
+            return
+
+        async def as_records(it):
+            async for c in it:
+                if conn.transport == "shm":
+                    yield await record_for(c)
+                else:
+                    yield struct.pack("<Q", len(c)) + bytes(c)
+
+        stream = records_iter if records_iter is not None \
+            else as_records(frames_iter)
+        async with wlock:
+            # The JSON head is HELD until the first frame record is
+            # ready, then both leave in a single buffered write — one
+            # syscall, one packet for small responses (and the exact
+            # same byte sequence as separate writes).
+            pending = data
+            try:
+                async for rec in stream:
+                    if pending is not None:
+                        writer.write(pending + rec)
+                        pending = None
+                    else:
+                        writer.write(rec)
+                    await writer.drain()
+                if pending is not None:
+                    writer.write(pending)
+                    await writer.drain()
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:
+                # The JSON head promised binary_frames the stream can no
+                # longer deliver (resume exhausted / chaos truncation):
+                # put whatever must precede the cut on the wire, then
+                # abort the transport so the client sees a hard
+                # connection error, never a silently-short response.
+                obs.count("serve.stream_aborts")
+                tail = exc.partial if isinstance(exc, shm.ChaosTruncation) \
+                    else b""
+                if pending is not None or tail:
+                    try:
+                        writer.write((pending or b"") + tail)
+                        await writer.drain()
+                    except Exception:
+                        pass
+                try:
+                    writer.transport.abort()
+                except Exception:
+                    pass
 
     async def one(req: dict) -> None:
         try:
-            fut = service.submit(req)
+            fut = service.submit(req, conn=conn)
         except Overloaded as exc:
             await write(error_response(
                 req, "Overloaded", str(exc),
@@ -102,6 +303,11 @@ async def _handle_connection(service: SplitService, reader, writer) -> None:
             except ProtocolError as exc:
                 await write(error_response({}, "ProtocolError", str(exc)))
                 continue
+            if req.get("op") == "hello":
+                # Answered inline on the loop: transport is connection
+                # state and must be settled before later responses.
+                await write(_hello_response(service, conn, req, writer))
+                continue
             task = asyncio.ensure_future(one(req))
             pending.add(task)
             task.add_done_callback(pending.discard)
@@ -110,6 +316,17 @@ async def _handle_connection(service: SplitService, reader, writer) -> None:
     finally:
         for task in pending:
             task.cancel()
+        ring = conn.detach_ring()
+        if ring is not None:
+            if ring.drained() or not ring.alive:
+                ring.close()
+            else:
+                # A relay peer closes its upstream connection as soon as
+                # the last descriptor is forwarded — possibly before the
+                # END client has mapped this segment. Hold the unlink
+                # until the ack cursor catches up (bounded): mapped pages
+                # survive the eventual unlink, an unmapped file does not.
+                asyncio.ensure_future(_drain_then_close(ring, loop))
         writer.close()
         try:
             await writer.wait_closed()

@@ -122,6 +122,29 @@ class _FileState:
         self._starts_lock = threading.Lock()
         self._read_batch = None
         self._read_batch_lock = threading.Lock()
+        # Encoded-frame cache: query shape → (frames tuple, rows). Valid
+        # by the SAME determinism invariant the resume token rests on —
+        # an unchanged file + query always encodes the same frame list
+        # (file changes evict the whole _FileState via ``fresh()``).
+        self._frame_cache: "OrderedDict[tuple, tuple]" = OrderedDict()
+        self._frame_cache_lock = threading.Lock()
+
+    #: distinct query shapes kept hot per file.
+    _FRAME_CACHE_SLOTS = 8
+
+    def frame_cache_get(self, key: tuple):
+        with self._frame_cache_lock:
+            hit = self._frame_cache.get(key)
+            if hit is not None:
+                self._frame_cache.move_to_end(key)
+            return hit
+
+    def frame_cache_put(self, key: tuple, chunks: tuple, rows: int) -> None:
+        with self._frame_cache_lock:
+            self._frame_cache[key] = (chunks, rows)
+            self._frame_cache.move_to_end(key)
+            while len(self._frame_cache) > self._FRAME_CACHE_SLOTS:
+                self._frame_cache.popitem(last=False)
 
     def fresh(self) -> bool:
         try:
@@ -165,6 +188,12 @@ class SplitService:
         self.config = config
         self.serve_cfg: ServeConfig = config.serve_config
         self.policy = config.fault_policy
+        # Zero-copy transport knobs the ACCEPT LOOP reads when answering
+        # ``hello`` (serve/server.py) — the service only carries them.
+        self.shm_enabled = bool(self.serve_cfg.shm)
+        self.shm_bytes = int(self.serve_cfg.shm_bytes)
+        self.shm_wait_ms = float(self.serve_cfg.shm_wait_ms)
+        self.shm_chaos = self._build_shm_chaos(config)
         self.mesh = mesh if mesh is not None else make_mesh()
         self.steps = mesh_steps(self.mesh)
         self.batcher = Batcher(
@@ -214,6 +243,22 @@ class SplitService:
         self.slo_engine: "SloEngine | None" = None
         self.sampler: "TailSampler | None" = None
         self.start_observability()
+
+    @staticmethod
+    def _build_shm_chaos(config: Config):
+        """Seeded shm-seam fault source (fabric/chaos.py) when the fabric
+        ``chaos=`` spec carries any ``shm_*`` rate — the serve accept
+        loop rolls it per frame record. Lazy import so an unconfigured
+        service never pulls the fabric stack."""
+        arg = config.fabric_config.chaos
+        if not arg:
+            return None
+        from spark_bam_tpu.fabric.chaos import FabricChaos, parse_fabric_chaos
+
+        seed, spec = parse_fabric_chaos(arg)
+        if not (spec.shm_crc or spec.shm_trunc or spec.shm_unlink):
+            return None
+        return FabricChaos(seed, spec)
 
     def start_observability(self) -> bool:
         """Idempotently start the time-series ring scraper, SLO engine
@@ -289,11 +334,14 @@ class SplitService:
         med = self.latency.median()
         return med if med is not None else _RETRY_AFTER_DEFAULT_MS
 
-    def submit(self, req: dict) -> "Future[dict]":
+    def submit(self, req: dict, conn=None) -> "Future[dict]":
         """Admit ``req`` and return a future resolving to the full response
         dict. Raises :class:`Overloaded` synchronously when the request
         class is at its inflight limit; every other failure becomes a typed
-        error *response* on the future."""
+        error *response* on the future. ``conn`` is the accept loop's
+        per-connection transport state — unused here (the loop itself
+        answers ``hello`` and encodes frame records), accepted so the
+        loop can pass it to any service uniformly."""
         fut: "Future[dict]" = Future()
         op = req.get("op")
         if op == "ping":
@@ -739,35 +787,73 @@ class SplitService:
         batch_rows = int(req.get("batch_rows") or ccfg.batch_rows)
         if batch_rows <= 0:
             raise ServiceError("ProtocolError", "batch_rows must be positive")
+        wire = str(req.get("wire") or "sbcr")
+        if wire not in ("sbcr", "arrow"):
+            raise ServiceError(
+                "ProtocolError",
+                f"wire must be 'sbcr' or 'arrow', got {wire!r}",
+            )
+        if wire == "arrow":
+            from spark_bam_tpu.columnar.arrow_ipc import arrow_available
+
+            if not arrow_available():
+                raise ServiceError(
+                    "Unsupported",
+                    "wire=arrow needs pyarrow (the [arrow] extra); "
+                    "the default sbcr wire has no dependencies",
+                )
         loci = req.get("intervals") or None
         flags_required = int(req.get("flags_required") or 0)
         flags_forbidden = int(req.get("flags_forbidden") or 0)
         tags_required = _norm_tags(req.get("tags_required"))
-        warm = fs.read_batch(self.config)
-        if deadline_ts is not None and time.monotonic() > deadline_ts:
-            obs.count("serve.shed")
-            raise ServiceError(
-                "DeadlineExceeded", "batch deadline expired during parse"
+        # Encoded frames are a pure function of (file, query) — the same
+        # determinism invariant resume rests on — so repeat queries skip
+        # filter + encode entirely and the transport is the only cost.
+        cache_key = (wire, columns, batch_rows, repr(loci), flags_required,
+                     flags_forbidden, tags_required, ccfg.codec, ccfg.level)
+        cached = fs.frame_cache_get(cache_key)
+        if cached is not None:
+            obs.count("serve.frame_cache_hits")
+            chunks, rows = list(cached[0]), cached[1]
+        else:
+            obs.count("serve.frame_cache_misses")
+            warm = fs.read_batch(self.config)
+            if deadline_ts is not None and time.monotonic() > deadline_ts:
+                obs.count("serve.shed")
+                raise ServiceError(
+                    "DeadlineExceeded", "batch deadline expired during parse"
+                )
+            # _apply_filter narrows ``valid`` in place: work on a copy so
+            # the warm tier keeps the unfiltered mask for the next request.
+            batch = ReadBatch(dict(warm.columns), warm.starts, buf=warm.buf)
+            batch.columns["valid"] = np.array(
+                warm.columns["valid"], copy=True
             )
-        # _apply_filter narrows ``valid`` in place: work on a copy so the
-        # warm tier keeps the unfiltered mask for the next request.
-        batch = ReadBatch(dict(warm.columns), warm.starts, buf=warm.buf)
-        batch.columns["valid"] = np.array(warm.columns["valid"], copy=True)
-        if loci or flags_required or flags_forbidden or tags_required:
-            _apply_filter(
-                batch, fs.header, loci, flags_required, flags_forbidden,
-                tags_required=tags_required,
-            )
-        meta = container_meta(
-            columns, codec=ccfg.codec, level=ccfg.level, contigs=fs.contigs
-        )
-        chunks = [container_head(meta)]
-        rows = 0
-        with obs.span("serve.batch_encode", path=fs.path):
-            for rb in read_batch_to_record_batches(batch, batch_rows, columns):
-                chunks.append(batch_frame(rb, meta))
-                rows += rb.num_rows
-        chunks.append(end_frame(rows, len(chunks) - 1))
+            if loci or flags_required or flags_forbidden or tags_required:
+                _apply_filter(
+                    batch, fs.header, loci, flags_required, flags_forbidden,
+                    tags_required=tags_required,
+                )
+            if wire == "arrow":
+                from spark_bam_tpu.columnar.arrow_ipc import stream_frames
+
+                with obs.span("serve.batch_encode", path=fs.path):
+                    chunks, rows = stream_frames(batch, batch_rows, columns)
+            else:
+                meta = container_meta(
+                    columns, codec=ccfg.codec, level=ccfg.level,
+                    contigs=fs.contigs,
+                )
+                chunks = [container_head(meta)]
+                rows = 0
+                with obs.span("serve.batch_encode", path=fs.path):
+                    for rb in read_batch_to_record_batches(
+                        batch, batch_rows, columns
+                    ):
+                        chunks.append(batch_frame(rb, meta))
+                        rows += rb.num_rows
+                chunks.append(end_frame(rows, len(chunks) - 1))
+            fs.frame_cache_put(cache_key, tuple(chunks), rows)
         total_frames = len(chunks)
         # Frame-sequence resume token (docs/robustness.md): the chunk
         # list is deterministic for an unchanged file + query, so a
@@ -788,6 +874,10 @@ class SplitService:
         nbytes = sum(len(c) for c in chunks)
         obs.count("columnar.rows", rows)
         obs.count("columnar.bytes_out", nbytes)
+        if wire == "arrow":
+            # Only the non-default wire is echoed: sbcr responses stay
+            # byte-identical to every earlier release.
+            out["wire"] = wire
         out.update({
             "path": fs.path,
             "rows": int(rows),

@@ -494,6 +494,14 @@ class _CutOnceWorker:
                     return
                 req = json.loads(line)
                 rid = req.get("id")
+                if req.get("op") == "hello":
+                    # Speak the transport handshake, but never grant shm
+                    # — this fake exercises the socket resume path.
+                    writer.write((json.dumps(
+                        {"id": rid, "ok": True, "transport": "socket"}
+                    ) + "\n").encode())
+                    await writer.drain()
+                    continue
                 base = int(req.get("resume_from") or 0)
                 self.resume_tokens.append(req.get("resume_from"))
                 self._attempts += 1
